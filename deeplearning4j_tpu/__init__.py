@@ -1,0 +1,26 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A ground-up JAX/XLA re-design of the capability surface of Eclipse
+Deeplearning4j (reference: /root/reference @ 0.9.2-SNAPSHOT).  Where the
+reference executes eager per-op through JNI into libnd4j/cuDNN
+(see reference nn/multilayer/MultiLayerNetwork.java:1165 fit loop), this
+framework defines layers as pure functions, derives gradients with
+``jax.grad``, and compiles one XLA program per training step; distributed
+training uses mesh collectives (psum/ppermute) over ICI/DCN instead of
+parameter averaging / Aeron UDP gradient messages.
+
+Top-level layout:
+    ops/          tensor substrate: dtype policy, activations, initializers,
+                  losses, collectives, pallas kernels  (replaces ND4J, L0)
+    nn/           configs-as-data, layer impls, model containers, updaters,
+                  train-step factory                    (replaces deeplearning4j-nn, L1)
+    datasets/     DataSet + iterator pipeline           (replaces deeplearning4j-core data, L3)
+    evaluation/   Evaluation / ROC / regression metrics (replaces eval/, L1)
+    parallel/     mesh builders, DP/TP/SP training, ring attention,
+                  parallel inference                    (replaces scaleout, L4)
+    models/       model zoo                             (replaces deeplearning4j-zoo, L5)
+    nlp/          embeddings (Word2Vec family)          (replaces deeplearning4j-nlp, L5)
+    utils/        serialization, gradient checks        (replaces util/, gradientcheck/)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,9 @@
+from .dataset import DataSet, MultiDataSet
+from .iterators import (
+    DataSetIterator,
+    ListDataSetIterator,
+    AsyncDataSetIterator,
+    MultipleEpochsIterator,
+    EarlyTerminationDataSetIterator,
+    SamplingDataSetIterator,
+)

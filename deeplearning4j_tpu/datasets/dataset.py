@@ -1,0 +1,72 @@
+"""DataSet / MultiDataSet — minibatch containers.
+
+Parity with ND4J ``org.nd4j.linalg.dataset.DataSet`` (features, labels,
+featuresMask, labelsMask) and ``MultiDataSet`` (lists of each).  Arrays are
+numpy on the host; device placement happens inside the jit'd step (or via
+double-buffered device puts in AsyncDataSetIterator).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class DataSet:
+    features: np.ndarray
+    labels: Optional[np.ndarray] = None
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_test_and_train(self, n_train: int) -> tuple["DataSet", "DataSet"]:
+        def cut(a, lo, hi):
+            return None if a is None else a[lo:hi]
+        n = self.num_examples()
+        return (
+            DataSet(self.features[:n_train], cut(self.labels, 0, n_train),
+                    cut(self.features_mask, 0, n_train), cut(self.labels_mask, 0, n_train)),
+            DataSet(self.features[n_train:], cut(self.labels, n_train, n),
+                    cut(self.features_mask, n_train, n), cut(self.labels_mask, n_train, n)),
+        )
+
+    def shuffle(self, seed: Optional[int] = None) -> "DataSet":
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(self.num_examples())
+        pick = lambda a: None if a is None else a[perm]
+        return DataSet(self.features[perm], pick(self.labels),
+                       pick(self.features_mask), pick(self.labels_mask))
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        out = []
+        for i in range(0, self.num_examples(), batch_size):
+            cut = lambda a: None if a is None else a[i:i + batch_size]
+            out.append(DataSet(self.features[i:i + batch_size], cut(self.labels),
+                               cut(self.features_mask), cut(self.labels_mask)))
+        return out
+
+    @staticmethod
+    def merge(sets: Sequence["DataSet"]) -> "DataSet":
+        cat = lambda xs: None if xs[0] is None else np.concatenate(xs, axis=0)
+        return DataSet(
+            np.concatenate([d.features for d in sets], axis=0),
+            cat([d.labels for d in sets]),
+            cat([d.features_mask for d in sets]),
+            cat([d.labels_mask for d in sets]),
+        )
+
+
+@dataclasses.dataclass
+class MultiDataSet:
+    features: List[np.ndarray]
+    labels: List[np.ndarray]
+    features_masks: Optional[List[Optional[np.ndarray]]] = None
+    labels_masks: Optional[List[Optional[np.ndarray]]] = None
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])

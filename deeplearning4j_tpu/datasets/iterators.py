@@ -1,0 +1,238 @@
+"""DataSetIterator pipeline — composable minibatch iterators.
+
+Parity with reference datasets/iterator/ (SURVEY.md §2.1 "Dataset iterator
+layer"): AsyncDataSetIterator (background prefetch thread wrapped around any
+iterator — reference AsyncDataSetIterator.java:30, used by
+MultiLayerNetwork.fit():1169-1172), MultipleEpochsIterator,
+EarlyTerminationDataSetIterator, SamplingDataSetIterator.
+
+Iterators follow the reference's contract: ``reset()``, ``has_next()``,
+``next()`` → DataSet, ``batch_size``, plus Python iteration sugar.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from .dataset import DataSet
+
+
+class DataSetIterator:
+    """Abstract base (reference org.nd4j.linalg.dataset.api.iterator)."""
+
+    batch_size: int = 0
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next(self) -> DataSet:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        while self.has_next():
+            yield self.next()
+
+    def total_examples(self) -> Optional[int]:
+        return None
+
+
+class ListDataSetIterator(DataSetIterator):
+    """Iterate a list of pre-built minibatches (reference ListDataSetIterator
+    / ExistingDataSetIterator)."""
+
+    def __init__(self, batches: List[DataSet]):
+        self._batches = list(batches)
+        self._pos = 0
+        self.batch_size = batches[0].num_examples() if batches else 0
+
+    @staticmethod
+    def from_arrays(features: np.ndarray, labels: np.ndarray, batch_size: int,
+                    shuffle: bool = False, seed: Optional[int] = None) -> "ListDataSetIterator":
+        ds = DataSet(features, labels)
+        if shuffle:
+            ds = ds.shuffle(seed)
+        return ListDataSetIterator(ds.batch_by(batch_size))
+
+    def reset(self) -> None:
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < len(self._batches)
+
+    def next(self) -> DataSet:
+        b = self._batches[self._pos]
+        self._pos += 1
+        return b
+
+    def total_examples(self) -> int:
+        return sum(b.num_examples() for b in self._batches)
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch (reference AsyncDataSetIterator.java:30:
+    'used to load batches in the background while training proceeds').
+
+    ``prefetch`` matches the reference's queue capacity (default 2×).
+    The producer thread fills a bounded queue; a sentinel marks exhaustion.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, base: DataSetIterator, prefetch: int = 4):
+        self._base = base
+        self._prefetch = prefetch
+        self.batch_size = base.batch_size
+        self._queue: queue.Queue = queue.Queue(maxsize=prefetch)
+        self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
+        self._peeked = None
+        self._start()
+
+    def _start(self) -> None:
+        self._queue = queue.Queue(maxsize=self._prefetch)
+        stop = threading.Event()
+        self._stop = stop
+        q = self._queue
+
+        def producer():
+            try:
+                self._base.reset()
+                while self._base.has_next() and not stop.is_set():
+                    item = self._base.next()
+                    while not stop.is_set():
+                        try:
+                            q.put(item, timeout=0.1)
+                            break
+                        except queue.Full:
+                            continue
+            finally:
+                while not stop.is_set():
+                    try:
+                        q.put(self._SENTINEL, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+
+        self._thread = threading.Thread(target=producer, daemon=True)
+        self._thread.start()
+
+    def reset(self) -> None:
+        """Tear down the producer (deadlock-free even mid-stream or after
+        exhaustion) and start a fresh pass."""
+        if self._thread is not None:
+            self._stop.set()
+            while self._thread.is_alive():
+                try:  # unblock a producer stuck on a full queue
+                    self._queue.get(timeout=0.05)
+                except queue.Empty:
+                    pass
+            self._thread.join()
+        self._peeked = None
+        self._start()
+
+    def _peek(self):
+        if self._peeked is None:
+            self._peeked = self._queue.get()
+        return self._peeked
+
+    def has_next(self) -> bool:
+        return self._peek() is not self._SENTINEL
+
+    def next(self) -> DataSet:
+        item = self._peek()
+        if item is self._SENTINEL:
+            raise StopIteration
+        self._peeked = None
+        return item
+
+    def total_examples(self):
+        return self._base.total_examples()
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Replays a base iterator for N epochs (reference MultipleEpochsIterator)."""
+
+    def __init__(self, epochs: int, base: DataSetIterator):
+        self._base = base
+        self._epochs = epochs
+        self._epoch = 0
+        self.batch_size = base.batch_size
+        base.reset()
+
+    def reset(self) -> None:
+        self._epoch = 0
+        self._base.reset()
+
+    def has_next(self) -> bool:
+        if self._base.has_next():
+            return True
+        if self._epoch + 1 < self._epochs:
+            self._epoch += 1
+            self._base.reset()
+            return self._base.has_next()
+        return False
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        return self._base.next()
+
+
+class EarlyTerminationDataSetIterator(DataSetIterator):
+    """Caps the number of minibatches per epoch (reference
+    EarlyTerminationDataSetIterator)."""
+
+    def __init__(self, base: DataSetIterator, max_batches: int):
+        self._base = base
+        self._max = max_batches
+        self._count = 0
+        self.batch_size = base.batch_size
+
+    def reset(self) -> None:
+        self._count = 0
+        self._base.reset()
+
+    def has_next(self) -> bool:
+        return self._count < self._max and self._base.has_next()
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        self._count += 1
+        return self._base.next()
+
+
+class SamplingDataSetIterator(DataSetIterator):
+    """Samples minibatches with replacement from a full DataSet (reference
+    SamplingDataSetIterator)."""
+
+    def __init__(self, dataset: DataSet, batch_size: int, batches_per_epoch: int,
+                 seed: Optional[int] = None):
+        self._ds = dataset
+        self.batch_size = batch_size
+        self._n_batches = batches_per_epoch
+        self._count = 0
+        self._rng = np.random.default_rng(seed)
+
+    def reset(self) -> None:
+        self._count = 0
+
+    def has_next(self) -> bool:
+        return self._count < self._n_batches
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        self._count += 1
+        idx = self._rng.integers(0, self._ds.num_examples(), size=self.batch_size)
+        pick = lambda a: None if a is None else a[idx]
+        return DataSet(self._ds.features[idx], pick(self._ds.labels),
+                       pick(self._ds.features_mask), pick(self._ds.labels_mask))

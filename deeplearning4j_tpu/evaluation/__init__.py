@@ -1,0 +1,5 @@
+from .evaluation import Evaluation, ConfusionMatrix
+from .regression import RegressionEvaluation
+from .roc import ROC, ROCBinary, ROCMultiClass
+from .binary import EvaluationBinary
+from .calibration import EvaluationCalibration

@@ -1,0 +1,9 @@
+"""NN core (L1): configs-as-data, layer impls, containers, updaters, train step.
+
+Replaces the reference's deeplearning4j-nn module (SURVEY.md §1 L1).  Key
+inversion: the reference pairs every declarative layer config
+(nn/conf/layers/*) with a hand-written runtime impl (nn/layers/*) carrying
+its own backpropGradient; here each layer is ONE dataclass whose ``forward``
+is a pure function and whose backward pass is derived by jax.grad, with the
+whole fit step compiled to a single XLA program.
+"""

@@ -1,0 +1,84 @@
+"""InputType — shape inference between layers.
+
+Parity with reference nn/conf/inputs/InputType.java:43-201 (feedForward,
+recurrent, convolutional, convolutionalFlat).  Differences by design:
+
+  - Convolutional activations are **NHWC** ``[mb, h, w, c]`` (TPU/XLA native
+    layout), not the reference's NCHW.
+  - Recurrent activations are **[mb, time, size]** (scan-friendly), not the
+    reference's ``[mb, size, time]``.
+
+These layouts keep XLA convolutions and ``lax.scan`` in their fast paths;
+converters at the data boundary accept DL4J-layout arrays.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class InputType:
+    """Tagged shape descriptor: kind ∈ {ff, rnn, cnn, cnn_flat}."""
+
+    kind: str
+    size: int = 0                      # ff/rnn feature size
+    timesteps: Optional[int] = None    # rnn (None = variable)
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    # -- constructors (parity with InputType.feedForward() etc.) --
+    @staticmethod
+    def feed_forward(size: int) -> "InputType":
+        return InputType("ff", size=int(size))
+
+    @staticmethod
+    def recurrent(size: int, timesteps: Optional[int] = None) -> "InputType":
+        return InputType("rnn", size=int(size), timesteps=timesteps)
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType("cnn", height=int(height), width=int(width), channels=int(channels))
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
+        return InputType("cnn_flat", height=int(height), width=int(width), channels=int(channels))
+
+    # -- helpers --
+    def flat_size(self) -> int:
+        """Total per-example element count (InputType.arrayElementsPerExample)."""
+        if self.kind == "ff":
+            return self.size
+        if self.kind == "rnn":
+            if self.timesteps is None:
+                raise ValueError("variable-length recurrent input has no flat size")
+            return self.size * self.timesteps
+        return self.height * self.width * self.channels
+
+    def batch_shape(self, mb: int) -> Tuple[int, ...]:
+        """Example array shape for minibatch size ``mb`` (native layouts)."""
+        if self.kind == "ff" or self.kind == "cnn_flat":
+            return (mb, self.flat_size()) if self.kind == "ff" else (
+                mb, self.height * self.width * self.channels)
+        if self.kind == "rnn":
+            if self.timesteps is None:
+                raise ValueError("variable timesteps: shape unknown")
+            return (mb, self.timesteps, self.size)
+        return (mb, self.height, self.width, self.channels)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d: dict) -> "InputType":
+        return InputType(**d)
+
+    def __repr__(self) -> str:  # compact, DL4J-ish
+        if self.kind == "ff":
+            return f"InputType(ff,{self.size})"
+        if self.kind == "rnn":
+            return f"InputType(rnn,{self.size},t={self.timesteps})"
+        tag = "cnn" if self.kind == "cnn" else "cnn_flat"
+        return f"InputType({tag},h={self.height},w={self.width},c={self.channels})"

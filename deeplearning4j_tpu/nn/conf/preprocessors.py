@@ -1,0 +1,170 @@
+"""Input preprocessors — shape adapters between layer families.
+
+Reference: nn/conf/preprocessor/ (CnnToFeedForwardPreProcessor,
+FeedForwardToCnnPreProcessor, RnnToFeedForwardPreProcessor, etc.).  In the
+reference these also implement backprop() to reverse the reshape; here the
+reshapes are traced ops, so autodiff reverses them for free.
+
+Native layouts: CNN = NHWC [mb,h,w,c]; RNN = [mb,t,f].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+from ..layers.base import register_config
+from .inputs import InputType
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class Preprocessor:
+    def apply(self, x: Array) -> Array:
+        raise NotImplementedError
+
+    def output_type(self, in_type: InputType) -> InputType:
+        raise NotImplementedError
+
+
+@register_config
+@dataclasses.dataclass
+class CnnToFeedForward(Preprocessor):
+    """[mb,h,w,c] → [mb, h*w*c] (reference CnnToFeedForwardPreProcessor)."""
+
+    def apply(self, x: Array) -> Array:
+        return x.reshape((x.shape[0], -1))
+
+    def output_type(self, in_type: InputType) -> InputType:
+        return InputType.feed_forward(in_type.flat_size())
+
+
+@register_config
+@dataclasses.dataclass
+class FeedForwardToCnn(Preprocessor):
+    """[mb, h*w*c] → [mb,h,w,c] (reference FeedForwardToCnnPreProcessor)."""
+
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def apply(self, x: Array) -> Array:
+        return x.reshape((x.shape[0], self.height, self.width, self.channels))
+
+    def output_type(self, in_type: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_config
+@dataclasses.dataclass
+class RnnToFeedForward(Preprocessor):
+    """[mb,t,f] → [mb*t, f] time-flattening (reference RnnToFeedForwardPreProcessor).
+
+    NOTE: our Dense layers broadcast over [mb,t,f] directly, so this is only
+    needed for explicit parity paths."""
+
+    def apply(self, x: Array) -> Array:
+        return x.reshape((-1, x.shape[-1]))
+
+    def output_type(self, in_type: InputType) -> InputType:
+        return InputType.feed_forward(in_type.size)
+
+
+@register_config
+@dataclasses.dataclass
+class FeedForwardToRnn(Preprocessor):
+    """[mb*t, f] → [mb,t,f]."""
+
+    timesteps: int = 0
+
+    def apply(self, x: Array) -> Array:
+        return x.reshape((-1, self.timesteps, x.shape[-1]))
+
+    def output_type(self, in_type: InputType) -> InputType:
+        return InputType.recurrent(in_type.size, self.timesteps)
+
+
+@register_config
+@dataclasses.dataclass
+class CnnToRnn(Preprocessor):
+    """[mb,h,w,c] → [mb, t=h*w? no: treat h as time? ] — the reference maps
+    [mb,c,h,w] → [mb, c*h*w / t ...]; canonical use is video/audio frames.
+    We adopt: time = height, features = width*channels."""
+
+    def apply(self, x: Array) -> Array:
+        mb, h, w, c = x.shape
+        return x.reshape((mb, h, w * c))
+
+    def output_type(self, in_type: InputType) -> InputType:
+        return InputType.recurrent(in_type.width * in_type.channels, in_type.height)
+
+
+@register_config
+@dataclasses.dataclass
+class RnnToCnn(Preprocessor):
+    """[mb,t,f] → [mb, t, f/c, c]: inverse of CnnToRnn."""
+
+    channels: int = 1
+
+    def apply(self, x: Array) -> Array:
+        mb, t, f = x.shape
+        return x.reshape((mb, t, f // self.channels, self.channels))
+
+    def output_type(self, in_type: InputType) -> InputType:
+        t = in_type.timesteps or 0
+        return InputType.convolutional(t, in_type.size // self.channels, self.channels)
+
+
+@register_config
+@dataclasses.dataclass
+class UnitVariance(Preprocessor):
+    """Per-example unit variance (reference UnitVarianceProcessor)."""
+
+    def apply(self, x: Array) -> Array:
+        std = jnp.std(x.reshape((x.shape[0], -1)), axis=1)
+        std = std.reshape((-1,) + (1,) * (x.ndim - 1))
+        return x / jnp.maximum(std, 1e-8)
+
+    def output_type(self, in_type: InputType) -> InputType:
+        return in_type
+
+
+@register_config
+@dataclasses.dataclass
+class ZeroMean(Preprocessor):
+    """Per-example zero mean (reference ZeroMeanPrePreProcessor)."""
+
+    unit_variance: bool = False
+
+    def apply(self, x: Array) -> Array:
+        flat = x.reshape((x.shape[0], -1))
+        mean = jnp.mean(flat, axis=1).reshape((-1,) + (1,) * (x.ndim - 1))
+        y = x - mean
+        if self.unit_variance:
+            std = jnp.std(flat, axis=1).reshape((-1,) + (1,) * (x.ndim - 1))
+            y = y / jnp.maximum(std, 1e-8)
+        return y
+
+    def output_type(self, in_type: InputType) -> InputType:
+        return in_type
+
+
+@register_config
+@dataclasses.dataclass
+class Composable(Preprocessor):
+    """Chain of preprocessors (reference ComposableInputPreProcessor)."""
+
+    steps: list = dataclasses.field(default_factory=list)
+
+    def apply(self, x: Array) -> Array:
+        for s in self.steps:
+            x = s.apply(x)
+        return x
+
+    def output_type(self, in_type: InputType) -> InputType:
+        for s in self.steps:
+            in_type = s.output_type(in_type)
+        return in_type

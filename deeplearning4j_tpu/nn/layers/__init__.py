@@ -1,0 +1,29 @@
+from .base import Layer, ForwardOut, register_layer, layer_from_dict, layer_to_dict
+from .feedforward import (
+    Dense,
+    OutputLayer,
+    LossLayer,
+    ActivationLayer,
+    DropoutLayer,
+    Embedding,
+    EmbeddingSequence,
+    ElementWiseMultiplication,
+    AutoEncoder,
+)
+from .convolution import (
+    Convolution1D,
+    Convolution2D,
+    Deconvolution2D,
+    SeparableConvolution2D,
+    ZeroPadding1D,
+    ZeroPadding2D,
+    Cropping2D,
+    Upsampling1D,
+    Upsampling2D,
+)
+from .pooling import Subsampling1D, Subsampling2D, GlobalPooling
+from .normalization import BatchNormalization, LocalResponseNormalization
+from .recurrent import LSTM, GravesLSTM, GravesBidirectionalLSTM, SimpleRnn, RnnOutputLayer, Bidirectional, LastTimeStep
+from .variational import VariationalAutoencoder
+from .objdetect import Yolo2OutputLayer
+from .special import FrozenLayer, CenterLossOutputLayer

@@ -1,0 +1,225 @@
+"""Layer base: config-as-data + pure-function runtime in one class.
+
+The reference splits every layer into a declarative config
+(nn/conf/layers/*.java, JSON-serializable via Jackson) and a runtime impl
+(nn/layers/*.java) with hand-written ``activate``/``backpropGradient``
+(e.g. ConvolutionLayer.java:197-213 im2col+gemm).  Here one dataclass plays
+both roles: fields are the JSON-serializable hyperparameters; ``forward`` is
+a pure jax function (backward derived by autodiff); ``init_params`` replaces
+the 13 ParamInitializer classes (nn/params/).
+
+Param-name parity: weight key "W", bias key "b" (DefaultParamInitializer),
+recurrent weights "RW" (LSTMParamInitializer RECURRENT_WEIGHT_KEY), BN
+"gamma"/"beta" + state "mean"/"var".
+
+Serde: every config dataclass (layers, updaters, preprocessors, vertices)
+registers in one registry and round-trips through ``{"type": ClsName, ...}``
+dicts — the equivalent of the reference's Jackson subtype registry
+(nn/conf/serde/).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, ClassVar, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...ops.activations import get_activation
+from ...ops.initializers import init_weight
+from ..conf.inputs import InputType
+
+Array = jax.Array
+
+# ---------------------------------------------------------------------------
+# serde registry (shared by layers, vertices, updaters, preprocessors)
+# ---------------------------------------------------------------------------
+
+CONFIG_REGISTRY: Dict[str, type] = {}
+
+
+def register_config(cls):
+    """Class decorator: make a dataclass JSON round-trippable by type name."""
+    CONFIG_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+register_layer = register_config  # alias, reads better at layer definitions
+
+
+def config_to_dict(obj: Any) -> Any:
+    """Recursively encode a registered dataclass to plain JSON types."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        d: Dict[str, Any] = {"type": type(obj).__name__}
+        for f in dataclasses.fields(obj):
+            d[f.name] = config_to_dict(getattr(obj, f.name))
+        return d
+    if isinstance(obj, (list, tuple)):
+        return [config_to_dict(v) for v in obj]
+    if isinstance(obj, dict):
+        return {k: config_to_dict(v) for k, v in obj.items()}
+    if isinstance(obj, np.dtype):
+        return str(obj)
+    return obj
+
+
+def config_from_dict(d: Any) -> Any:
+    """Inverse of config_to_dict."""
+    if isinstance(d, dict) and "type" in d and d["type"] in CONFIG_REGISTRY:
+        cls = CONFIG_REGISTRY[d["type"]]
+        fields = {f.name for f in dataclasses.fields(cls)}
+        kwargs = {k: config_from_dict(v) for k, v in d.items() if k in fields}
+        return cls(**kwargs)
+    if isinstance(d, dict):
+        return {k: config_from_dict(v) for k, v in d.items()}
+    if isinstance(d, list):
+        return [config_from_dict(v) for v in d]
+    return d
+
+
+def layer_to_dict(layer: "Layer") -> dict:
+    return config_to_dict(layer)
+
+
+def layer_from_dict(d: dict) -> "Layer":
+    out = config_from_dict(d)
+    if not isinstance(out, Layer):
+        raise ValueError(f"not a layer dict: {d.get('type')}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# forward result
+# ---------------------------------------------------------------------------
+
+
+class ForwardOut(NamedTuple):
+    """Result of Layer.forward: activations, new non-trainable state, mask.
+
+    ``mask`` threads per-timestep masks through the stack the way the
+    reference's feedForwardMaskArray does (nn/graph/vertex/GraphVertex.java:142).
+    ``carry`` is the recurrent hidden state a layer emits when driven with an
+    explicit carry (TBPTT chunking / rnnTimeStep streaming — reference
+    MultiLayerNetwork.doTruncatedBPTT():1386, rnnTimeStep():2636).
+    """
+
+    y: Array
+    state: Dict[str, Array]
+    mask: Optional[Array]
+    carry: Any = None
+
+
+# ---------------------------------------------------------------------------
+# base layer
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class Layer:
+    """Base hyperparameters shared by all layers (reference BaseLayer conf).
+
+    ``dropout`` is *input* dropout, applied to the layer input during
+    training (reference nn/conf/dropout/Dropout.java semantics: retain prob
+    = 1 - dropout... DL4J's `dropOut(p)` is the *retain* probability in 0.x;
+    here ``dropout`` is the DROP probability for clarity, documented).
+    ``l1``/``l2`` apply to weight params only (DL4J default).
+    """
+
+    #: ``activation``/``weight_init`` default to None = "unset": the builder
+    #: fills them from its global defaults (the reference's global-conf
+    #: inheritance, NeuralNetConfiguration.Builder), else they resolve to
+    #: "identity"/"xavier".  Layer subclasses with a real domain default
+    #: (e.g. LSTM tanh) declare it explicitly and win over builder defaults.
+    name: Optional[str] = None
+    activation: Optional[str] = None
+    weight_init: Optional[str] = None
+    bias_init: float = 0.0
+    dropout: float = 0.0
+    l1: float = 0.0
+    l2: float = 0.0
+    updater: Optional[Any] = None  # per-layer IUpdater override (nn/updaters)
+    trainable: bool = True
+
+    #: expected input kind: None = any, else "ff" / "cnn" / "rnn".  Drives
+    #: automatic preprocessor insertion (the reference's
+    #: InputType.getPreProcessorForInputType pass).  ClassVar: not serialized.
+    wants: ClassVar[Optional[str]] = None
+    #: True for layers whose forward() accepts a ``carry`` kwarg (LSTM/RNN);
+    #: enables TBPTT chunking and streaming inference.
+    recurrent: ClassVar[bool] = False
+
+    def init_carry(self, mb: int, dtype=jnp.float32):
+        """Zero recurrent carry for batch size ``mb`` (None if stateless)."""
+        return None
+
+    # -- shape inference ---------------------------------------------------
+    def output_type(self, in_type: InputType) -> InputType:
+        return in_type
+
+    def infer_nin(self, in_type: InputType) -> None:
+        """Fill in n_in style fields from the incoming InputType (the
+        equivalent of MultiLayerConfiguration's setNIn / InputType pass)."""
+
+    # -- params/state ------------------------------------------------------
+    def init_params(self, rng: Array, in_type: InputType, dtype=jnp.float32) -> Dict[str, Array]:
+        return {}
+
+    def init_state(self, in_type: InputType, dtype=jnp.float32) -> Dict[str, Array]:
+        return {}
+
+    # -- runtime -----------------------------------------------------------
+    def forward(
+        self,
+        params: Dict[str, Array],
+        state: Dict[str, Array],
+        x: Array,
+        *,
+        train: bool = False,
+        rng: Optional[Array] = None,
+        mask: Optional[Array] = None,
+    ) -> ForwardOut:
+        raise NotImplementedError
+
+    # -- shared helpers ----------------------------------------------------
+    def _maybe_dropout(self, x: Array, train: bool, rng: Optional[Array]) -> Array:
+        if not train or self.dropout <= 0.0:
+            return x
+        if rng is None:
+            raise ValueError(f"layer {self.name}: dropout requires an rng key in training")
+        keep = 1.0 - self.dropout
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+    def _act(self, x: Array) -> Array:
+        return get_activation(self.activation or "identity")(x)
+
+    def _winit(self) -> str:
+        return self.weight_init or "xavier"
+
+    def _dense_init(self, rng, n_in: int, n_out: int, dtype) -> Dict[str, Array]:
+        wk, _ = jax.random.split(rng)
+        return {
+            "W": init_weight(wk, (n_in, n_out), self._winit(), n_in, n_out, dtype),
+            "b": jnp.full((n_out,), self.bias_init, dtype),
+        }
+
+    def regularization_score(self, params: Dict[str, Array]) -> Array:
+        """l1*|W| + 0.5*l2*W² over weight-class params (reference
+        BaseLayer.calcL2/calcL1: biases excluded by default)."""
+        if (self.l1 == 0.0 and self.l2 == 0.0) or not params:
+            return jnp.zeros((), jnp.float32)
+        score = jnp.zeros((), jnp.float32)
+        for k, v in params.items():
+            if k in ("b", "beta", "gamma", "mean", "var"):
+                continue
+            v32 = v.astype(jnp.float32)
+            if self.l1:
+                score = score + self.l1 * jnp.sum(jnp.abs(v32))
+            if self.l2:
+                score = score + 0.5 * self.l2 * jnp.sum(v32 * v32)
+        return score
+
+    def has_params(self) -> bool:
+        return True

@@ -1,0 +1,253 @@
+"""Feed-forward layer family.
+
+Parity targets in the reference:
+  Dense              — nn/conf/layers/DenseLayer.java + nn/layers/feedforward/dense/DenseLayer.java
+  OutputLayer        — nn/conf/layers/OutputLayer.java (+ BaseOutputLayer score math)
+  LossLayer          — nn/conf/layers/LossLayer.java (no params, loss only)
+  ActivationLayer    — nn/conf/layers/ActivationLayer.java
+  DropoutLayer       — nn/conf/layers/DropoutLayer.java
+  Embedding          — nn/conf/layers/EmbeddingLayer.java (index lookup ≡ one-hot matmul)
+  ElementWiseMultiplication — nn/conf/layers/misc/ElementWiseMultiplicationLayer.java
+  AutoEncoder        — nn/conf/layers/AutoEncoder.java (denoising autoencoder,
+                       pretrain reconstruction; nn/layers/feedforward/autoencoder/AutoEncoder.java)
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.initializers import init_weight
+from ...ops.losses import get_loss
+from ..conf.inputs import InputType
+from .base import ForwardOut, Layer, register_layer
+
+Array = jax.Array
+
+
+def _flatten_ff(x: Array) -> Array:
+    """Accept [mb, f] directly; collapse trailing dims of cnn_flat inputs."""
+    if x.ndim == 2:
+        return x
+    return x.reshape((x.shape[0], -1))
+
+
+@register_layer
+@dataclasses.dataclass
+class Dense(Layer):
+    """Fully connected: y = act(x·W + b).  RNN inputs [mb,t,f] are handled
+    time-distributed (the reference forces a preprocessor; we broadcast)."""
+
+    wants = "ff"
+
+    n_in: int = 0
+    n_out: int = 0
+    has_bias: bool = True
+
+    def infer_nin(self, in_type: InputType) -> None:
+        if self.n_in == 0:
+            self.n_in = in_type.size if in_type.kind in ("ff", "rnn") else in_type.flat_size()
+
+    def output_type(self, in_type: InputType) -> InputType:
+        if in_type.kind == "rnn":
+            return InputType.recurrent(self.n_out, in_type.timesteps)
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, rng, in_type, dtype=jnp.float32) -> Dict[str, Array]:
+        p = self._dense_init(rng, self.n_in, self.n_out, dtype)
+        if not self.has_bias:
+            del p["b"]
+        return p
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        x = self._maybe_dropout(x, train, rng)
+        y = x @ params["W"].astype(x.dtype)
+        if self.has_bias:
+            y = y + params["b"].astype(x.dtype)
+        return ForwardOut(self._act(y), state, mask)
+
+
+@register_layer
+@dataclasses.dataclass
+class OutputLayer(Dense):
+    """Dense + loss head (reference OutputLayer extends BaseOutputLayer).
+
+    ``loss`` names an ops.losses entry; score() fuses softmax/sigmoid with
+    the loss in log-space.
+    """
+
+    loss: str = "mcxent"
+
+    def score(self, params, state, x, labels, *, mask: Optional[Array] = None) -> Array:
+        pre = x @ params["W"].astype(x.dtype)
+        if self.has_bias:
+            pre = pre + params["b"].astype(x.dtype)
+        return get_loss(self.loss)(labels, pre, self.activation or "identity", mask)
+
+
+@register_layer
+@dataclasses.dataclass
+class LossLayer(Layer):
+    """Parameter-free loss head (reference LossLayer: 'loss only, no params')."""
+
+    loss: str = "mse"
+
+    def has_params(self) -> bool:
+        return False
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        return ForwardOut(self._act(x), state, mask)
+
+    def score(self, params, state, x, labels, *, mask: Optional[Array] = None) -> Array:
+        return get_loss(self.loss)(labels, x, self.activation or "identity", mask)
+
+
+@register_layer
+@dataclasses.dataclass
+class ActivationLayer(Layer):
+    def has_params(self) -> bool:
+        return False
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        return ForwardOut(self._act(x), state, mask)
+
+
+@register_layer
+@dataclasses.dataclass
+class DropoutLayer(Layer):
+    """Standalone dropout (reference DropoutLayer: identity at test time)."""
+
+    def has_params(self) -> bool:
+        return False
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        return ForwardOut(self._maybe_dropout(x, train, rng), state, mask)
+
+
+@register_layer
+@dataclasses.dataclass
+class Embedding(Layer):
+    """Index → vector lookup (reference EmbeddingLayer: 'equivalent to a
+    DenseLayer with a one-hot input'; input is [mb, 1] int indices).
+
+    Accepts int arrays [mb] or [mb, 1]; gather replaces the reference's
+    one-hot matmul — XLA lowers gather efficiently on TPU.
+    """
+
+    n_in: int = 0   # vocab size
+    n_out: int = 0  # embedding dim
+    has_bias: bool = True
+
+    def infer_nin(self, in_type: InputType) -> None:
+        if self.n_in == 0:
+            self.n_in = in_type.size
+
+    def output_type(self, in_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, rng, in_type, dtype=jnp.float32) -> Dict[str, Array]:
+        p = self._dense_init(rng, self.n_in, self.n_out, dtype)
+        if not self.has_bias:
+            del p["b"]
+        return p
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2 and idx.shape[-1] == 1:
+            idx = idx[:, 0]
+        y = params["W"][idx]
+        if self.has_bias:
+            y = y + params["b"]
+        return ForwardOut(self._act(y), state, mask)
+
+
+@register_layer
+@dataclasses.dataclass
+class EmbeddingSequence(Embedding):
+    """Sequence of indices [mb, t] → [mb, t, n_out] (reference
+    EmbeddingSequenceLayer, added for RNN/text paths)."""
+
+    def output_type(self, in_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, in_type.timesteps)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        idx = x.astype(jnp.int32)
+        y = params["W"][idx]  # [mb, t, n_out]
+        if self.has_bias:
+            y = y + params["b"]
+        return ForwardOut(self._act(y), state, mask)
+
+
+@register_layer
+@dataclasses.dataclass
+class ElementWiseMultiplication(Layer):
+    """y = act(x ⊙ w + b) (reference misc/ElementWiseMultiplicationLayer)."""
+
+    n_in: int = 0
+
+    def infer_nin(self, in_type: InputType) -> None:
+        if self.n_in == 0:
+            self.n_in = in_type.size
+
+    def init_params(self, rng, in_type, dtype=jnp.float32) -> Dict[str, Array]:
+        return {
+            "W": jnp.ones((self.n_in,), dtype),
+            "b": jnp.full((self.n_in,), self.bias_init, dtype),
+        }
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        y = x * params["W"].astype(x.dtype) + params["b"].astype(x.dtype)
+        return ForwardOut(self._act(y), state, mask)
+
+
+@register_layer
+@dataclasses.dataclass
+class AutoEncoder(Layer):
+    """Denoising autoencoder with tied-ish params (reference AutoEncoder:
+    params W, b (hidden), vb (visible); corruption level; reconstruction
+    distribution is the layer loss).
+
+    forward() yields the hidden code (as the reference's activate does);
+    ``reconstruction_score`` gives the pretrain loss.
+    """
+
+    n_in: int = 0
+    n_out: int = 0
+    corruption_level: float = 0.3
+    loss: str = "mse"
+
+    def infer_nin(self, in_type: InputType) -> None:
+        if self.n_in == 0:
+            self.n_in = in_type.size
+
+    def output_type(self, in_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, rng, in_type, dtype=jnp.float32) -> Dict[str, Array]:
+        k1, _ = jax.random.split(rng)
+        return {
+            "W": init_weight(k1, (self.n_in, self.n_out), self._winit(), self.n_in, self.n_out, dtype),
+            "b": jnp.full((self.n_out,), self.bias_init, dtype),
+            "vb": jnp.zeros((self.n_in,), dtype),
+        }
+
+    def encode(self, params, x):
+        return self._act(x @ params["W"].astype(x.dtype) + params["b"].astype(x.dtype))
+
+    def decode(self, params, h):
+        return self._act(h @ params["W"].T.astype(h.dtype) + params["vb"].astype(h.dtype))
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        x = self._maybe_dropout(x, train, rng)
+        return ForwardOut(self.encode(params, x), state, mask)
+
+    def reconstruction_score(self, params, x, *, rng=None, train=False) -> Array:
+        xin = x
+        if train and self.corruption_level > 0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level, x.shape)
+            xin = jnp.where(keep, x, 0.0).astype(x.dtype)
+        recon = self.decode(params, self.encode(params, xin))
+        return get_loss(self.loss)(x, recon, "identity")

@@ -1,0 +1,100 @@
+"""YOLOv2 output layer.
+
+Reference: nn/conf/layers/objdetect/Yolo2OutputLayer.java +
+nn/layers/objdetect/Yolo2OutputLayer.java (721 LoC): grid-cell predictions
+[mb, B*(5+C), H, W] with anchor boxes; loss = λ_coord·(xy + √wh) +
+confidence (IOU target, λ_noobj on empty cells) + per-cell class
+cross-entropy.  Here layout is NHWC: [mb, H, W, B*(5+C)], labels
+[mb, H, W, 4 + C_onehot + objmask] simplified to the canonical YOLOv2
+target encoding below.
+
+Label format accepted: ``labels`` dict with
+  "boxes":  [mb, H, W, B, 4]  target (tx, ty, tw, th) in cell coords
+  "obj":    [mb, H, W, B]     1 where an object is assigned to anchor b
+  "cls":    [mb, H, W, C]     one-hot class per cell
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..conf.inputs import InputType
+from .base import ForwardOut, Layer, register_layer
+
+Array = jax.Array
+
+
+@register_layer
+@dataclasses.dataclass
+class Yolo2OutputLayer(Layer):
+    anchors: List[List[float]] = dataclasses.field(
+        default_factory=lambda: [[1.0, 1.0], [2.0, 2.0]])
+    n_classes: int = 20
+    lambda_coord: float = 5.0
+    lambda_noobj: float = 0.5
+
+    def has_params(self) -> bool:
+        return False
+
+    def output_type(self, in_type: InputType) -> InputType:
+        return in_type
+
+    @property
+    def n_boxes(self) -> int:
+        return len(self.anchors)
+
+    def _split(self, x):
+        b, c = self.n_boxes, self.n_classes
+        mb, h, w, _ = x.shape
+        x = x.reshape(mb, h, w, b, 5 + c)
+        txy = jax.nn.sigmoid(x[..., 0:2])
+        twh = x[..., 2:4]
+        conf = jax.nn.sigmoid(x[..., 4])
+        cls_logits = x[..., 5:]
+        return txy, twh, conf, cls_logits
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        return ForwardOut(x, state, mask)
+
+    def score(self, params, state, x, labels, *, mask: Optional[Array] = None) -> Array:
+        txy, twh, conf, cls_logits = self._split(x)
+        boxes, obj, cls = labels["boxes"], labels["obj"], labels["cls"]
+        obj = obj.astype(x.dtype)
+        # coordinate loss (λ_coord, √wh per YOLOv2 paper / reference impl)
+        xy_loss = jnp.sum(obj[..., None] * (txy - boxes[..., 0:2]) ** 2, axis=-1)
+        anchors = jnp.asarray(self.anchors, x.dtype)  # [B,2]
+        pred_wh = jnp.exp(jnp.clip(twh, -10, 10)) * anchors
+        true_wh = jnp.exp(jnp.clip(boxes[..., 2:4], -10, 10)) * anchors
+        wh_loss = jnp.sum(obj[..., None] * (jnp.sqrt(pred_wh + 1e-8) - jnp.sqrt(true_wh + 1e-8)) ** 2, axis=-1)
+        coord = self.lambda_coord * (xy_loss + wh_loss)
+        # confidence: target 1 for assigned anchors, 0 elsewhere (λ_noobj)
+        conf_loss = obj * (conf - 1.0) ** 2 + self.lambda_noobj * (1 - obj) * conf ** 2
+        # per-anchor class cross-entropy, counted for each responsible anchor
+        # (YOLOv2: every assigned predictor predicts the cell's class)
+        logp = jax.nn.log_softmax(cls_logits, axis=-1)          # [mb,h,w,B,C]
+        cls_loss = -jnp.sum(cls[..., None, :] * logp, axis=-1)  # [mb,h,w,B]
+        per_cell = jnp.sum(coord + conf_loss, axis=-1) + jnp.sum(cls_loss * obj, axis=-1)
+        per_example = jnp.sum(per_cell, axis=(1, 2))
+        return jnp.mean(per_example)
+
+    def decode_predictions(self, x, conf_threshold: float = 0.5):
+        """Post-process to (boxes, confidences, class probabilities) — the
+        reference's getPredictedObjects equivalent, vectorized."""
+        txy, twh, conf, cls_logits = self._split(x)
+        mb, h, w = conf.shape[:3]
+        gy = jnp.arange(h, dtype=x.dtype)[None, :, None, None]
+        gx = jnp.arange(w, dtype=x.dtype)[None, None, :, None]
+        cx = (txy[..., 0] + gx) / w
+        cy = (txy[..., 1] + gy) / h
+        anchors = jnp.asarray(self.anchors, x.dtype)
+        wh = jnp.exp(jnp.clip(twh, -10, 10)) * anchors / jnp.asarray([w, h], x.dtype)
+        probs = jax.nn.softmax(cls_logits, axis=-1)
+        return {
+            "cx": cx, "cy": cy, "w": wh[..., 0], "h": wh[..., 1],
+            "conf": conf, "class_probs": probs,
+            "detect": conf > conf_threshold,
+        }

@@ -1,0 +1,161 @@
+"""Pooling family — XLA reduce_window replaces the reference's
+SubsamplingLayer (nn/layers/convolution/subsampling/SubsamplingLayer.java and
+CudnnSubsamplingHelper).
+
+PoolingType parity (nn/conf/layers/PoolingType): MAX, AVG, SUM, PNORM.
+GlobalPoolingLayer parity (nn/layers/pooling/GlobalPoolingLayer.java):
+pools CNN [mb,h,w,c]→[mb,c] or RNN [mb,t,f]→[mb,f], honoring per-timestep
+masks via MaskedReductionUtil-equivalent masked reductions.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..conf.inputs import InputType
+from .base import ForwardOut, Layer, register_layer
+from .convolution import _conv_out_size, _pair, _padding
+
+Array = jax.Array
+
+
+def _pool2d(x: Array, kind: str, kernel, stride, padding: str, pnorm: int = 2) -> Array:
+    kh, kw = kernel
+    window = (1, kh, kw, 1)
+    strides = (1, stride[0], stride[1], 1)
+    # NOTE: init values must be Python scalars — jax pattern-matches
+    # reduce_window(max/add) to its differentiable primitives only then.
+    if kind == "max":
+        return lax.reduce_window(x, -jnp.inf, lax.max, window, strides, padding)
+    if kind in ("avg", "sum"):
+        y = lax.reduce_window(x, 0.0, lax.add, window, strides, padding)
+        if kind == "sum":
+            return y
+        if padding == "VALID":
+            return y / (kh * kw)
+        counts = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, window, strides, padding)
+        return y / counts
+    if kind == "pnorm":
+        p = float(pnorm)
+        y = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, window, strides, padding)
+        return y ** (1.0 / p)
+    raise ValueError(f"unknown pooling type {kind}")
+
+
+@register_layer
+@dataclasses.dataclass
+class Subsampling2D(Layer):
+    """2-D pooling (reference SubsamplingLayer conf)."""
+
+    pooling: str = "max"
+    kernel: Tuple[int, int] = (2, 2)
+    stride: Tuple[int, int] = (2, 2)
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+
+    def __post_init__(self):
+        self.kernel = _pair(self.kernel)
+        self.stride = _pair(self.stride)
+
+    def has_params(self) -> bool:
+        return False
+
+    def output_type(self, in_type: InputType) -> InputType:
+        h = _conv_out_size(in_type.height, self.kernel[0], self.stride[0], self.convolution_mode)
+        w = _conv_out_size(in_type.width, self.kernel[1], self.stride[1], self.convolution_mode)
+        return InputType.convolutional(h, w, in_type.channels)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        y = _pool2d(x, self.pooling, self.kernel, self.stride, _padding(self.convolution_mode), self.pnorm)
+        return ForwardOut(y, state, mask)
+
+
+@register_layer
+@dataclasses.dataclass
+class Subsampling1D(Layer):
+    """1-D pooling over [mb, t, f] (reference Subsampling1DLayer)."""
+
+    pooling: str = "max"
+    kernel: int = 2
+    stride: int = 2
+    convolution_mode: str = "truncate"
+    pnorm: int = 2
+
+    def has_params(self) -> bool:
+        return False
+
+    def output_type(self, in_type: InputType) -> InputType:
+        t = in_type.timesteps
+        if t is not None:
+            t = _conv_out_size(t, self.kernel, self.stride, self.convolution_mode)
+        return InputType.recurrent(in_type.size, t)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        x4 = x[:, :, None, :]  # [mb, t, 1, f]
+        y = _pool2d(x4, self.pooling, (self.kernel, 1), (self.stride, 1),
+                    _padding(self.convolution_mode), self.pnorm)
+        return ForwardOut(y[:, :, 0, :], state, mask)
+
+
+@register_layer
+@dataclasses.dataclass
+class GlobalPooling(Layer):
+    """Global pooling over spatial/time dims with mask support
+    (reference GlobalPoolingLayer + MaskedReductionUtil)."""
+
+    pooling: str = "max"
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+
+    def has_params(self) -> bool:
+        return False
+
+    def output_type(self, in_type: InputType) -> InputType:
+        if in_type.kind == "rnn":
+            return InputType.feed_forward(in_type.size)
+        if in_type.kind == "cnn":
+            return InputType.feed_forward(in_type.channels)
+        return in_type
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        if x.ndim == 4:        # [mb, h, w, c] → [mb, c]
+            axes = (1, 2)
+            m = None
+        elif x.ndim == 3:      # [mb, t, f] → [mb, f]
+            axes = (1,)
+            m = mask            # [mb, t]
+        else:
+            raise ValueError(f"GlobalPooling expects rank 3/4, got {x.shape}")
+
+        if m is not None:
+            mx = m[..., None].astype(x.dtype)
+            if self.pooling == "max":
+                y = jnp.max(jnp.where(mx > 0, x, -jnp.inf), axis=axes)
+            elif self.pooling == "sum":
+                y = jnp.sum(x * mx, axis=axes)
+            elif self.pooling == "avg":
+                y = jnp.sum(x * mx, axis=axes) / jnp.maximum(jnp.sum(mx, axis=axes), 1.0)
+            elif self.pooling == "pnorm":
+                p = float(self.pnorm)
+                y = jnp.sum((jnp.abs(x) * mx) ** p, axis=axes) ** (1.0 / p)
+            else:
+                raise ValueError(self.pooling)
+        else:
+            if self.pooling == "max":
+                y = jnp.max(x, axis=axes)
+            elif self.pooling == "sum":
+                y = jnp.sum(x, axis=axes)
+            elif self.pooling == "avg":
+                y = jnp.mean(x, axis=axes)
+            elif self.pooling == "pnorm":
+                p = float(self.pnorm)
+                y = jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1.0 / p)
+            else:
+                raise ValueError(self.pooling)
+        # mask is consumed by the reduction (reference: GlobalPooling clears it)
+        return ForwardOut(y, state, None)

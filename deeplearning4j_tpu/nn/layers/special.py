@@ -1,0 +1,91 @@
+"""Special wrappers: FrozenLayer, CenterLossOutputLayer.
+
+FrozenLayer — reference nn/layers/FrozenLayer.java (+ misc/FrozenLayer
+conf): wraps any layer; params take no gradient.  Implemented with
+``lax.stop_gradient`` on the inner params — the optimizer never sees
+nonzero gradients, matching the reference's zero-filled gradient view.
+
+CenterLossOutputLayer — reference nn/conf/layers/CenterLossOutputLayer.java:
+softmax head + λ·‖f(x) − c_y‖² with per-class centers updated by moving
+average (alpha); centers live in layer state, not params.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...ops.losses import get_loss
+from ..conf.inputs import InputType
+from .base import ForwardOut, Layer, register_layer
+from .feedforward import Dense
+
+Array = jax.Array
+
+
+@register_layer
+@dataclasses.dataclass
+class FrozenLayer(Layer):
+    layer: Optional[Layer] = None
+
+    def infer_nin(self, in_type: InputType) -> None:
+        self.layer.infer_nin(in_type)
+
+    def output_type(self, in_type: InputType) -> InputType:
+        return self.layer.output_type(in_type)
+
+    def init_params(self, rng, in_type, dtype=jnp.float32) -> Dict[str, Array]:
+        return self.layer.init_params(rng, in_type, dtype)
+
+    def init_state(self, in_type, dtype=jnp.float32) -> Dict[str, Array]:
+        return self.layer.init_state(in_type, dtype)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        frozen = jax.tree_util.tree_map(lax.stop_gradient, params)
+        # train=False inside: frozen layers run in inference mode (reference
+        # FrozenLayer forces test-time behavior for dropout etc.)
+        return self.layer.forward(frozen, state, x, train=False, rng=rng, mask=mask)
+
+    def regularization_score(self, params):
+        return jnp.zeros((), jnp.float32)
+
+    def has_params(self) -> bool:
+        return self.layer.has_params()
+
+
+@register_layer
+@dataclasses.dataclass
+class CenterLossOutputLayer(Dense):
+    """Softmax + center loss (Wen et al. 2016), reference
+    CenterLossOutputLayer: gradient check suite CNNGradientCheckTest covers
+    it via lambda/alpha hyperparams."""
+
+    loss: str = "mcxent"
+    alpha: float = 0.05
+    lambda_: float = 2e-4
+
+    def init_state(self, in_type, dtype=jnp.float32) -> Dict[str, Array]:
+        return {"centers": jnp.zeros((self.n_out, self.n_in), dtype)}
+
+    def score(self, params, state, x, labels, *, mask: Optional[Array] = None) -> Array:
+        pre = x @ params["W"].astype(x.dtype)
+        if self.has_bias:
+            pre = pre + params["b"].astype(x.dtype)
+        base = get_loss(self.loss)(labels, pre, self.activation or "identity", mask)
+        centers = state["centers"].astype(x.dtype)           # [C, n_in]
+        assigned = labels @ centers                           # [mb, n_in]
+        center_term = 0.5 * self.lambda_ * jnp.mean(jnp.sum((x - assigned) ** 2, axis=-1))
+        return base + center_term
+
+    def update_centers(self, state, x, labels) -> Dict[str, Array]:
+        """Moving-average center update (runs outside the gradient path)."""
+        centers = state["centers"]
+        counts = jnp.sum(labels, axis=0)[:, None]            # [C,1]
+        sums = labels.T @ x.astype(centers.dtype)            # [C, n_in]
+        means = sums / jnp.maximum(counts, 1.0)
+        upd = jnp.where(counts > 0, centers + self.alpha * (means - centers), centers)
+        return {"centers": upd}

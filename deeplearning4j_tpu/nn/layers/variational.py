@@ -1,0 +1,130 @@
+"""Variational autoencoder layer.
+
+Reference: nn/conf/layers/variational/VariationalAutoencoder.java +
+nn/layers/variational/VariationalAutoencoder.java (1,163 LoC): MLP encoder →
+Gaussian q(z|x) → MLP decoder → reconstruction distribution
+(Bernoulli or Gaussian); ELBO = E[log p(x|z)] - KL(q||p).  When stacked in a
+network, ``forward`` emits the q(z|x) mean (matching the reference's
+activate() in supervised mode); ``elbo_score`` is the pretrain objective.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.activations import get_activation
+from ...ops.initializers import init_weight
+from ..conf.inputs import InputType
+from .base import ForwardOut, Layer, register_layer
+
+Array = jax.Array
+
+
+@register_layer
+@dataclasses.dataclass
+class VariationalAutoencoder(Layer):
+    n_in: int = 0
+    n_out: int = 0                       # latent size (reference nOut = nLatent)
+    encoder_layer_sizes: List[int] = dataclasses.field(default_factory=lambda: [256])
+    decoder_layer_sizes: List[int] = dataclasses.field(default_factory=lambda: [256])
+    activation: str = "leakyrelu"        # hidden activation (reference pzxActivationFn separate)
+    pzx_activation: str = "identity"
+    reconstruction: str = "bernoulli"    # or "gaussian"
+    num_samples: int = 1
+
+    def infer_nin(self, in_type: InputType) -> None:
+        if self.n_in == 0:
+            self.n_in = in_type.flat_size()
+
+    def output_type(self, in_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def _mlp_init(self, rng, sizes, dtype):
+        params = []
+        keys = jax.random.split(rng, len(sizes) - 1)
+        for k, (a, b) in zip(keys, zip(sizes[:-1], sizes[1:])):
+            params.append({
+                "W": init_weight(k, (a, b), self._winit(), a, b, dtype),
+                "b": jnp.zeros((b,), dtype),
+            })
+        return params
+
+    def init_params(self, rng, in_type, dtype=jnp.float32) -> Dict:
+        ke, km, kv, kd, ko = jax.random.split(rng, 5)
+        enc_sizes = [self.n_in] + list(self.encoder_layer_sizes)
+        dec_sizes = [self.n_out] + list(self.decoder_layer_sizes)
+        eh = self.encoder_layer_sizes[-1]
+        dh = self.decoder_layer_sizes[-1]
+        out_size = self.n_in * (2 if self.reconstruction == "gaussian" else 1)
+        return {
+            "enc": self._mlp_init(ke, enc_sizes, dtype),
+            "z_mean": {"W": init_weight(km, (eh, self.n_out), self._winit(), eh, self.n_out, dtype),
+                       "b": jnp.zeros((self.n_out,), dtype)},
+            "z_logvar": {"W": init_weight(kv, (eh, self.n_out), self._winit(), eh, self.n_out, dtype),
+                         "b": jnp.zeros((self.n_out,), dtype)},
+            "dec": self._mlp_init(kd, dec_sizes, dtype),
+            "out": {"W": init_weight(ko, (dh, out_size), self._winit(), dh, out_size, dtype),
+                    "b": jnp.zeros((out_size,), dtype)},
+        }
+
+    def _mlp(self, layers, x):
+        act = get_activation(self.activation)
+        for p in layers:
+            x = act(x @ p["W"].astype(x.dtype) + p["b"].astype(x.dtype))
+        return x
+
+    def encode(self, params, x):
+        h = self._mlp(params["enc"], x)
+        pzx = get_activation(self.pzx_activation)
+        mean = pzx(h @ params["z_mean"]["W"].astype(x.dtype) + params["z_mean"]["b"].astype(x.dtype))
+        logvar = h @ params["z_logvar"]["W"].astype(x.dtype) + params["z_logvar"]["b"].astype(x.dtype)
+        return mean, logvar
+
+    def decode(self, params, z):
+        h = self._mlp(params["dec"], z)
+        return h @ params["out"]["W"].astype(z.dtype) + params["out"]["b"].astype(z.dtype)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        x = x.reshape((x.shape[0], -1))
+        mean, _ = self.encode(params, x)
+        return ForwardOut(mean, state, mask)
+
+    def elbo_score(self, params, x, *, rng, num_samples: Optional[int] = None) -> Array:
+        """Negative ELBO (to minimize), mean over minibatch."""
+        x = x.reshape((x.shape[0], -1))
+        mean, logvar = self.encode(params, x)
+        ns = num_samples or self.num_samples
+        keys = jax.random.split(rng, ns)
+
+        def one_sample(k):
+            eps = jax.random.normal(k, mean.shape, mean.dtype)
+            z = mean + jnp.exp(0.5 * logvar) * eps
+            out = self.decode(params, z)
+            if self.reconstruction == "bernoulli":
+                # stable BCE from logits
+                ll = -(jnp.maximum(out, 0) - out * x + jnp.log1p(jnp.exp(-jnp.abs(out))))
+                return jnp.sum(ll, axis=-1)
+            mu, lv = out[:, :self.n_in], out[:, self.n_in:]
+            ll = -0.5 * (lv + jnp.log(2 * jnp.pi) + (x - mu) ** 2 / jnp.exp(lv))
+            return jnp.sum(ll, axis=-1)
+
+        recon_ll = jnp.mean(jnp.stack([one_sample(k) for k in keys]), axis=0)
+        kl = -0.5 * jnp.sum(1 + logvar - mean ** 2 - jnp.exp(logvar), axis=-1)
+        return jnp.mean(kl - recon_ll)
+
+    def reconstruction_score(self, params, x, *, rng=None, train=False) -> Array:
+        if rng is None:
+            rng = jax.random.PRNGKey(0)
+        return self.elbo_score(params, x, rng=rng)
+
+    def generate(self, params, z):
+        """Decode latent samples to reconstruction-distribution params
+        (reference generateAtMeanGivenZ)."""
+        out = self.decode(params, z)
+        if self.reconstruction == "bernoulli":
+            return jax.nn.sigmoid(out)
+        return out[:, :self.n_in]

@@ -1,0 +1,531 @@
+"""MultiLayerNetwork — the sequential-stack model container.
+
+Parity target: reference nn/multilayer/MultiLayerNetwork.java (3,177 LoC):
+``init():545`` (param flattening), ``fit(DataSetIterator):1165``,
+``backprop():1260``, ``output():1867``, score accumulation, masking, and the
+Solver/updater wiring (optimize/solvers/StochasticGradientDescent.java:58).
+
+Design inversion (SURVEY.md §7): instead of the reference's eager per-op
+forward + hand-written ``calcBackpropGradients`` loop + mutable flat param
+buffer, the entire step — forward, loss, backward (jax.grad), gradient
+normalization (preApply parity), per-layer updater math, and the parameter
+update — is ONE jit-compiled XLA program.  Params/state/opt-state are
+pytrees (list of per-layer dicts, keys matching the reference's param names
+"W"/"b"/"RW"/"gamma"/...); donation avoids double-buffering params in HBM.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..datasets.dataset import DataSet
+from ..datasets.iterators import DataSetIterator, ListDataSetIterator
+from .conf.inputs import InputType
+from .conf.preprocessors import Preprocessor
+from .layers.base import Layer, config_from_dict, config_to_dict
+from .updaters import Adam, GradientNormalization, Updater, normalize_gradients
+
+Array = jax.Array
+
+
+@dataclasses.dataclass
+class MultiLayerConfiguration:
+    """Configs-as-data for a sequential net (reference
+    MultiLayerConfiguration + per-layer NeuralNetConfiguration).  JSON
+    round-trip via ``to_dict``/``from_dict`` is the serialization contract
+    that checkpointing, transfer learning, and the zoo build on (reference
+    nn/conf/serde/)."""
+
+    layers: List[Layer] = dataclasses.field(default_factory=list)
+    input_type: Optional[InputType] = None
+    preprocessors: Dict[int, Preprocessor] = dataclasses.field(default_factory=dict)
+    updater: Updater = dataclasses.field(default_factory=Adam)
+    gradient_normalization: str = GradientNormalization.NONE
+    gradient_normalization_threshold: float = 1.0
+    seed: int = 12345
+    param_dtype: str = "float32"
+    compute_dtype: str = "float32"
+    backprop_type: str = "standard"       # or "tbptt"
+    tbptt_length: int = 20
+
+    def to_dict(self) -> dict:
+        d = config_to_dict(self)
+        d["type"] = "MultiLayerConfiguration"
+        d["preprocessors"] = {str(k): config_to_dict(v) for k, v in self.preprocessors.items()}
+        d["input_type"] = None if self.input_type is None else self.input_type.to_dict()
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "MultiLayerConfiguration":
+        d = dict(d)
+        d.pop("type", None)
+        pre = {int(k): config_from_dict(v) for k, v in (d.pop("preprocessors") or {}).items()}
+        it = d.pop("input_type")
+        conf = MultiLayerConfiguration(
+            layers=[config_from_dict(l) for l in d.pop("layers")],
+            input_type=None if it is None else InputType.from_dict(it),
+            preprocessors=pre,
+            updater=config_from_dict(d.pop("updater")),
+            **{k: v for k, v in d.items()},
+        )
+        return conf
+
+
+class ListBuilder:
+    """Fluent builder parity with NeuralNetConfiguration.Builder().list()
+    (reference NeuralNetConfiguration.java:206-303)."""
+
+    def __init__(self, **defaults):
+        self._conf = MultiLayerConfiguration()
+        self._defaults = defaults
+
+    def seed(self, s: int) -> "ListBuilder":
+        self._conf.seed = s
+        return self
+
+    def updater(self, u: Updater) -> "ListBuilder":
+        self._conf.updater = u
+        return self
+
+    def gradient_normalization(self, mode: str, threshold: float = 1.0) -> "ListBuilder":
+        self._conf.gradient_normalization = mode
+        self._conf.gradient_normalization_threshold = threshold
+        return self
+
+    def layer(self, layer: Layer) -> "ListBuilder":
+        for k, v in self._defaults.items():
+            # apply builder-level defaults to layers that kept dataclass defaults
+            if hasattr(layer, k) and getattr(layer, k) == type(layer).__dataclass_fields__[k].default:
+                setattr(layer, k, v)
+        self._conf.layers.append(layer)
+        return self
+
+    def preprocessor(self, index: int, pre: Preprocessor) -> "ListBuilder":
+        self._conf.preprocessors[index] = pre
+        return self
+
+    def set_input_type(self, t: InputType) -> "ListBuilder":
+        self._conf.input_type = t
+        return self
+
+    def tbptt(self, length: int) -> "ListBuilder":
+        self._conf.backprop_type = "tbptt"
+        self._conf.tbptt_length = length
+        return self
+
+    def dtype(self, param_dtype: str = "float32", compute_dtype: str = "float32") -> "ListBuilder":
+        self._conf.param_dtype = param_dtype
+        self._conf.compute_dtype = compute_dtype
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        return self._conf
+
+
+class NeuralNetConfiguration:
+    """Entry point mirroring the reference's builder DSL."""
+
+    @staticmethod
+    def builder(**defaults) -> ListBuilder:
+        return ListBuilder(**defaults)
+
+
+class MultiLayerNetwork:
+    """Sequential model: init / fit / output / score / evaluate.
+
+    Functional core, stateful shell: ``params``/``state``/``opt_state`` live
+    on the object for the user-facing API (like the reference's mutable
+    model), but every computation runs through pure jit'd functions.
+    """
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.params: List[Dict[str, Array]] = []
+        self.state: List[Dict[str, Array]] = []
+        self.opt_state: List[Dict] = []
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: List[Any] = []
+        self.input_types: List[InputType] = []
+        self._jit_step = None
+        self._jit_step_tbptt = None
+        self._jit_output = None
+        self._jit_score = None
+        self._jit_stream = None
+        self._stream_carries = None
+        self._rng = jax.random.PRNGKey(conf.seed)
+        self._infer_types()
+
+    # ------------------------------------------------------------------
+    # shape inference + init
+    # ------------------------------------------------------------------
+
+    def _infer_types(self) -> None:
+        """Propagate InputType through preprocessors+layers, auto-inserting
+        shape adapters where the layer's expected kind mismatches (the
+        reference's setInputType + getPreProcessorForInputType pass)."""
+        from .conf.preprocessors import CnnToFeedForward, CnnToRnn, FeedForwardToCnn
+        self.input_types = []
+        t = self.conf.input_type
+        if t is None:
+            return
+        for i, layer in enumerate(self.conf.layers):
+            if i in self.conf.preprocessors:
+                t = self.conf.preprocessors[i].output_type(t)
+            elif layer.wants is not None and t.kind != layer.wants:
+                pre = None
+                if t.kind == "cnn" and layer.wants == "ff":
+                    pre = CnnToFeedForward()
+                elif t.kind == "cnn_flat" and layer.wants == "cnn":
+                    pre = FeedForwardToCnn(t.height, t.width, t.channels)
+                elif t.kind == "cnn_flat" and layer.wants == "ff":
+                    t = InputType.feed_forward(t.flat_size())
+                elif t.kind == "cnn" and layer.wants == "rnn":
+                    pre = CnnToRnn()
+                elif t.kind == "rnn" and layer.wants == "ff":
+                    pre = None  # Dense-family layers broadcast over time
+                if pre is not None:
+                    self.conf.preprocessors[i] = pre
+                    t = pre.output_type(t)
+            self.input_types.append(t)
+            layer.infer_nin(t)
+            t = layer.output_type(t)
+        self.output_type = t
+
+    def init(self, rng: Optional[Array] = None) -> None:
+        """Initialize params/state (reference init():545; param views become
+        per-layer dicts — no flat buffer needed, XLA fuses updates)."""
+        if not self.input_types:
+            raise ValueError("conf.input_type must be set before init() "
+                             "(or call set_input_type on the builder)")
+        rng = rng if rng is not None else self._rng
+        dtype = jnp.dtype(self.conf.param_dtype)
+        keys = jax.random.split(rng, len(self.conf.layers))
+        self.params, self.state, self.opt_state = [], [], []
+        for layer, k, t in zip(self.conf.layers, keys, self.input_types):
+            p = layer.init_params(k, t, dtype)
+            s = layer.init_state(t, dtype)
+            self.params.append(p)
+            self.state.append(s)
+            self.opt_state.append(self._updater_for(layer).init_state(p) if p else {})
+        self.iteration = 0
+
+    def _updater_for(self, layer: Layer) -> Updater:
+        return layer.updater if layer.updater is not None else self.conf.updater
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(x.shape)) for p in self.params for x in jax.tree_util.tree_leaves(p))
+
+    # ------------------------------------------------------------------
+    # pure forward / loss
+    # ------------------------------------------------------------------
+
+    def _apply_layers(self, params, state, x, *, train: bool, rng, mask,
+                      upto: Optional[int] = None, carries=None):
+        """Run layers [0, upto) returning (y, new_state, mask, activations,
+        new_carries).
+
+        ``upto=None`` runs all layers.  The activations list is the
+        feedForwardToLayer capture (reference MultiLayerNetwork.java:893) —
+        under jit, unused entries are DCE'd so capture is free unless used.
+        ``carries`` (list per layer or None) threads recurrent hidden state
+        for TBPTT / streaming (reference rnnActivateUsingStoredState).
+        """
+        n = len(self.conf.layers) if upto is None else upto
+        new_state = list(state)
+        new_carries = list(carries) if carries is not None else [None] * len(self.conf.layers)
+        acts: List[Array] = []
+        x = x.astype(jnp.dtype(self.conf.compute_dtype)) if jnp.issubdtype(x.dtype, jnp.floating) else x
+        keys = jax.random.split(rng, n) if (rng is not None and n > 0) else [None] * n
+        for i in range(n):
+            layer = self.conf.layers[i]
+            if i in self.conf.preprocessors:
+                x = self.conf.preprocessors[i].apply(x)
+            kwargs = {}
+            if layer.recurrent and carries is not None:
+                kwargs["carry"] = carries[i]
+            out = layer.forward(params[i], state[i], x, train=train, rng=keys[i],
+                                mask=mask, **kwargs)
+            x, mask = out.y, out.mask
+            new_state[i] = out.state
+            new_carries[i] = out.carry
+            acts.append(x)
+        return x, new_state, mask, acts, new_carries
+
+    def _loss(self, params, state, x, labels, *, train: bool, rng,
+              mask=None, label_mask=None, carries=None):
+        """Full score: output-layer loss + L1/L2 (reference computeGradientAndScore)."""
+        n = len(self.conf.layers)
+        h, new_state, mask_out, _, new_carries = self._apply_layers(
+            params, state, x, train=train, rng=rng, mask=mask, upto=n - 1, carries=carries)
+        last = self.conf.layers[n - 1]
+        if (n - 1) in self.conf.preprocessors:
+            h = self.conf.preprocessors[n - 1].apply(h)
+        if train and last.dropout > 0.0 and rng is not None:
+            # output layers honor input dropout too (reference BaseOutputLayer)
+            h = last._maybe_dropout(h, train, jax.random.fold_in(rng, n - 1))
+        lm = label_mask if label_mask is not None else (mask_out if labels is not None and getattr(labels, "ndim", 0) == 3 else None)
+        if not hasattr(last, "score"):
+            raise ValueError(f"last layer {type(last).__name__} has no score(); "
+                             "use OutputLayer/LossLayer/RnnOutputLayer")
+        loss = last.score(params[n - 1], state[n - 1], h, labels, mask=lm)
+        if train and hasattr(last, "update_centers"):
+            # center-loss moving-average update rides the state path
+            new_state[n - 1] = last.update_centers(
+                state[n - 1], jax.lax.stop_gradient(h), jax.lax.stop_gradient(labels))
+        reg = jnp.zeros((), jnp.float32)
+        for layer, p in zip(self.conf.layers, params):
+            if p:
+                reg = reg + layer.regularization_score(p)
+        total = loss.astype(jnp.float32) + reg
+        if carries is not None:
+            return total, (new_state, new_carries)
+        return total, new_state
+
+    # ------------------------------------------------------------------
+    # train step (jit once, reuse across iterations)
+    # ------------------------------------------------------------------
+
+    def _apply_updates(self, grads, params, opt_state, itf):
+        """Shared updater application (the reference's BaseMultiLayerUpdater
+        update loop: preApply normalization + per-block updater math)."""
+        conf = self.conf
+        new_params, new_opt = [], []
+        for i, layer in enumerate(conf.layers):
+            g, p, os = grads[i], params[i], opt_state[i]
+            if not p:
+                new_params.append(p)
+                new_opt.append(os)
+                continue
+            if conf.gradient_normalization != GradientNormalization.NONE:
+                g = normalize_gradients(g, conf.gradient_normalization,
+                                        conf.gradient_normalization_threshold)
+            # L2/L1 gradient contribution comes via autodiff of the reg score.
+            updates, os2 = self._updater_for(layer).update(g, os, itf)
+            p2 = jax.tree_util.tree_map(
+                lambda pp, uu: (pp.astype(jnp.float32) - uu).astype(pp.dtype), p, updates)
+            new_params.append(p2)
+            new_opt.append(os2)
+        return new_params, new_opt
+
+    def _make_step(self):
+        def step(params, state, opt_state, it, x, labels, rng, mask, label_mask):
+            def loss_fn(p):
+                loss, new_state = self._loss(p, state, x, labels, train=True, rng=rng,
+                                             mask=mask, label_mask=label_mask)
+                return loss, new_state
+
+            (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            new_params, new_opt = self._apply_updates(grads, params, opt_state,
+                                                      it.astype(jnp.float32))
+            return new_params, new_state, new_opt, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _make_step_tbptt(self):
+        """TBPTT step: like _make_step but threads recurrent carries across
+        sequence chunks; truncation is automatic because each chunk is its
+        own value_and_grad (reference doTruncatedBPTT():1386)."""
+        def step(params, state, opt_state, it, x, labels, rng, mask, label_mask, carries):
+            def loss_fn(p):
+                loss, aux = self._loss(p, state, x, labels, train=True, rng=rng,
+                                       mask=mask, label_mask=label_mask, carries=carries)
+                return loss, aux
+
+            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opt = self._apply_updates(grads, params, opt_state,
+                                                      it.astype(jnp.float32))
+            return new_params, new_state, new_opt, new_carries, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def fit_batch(self, ds: DataSet) -> float:
+        """One optimization step on one minibatch (reference fit(DataSet))."""
+        if self.conf.backprop_type == "tbptt":
+            return self._fit_batch_tbptt(ds)
+        if self._jit_step is None:
+            self._jit_step = self._make_step()
+        self._rng, sub = jax.random.split(self._rng)
+        x = jnp.asarray(ds.features)
+        # labels may be a pytree (e.g. Yolo2OutputLayer's dict targets)
+        y = None if ds.labels is None else jax.tree_util.tree_map(jnp.asarray, ds.labels)
+        m = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+        lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        self.params, self.state, self.opt_state, loss = self._jit_step(
+            self.params, self.state, self.opt_state,
+            jnp.asarray(self.iteration, jnp.int32), x, y, sub, m, lm)
+        self.iteration += 1
+        loss_val = float(loss)
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, loss_val)
+        return loss_val
+
+    def _fit_batch_tbptt(self, ds: DataSet) -> float:
+        """Truncated BPTT: slice the time axis into tbptt_length chunks,
+        carry recurrent state forward between chunks, one optimizer step per
+        chunk (reference doTruncatedBPTT():1386 semantics)."""
+        if self._jit_step_tbptt is None:
+            self._jit_step_tbptt = self._make_step_tbptt()
+        x = np.asarray(ds.features)
+        y = None if ds.labels is None else np.asarray(ds.labels)
+        if x.ndim != 3 or (y is not None and y.ndim != 3):
+            raise ValueError("TBPTT requires [mb, time, features] inputs and "
+                             "[mb, time, classes] labels")
+        L = self.conf.tbptt_length
+        mb, T = x.shape[0], x.shape[1]
+        dtype = jnp.dtype(self.conf.compute_dtype)
+        carries = [l.init_carry(mb, dtype) if l.recurrent else None
+                   for l in self.conf.layers]
+        total, chunks = 0.0, 0
+        for s in range(0, T, L):
+            xs = jnp.asarray(x[:, s:s + L])
+            ys = None if y is None else jnp.asarray(y[:, s:s + L])
+            m = None if ds.features_mask is None else jnp.asarray(ds.features_mask[:, s:s + L])
+            lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask[:, s:s + L])
+            self._rng, sub = jax.random.split(self._rng)
+            self.params, self.state, self.opt_state, carries, loss = self._jit_step_tbptt(
+                self.params, self.state, self.opt_state,
+                jnp.asarray(self.iteration, jnp.int32), xs, ys, sub, m, lm, carries)
+            self.iteration += 1
+            total += float(loss)
+            chunks += 1
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration, float(loss))
+        return total / max(chunks, 1)
+
+    # ------------------------------------------------------------------
+    # streaming RNN inference (rnnTimeStep parity)
+    # ------------------------------------------------------------------
+
+    def rnn_time_step(self, x) -> np.ndarray:
+        """Stateful streaming inference: feeds [mb, f] (one step) or
+        [mb, t, f] and keeps hidden state across calls (reference
+        rnnTimeStep():2636)."""
+        xa = jnp.asarray(x)
+        squeeze = xa.ndim == 2
+        if squeeze:
+            xa = xa[:, None, :]
+        mb = xa.shape[0]
+        if self._stream_carries is not None:
+            for c in jax.tree_util.tree_leaves(self._stream_carries):
+                if c.shape[0] != mb:  # batch size changed → fresh state
+                    self._stream_carries = None
+                break
+        if self._stream_carries is None:
+            dtype = jnp.dtype(self.conf.compute_dtype)
+            self._stream_carries = [l.init_carry(mb, dtype) if l.recurrent else None
+                                    for l in self.conf.layers]
+        if self._jit_stream is None:
+            def fwd(params, state, xx, carries):
+                y, _, _, _, new_carries = self._apply_layers(
+                    params, state, xx, train=False, rng=None, mask=None, carries=carries)
+                return y, new_carries
+            self._jit_stream = jax.jit(fwd)
+        y, self._stream_carries = self._jit_stream(self.params, self.state, xa,
+                                                   self._stream_carries)
+        out = np.asarray(y)
+        return out[:, 0] if squeeze and out.ndim == 3 else out
+
+    def rnn_clear_previous_state(self) -> None:
+        """Reset streaming state (reference rnnClearPreviousState)."""
+        self._stream_carries = None
+
+    def fit(self, data, epochs: int = 1) -> List[float]:
+        """Train over a DataSetIterator / DataSet / (x, y) for N epochs
+        (reference fit(DataSetIterator):1165; async prefetch is the
+        iterator's job — wrap with AsyncDataSetIterator for parity)."""
+        it = self._as_iterator(data)
+        losses: List[float] = []
+        for _ in range(epochs):
+            for ds in it:
+                losses.append(self.fit_batch(ds))
+            self.epoch += 1
+            for lst in self.listeners:
+                if hasattr(lst, "epoch_done"):
+                    lst.epoch_done(self, self.epoch)
+        return losses
+
+    @staticmethod
+    def _as_iterator(data) -> DataSetIterator:
+        if isinstance(data, DataSetIterator):
+            return data
+        if isinstance(data, DataSet):
+            return ListDataSetIterator([data])
+        if isinstance(data, tuple) and len(data) == 2:
+            return ListDataSetIterator([DataSet(np.asarray(data[0]), np.asarray(data[1]))])
+        raise TypeError(f"cannot iterate {type(data)}")
+
+    # ------------------------------------------------------------------
+    # inference / scoring
+    # ------------------------------------------------------------------
+
+    def output(self, x, mask=None) -> np.ndarray:
+        """Inference activations of the last layer (reference output():1867)."""
+        if self._jit_output is None:
+            def fwd(params, state, xx, m):
+                y, _, _, _, _ = self._apply_layers(params, state, xx, train=False, rng=None, mask=m)
+                return y
+            self._jit_output = jax.jit(fwd)
+        y = self._jit_output(self.params, self.state, jnp.asarray(x),
+                             None if mask is None else jnp.asarray(mask))
+        return np.asarray(y)
+
+    def feed_forward(self, x, train: bool = False) -> List[np.ndarray]:
+        """All layer activations (reference feedForward(); activation-capture
+        mode for transfer learning / debugging)."""
+        _, _, _, acts, _ = self._apply_layers(self.params, self.state, jnp.asarray(x),
+                                              train=train, rng=None, mask=None)
+        return [np.asarray(a) for a in acts]
+
+    def score(self, ds: DataSet) -> float:
+        """Loss on a DataSet without updating (reference score(DataSet))."""
+        if self._jit_score is None:
+            def score_fn(params, state, x, y, m, lm):
+                loss, _ = self._loss(params, state, x, y, train=False, rng=None,
+                                     mask=m, label_mask=lm)
+                return loss
+            self._jit_score = jax.jit(score_fn)
+        loss = self._jit_score(
+            self.params, self.state, jnp.asarray(ds.features),
+            None if ds.labels is None else jax.tree_util.tree_map(jnp.asarray, ds.labels),
+            None if ds.features_mask is None else jnp.asarray(ds.features_mask),
+            None if ds.labels_mask is None else jnp.asarray(ds.labels_mask))
+        return float(loss)
+
+    def evaluate(self, data, evaluation=None):
+        """Accumulate classification metrics over an iterator (reference
+        MultiLayerNetwork.evaluate → eval/Evaluation)."""
+        from ..evaluation.evaluation import Evaluation
+        ev = evaluation if evaluation is not None else Evaluation()
+        for ds in self._as_iterator(data):
+            out = self.output(ds.features, mask=ds.features_mask)
+            ev.eval(ds.labels, out, mask=ds.labels_mask)
+        return ev
+
+    # ------------------------------------------------------------------
+    # listeners / serde
+    # ------------------------------------------------------------------
+
+    def set_listeners(self, *listeners) -> None:
+        self.listeners = list(listeners)
+
+    def add_listener(self, listener) -> None:
+        self.listeners.append(listener)
+
+    def clone_params(self):
+        return jax.tree_util.tree_map(lambda a: a, self.params)
+
+    def save(self, path: str, save_updater: bool = True) -> None:
+        from ..utils.serializer import save_model
+        save_model(self, path, save_updater=save_updater)
+
+    @staticmethod
+    def load(path: str, load_updater: bool = True) -> "MultiLayerNetwork":
+        from ..utils.serializer import load_model
+        return load_model(path, load_updater=load_updater)

@@ -1,0 +1,11 @@
+"""Tensor substrate (L0): the seam where ND4J is replaced by jax.numpy/XLA.
+
+Reference boundary: every DL4J op crosses `Nd4j.getExecutioner().exec(...)`
+into libnd4j C++/CUDA (SURVEY.md §1 L0).  Here the substrate is jax.numpy;
+ops are traced and fused by XLA rather than dispatched eagerly.
+"""
+
+from .dtypes import DTypePolicy, default_policy, canonical_dtype
+from .activations import Activation, get_activation
+from .initializers import WeightInit, init_weight
+from .losses import Loss, get_loss

@@ -1,0 +1,141 @@
+"""Activation functions — parity surface for ND4J's ``IActivation`` registry.
+
+The reference selects activations by enum/string on each layer config
+(reference nn/conf/layers via `Activation.fromString`; impls live in ND4J
+``org.nd4j.linalg.activations.impl``).  Here each activation is a pure
+jax.numpy function; the backward pass comes from autodiff instead of the
+hand-written ``backprop(in, epsilon)`` each ND4J activation implements.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+Activation = Callable[[Array], Array]
+
+
+def identity(x: Array) -> Array:
+    return x
+
+
+def relu(x: Array) -> Array:
+    return jnp.maximum(x, 0)
+
+
+def relu6(x: Array) -> Array:
+    return jnp.clip(x, 0, 6)
+
+
+def leakyrelu(x: Array, alpha: float = 0.01) -> Array:
+    return jnp.where(x >= 0, x, alpha * x)
+
+
+def elu(x: Array, alpha: float = 1.0) -> Array:
+    return jnp.where(x > 0, x, alpha * jnp.expm1(x))
+
+
+def selu(x: Array) -> Array:
+    return jax.nn.selu(x)
+
+
+def gelu(x: Array) -> Array:
+    return jax.nn.gelu(x)
+
+
+def sigmoid(x: Array) -> Array:
+    return jax.nn.sigmoid(x)
+
+
+def hardsigmoid(x: Array) -> Array:
+    return jnp.clip(0.2 * x + 0.5, 0.0, 1.0)
+
+
+def tanh(x: Array) -> Array:
+    return jnp.tanh(x)
+
+
+def hardtanh(x: Array) -> Array:
+    return jnp.clip(x, -1.0, 1.0)
+
+
+def rationaltanh(x: Array) -> Array:
+    # ND4J RationalTanh: 1.7159 * tanh_approx(2x/3) via Pade-like rational
+    # approximation f(x) = clip(x*(36x^2+49)/(x^2(12x^2+49)+49)) scaled.
+    a = x * (2.0 / 3.0)
+    tanh_a = jnp.sign(a) * (1.0 - 1.0 / (1.0 + jnp.abs(a) + a * a + 1.41645 * a * a * a * a))
+    return 1.7159 * tanh_a
+
+
+def rectifiedtanh(x: Array) -> Array:
+    return jnp.maximum(0.0, jnp.tanh(x))
+
+
+def softplus(x: Array) -> Array:
+    return jax.nn.softplus(x)
+
+
+def softsign(x: Array) -> Array:
+    return jax.nn.soft_sign(x)
+
+
+def softmax(x: Array) -> Array:
+    return jax.nn.softmax(x, axis=-1)
+
+
+def logsoftmax(x: Array) -> Array:
+    return jax.nn.log_softmax(x, axis=-1)
+
+
+def cube(x: Array) -> Array:
+    return x * x * x
+
+
+def swish(x: Array) -> Array:
+    return x * jax.nn.sigmoid(x)
+
+
+def mish(x: Array) -> Array:
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+_REGISTRY: Dict[str, Activation] = {
+    "identity": identity,
+    "linear": identity,
+    "relu": relu,
+    "relu6": relu6,
+    "leakyrelu": leakyrelu,
+    "elu": elu,
+    "selu": selu,
+    "gelu": gelu,
+    "sigmoid": sigmoid,
+    "hardsigmoid": hardsigmoid,
+    "tanh": tanh,
+    "hardtanh": hardtanh,
+    "rationaltanh": rationaltanh,
+    "rectifiedtanh": rectifiedtanh,
+    "softplus": softplus,
+    "softsign": softsign,
+    "softmax": softmax,
+    "logsoftmax": logsoftmax,
+    "cube": cube,
+    "swish": swish,
+    "mish": mish,
+}
+
+
+def get_activation(name: str | Activation) -> Activation:
+    """Resolve an activation by name (case-insensitive, DL4J enum style)."""
+    if callable(name):
+        return name
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown activation '{name}'. Known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def activation_names() -> list[str]:
+    return sorted(_REGISTRY)

@@ -1,0 +1,63 @@
+"""Dtype policy for TPU-efficient mixed precision.
+
+The reference runs float32 throughout (ND4J default dtype, set globally via
+`Nd4j.setDataType`); on TPU the MXU wants bfloat16 compute with float32
+accumulation/params.  A ``DTypePolicy`` carries the three dtypes every layer
+needs: parameter storage, compute, and output.  Tests use pure float32 (or
+float64 under ``jax.experimental.enable_x64``) so gradient checks against
+central differences stay meaningful (reference test strategy:
+gradientcheck/GradientCheckUtil.java:112).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+
+DTypeLike = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class DTypePolicy:
+    """Parameter / compute / output dtypes used by every layer.
+
+    ``param_dtype``   — dtype params are stored in (float32 by default).
+    ``compute_dtype`` — dtype activations/matmuls run in (bfloat16 on TPU).
+    ``output_dtype``  — dtype of loss/metrics accumulation (float32).
+    """
+
+    param_dtype: DTypeLike = jnp.float32
+    compute_dtype: DTypeLike = jnp.float32
+    output_dtype: DTypeLike = jnp.float32
+
+    def cast_to_compute(self, x):
+        return jnp.asarray(x, self.compute_dtype)
+
+    def cast_to_param(self, x):
+        return jnp.asarray(x, self.param_dtype)
+
+    def cast_to_output(self, x):
+        return jnp.asarray(x, self.output_dtype)
+
+
+_DEFAULT = DTypePolicy()
+_MIXED = DTypePolicy(compute_dtype=jnp.bfloat16)
+
+
+def default_policy() -> DTypePolicy:
+    """Full-precision policy (parity/testing)."""
+    return _DEFAULT
+
+
+def mixed_policy() -> DTypePolicy:
+    """bfloat16-compute policy for TPU throughput (MXU-native)."""
+    return _MIXED
+
+
+def canonical_dtype(name: str | DTypeLike) -> Any:
+    """Resolve a dtype from a JSON-friendly string name."""
+    if isinstance(name, str):
+        return jnp.dtype(name)
+    return jnp.dtype(name)

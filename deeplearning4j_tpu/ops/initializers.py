@@ -1,0 +1,102 @@
+"""Weight-init schemes — parity with DL4J's ``WeightInit`` enum.
+
+Reference: nn/weights/WeightInit.java + WeightInitUtil.java (scheme math).
+Fan-in/fan-out follow the reference convention: for a dense kernel
+``[n_in, n_out]`` fan_in = n_in, fan_out = n_out; for conv kernels fan
+includes the receptive-field size.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+class WeightInit:
+    ZERO = "zero"
+    ONES = "ones"
+    NORMAL = "normal"
+    UNIFORM = "uniform"
+    XAVIER = "xavier"
+    XAVIER_UNIFORM = "xavier_uniform"
+    XAVIER_FAN_IN = "xavier_fan_in"
+    RELU = "relu"
+    RELU_UNIFORM = "relu_uniform"
+    SIGMOID_UNIFORM = "sigmoid_uniform"
+    LECUN_NORMAL = "lecun_normal"
+    LECUN_UNIFORM = "lecun_uniform"
+    IDENTITY = "identity"
+    VAR_SCALING_NORMAL_FAN_IN = "var_scaling_normal_fan_in"
+    VAR_SCALING_NORMAL_FAN_OUT = "var_scaling_normal_fan_out"
+    VAR_SCALING_NORMAL_FAN_AVG = "var_scaling_normal_fan_avg"
+    VAR_SCALING_UNIFORM_FAN_IN = "var_scaling_uniform_fan_in"
+    VAR_SCALING_UNIFORM_FAN_OUT = "var_scaling_uniform_fan_out"
+    VAR_SCALING_UNIFORM_FAN_AVG = "var_scaling_uniform_fan_avg"
+
+
+def init_weight(
+    rng: jax.Array,
+    shape: Sequence[int],
+    scheme: str,
+    fan_in: float,
+    fan_out: float,
+    dtype=jnp.float32,
+) -> Array:
+    """Sample a weight tensor per the named scheme.
+
+    Scheme formulas mirror reference WeightInitUtil (e.g. XAVIER =
+    N(0, 2/(fan_in+fan_out)); RELU = N(0, 2/fan_in)).
+    """
+    scheme = scheme.lower()
+    shape = tuple(int(s) for s in shape)
+    fi, fo = max(fan_in, 1.0), max(fan_out, 1.0)
+
+    def normal(std):
+        return (jax.random.normal(rng, shape, dtype=jnp.float32) * std).astype(dtype)
+
+    def uniform(limit):
+        return jax.random.uniform(
+            rng, shape, minval=-limit, maxval=limit, dtype=jnp.float32
+        ).astype(dtype)
+
+    if scheme == WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if scheme == WeightInit.ONES:
+        return jnp.ones(shape, dtype)
+    if scheme == WeightInit.NORMAL:
+        return normal(1.0 / math.sqrt(fi))
+    if scheme == WeightInit.UNIFORM:
+        return uniform(1.0 / math.sqrt(fi))
+    if scheme == WeightInit.XAVIER:
+        return normal(math.sqrt(2.0 / (fi + fo)))
+    if scheme == WeightInit.XAVIER_UNIFORM:
+        return uniform(math.sqrt(6.0 / (fi + fo)))
+    if scheme == WeightInit.XAVIER_FAN_IN:
+        return normal(math.sqrt(1.0 / fi))
+    if scheme == WeightInit.RELU:
+        return normal(math.sqrt(2.0 / fi))
+    if scheme == WeightInit.RELU_UNIFORM:
+        return uniform(math.sqrt(6.0 / fi))
+    if scheme == WeightInit.SIGMOID_UNIFORM:
+        return uniform(4.0 * math.sqrt(6.0 / (fi + fo)))
+    if scheme == WeightInit.LECUN_NORMAL:
+        return normal(math.sqrt(1.0 / fi))
+    if scheme == WeightInit.LECUN_UNIFORM:
+        return uniform(math.sqrt(3.0 / fi))
+    if scheme == WeightInit.IDENTITY:
+        if len(shape) != 2 or shape[0] != shape[1]:
+            raise ValueError(f"IDENTITY init needs a square 2-D shape, got {shape}")
+        return jnp.eye(shape[0], dtype=dtype)
+    if scheme.startswith("var_scaling"):
+        fan = {"fan_in": fi, "fan_out": fo, "fan_avg": (fi + fo) / 2.0}[
+            scheme.rsplit("_", 2)[-2] + "_" + scheme.rsplit("_", 2)[-1]
+        ]
+        if "normal" in scheme:
+            return normal(math.sqrt(1.0 / fan))
+        return uniform(math.sqrt(3.0 / fan))
+    raise ValueError(f"Unknown weight init scheme '{scheme}'")

@@ -1,0 +1,201 @@
+"""Loss functions — parity with ND4J ``ILossFunction`` implementations.
+
+Reference: DL4J output layers hold an ``ILossFunction`` (LossFunctions enum:
+MCXENT, XENT, MSE, L1, L2, NEGATIVELOGLIKELIHOOD, HINGE, SQUARED_HINGE,
+KL_DIVERGENCE, POISSON, COSINE_PROXIMITY, MEAN_ABSOLUTE_PERCENTAGE_ERROR,
+MEAN_SQUARED_LOGARITHMIC_ERROR) whose ``computeGradient`` is hand-written.
+Here losses are pure functions of (labels, pre-activation output); gradients
+come from autodiff.  Softmax+MCXENT and sigmoid+XENT are computed in fused,
+numerically-stable log-space form — the reference relies on clipping
+(LossUtil) instead.
+
+Conventions (match the reference):
+  - per-example score = sum of per-element loss over feature axes
+  - network score = mean per-example score over the (masked) minibatch
+  - binary losses expect labels in {0,1}; hinge expects {-1,+1} internally
+    but accepts {0,1} and maps them (as LossHinge does).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .activations import get_activation
+
+Array = jax.Array
+_EPS = 1e-7
+
+
+def _activated(preout: Array, activation) -> Array:
+    return get_activation(activation)(preout)
+
+
+class Loss:
+    """A loss = per-element function + reduction, with optional fused paths.
+
+    ``per_example(labels, preout, activation, mask)`` returns a [batch] (or
+    [batch, time]) array of per-example scores; ``__call__`` reduces to the
+    mean scalar the way MultiLayerNetwork.score() does (reference
+    nn/multilayer/MultiLayerNetwork.java score accumulation).
+    """
+
+    def __init__(self, name: str, elementwise: Callable[[Array, Array], Array]):
+        self.name = name
+        self._elementwise = elementwise
+
+    def per_element(self, labels: Array, preout: Array, activation="identity") -> Array:
+        if self.name in ("mcxent", "negativeloglikelihood") and _act_name(activation) == "softmax":
+            logp = jax.nn.log_softmax(preout, axis=-1)
+            return -labels * logp
+        if self.name == "xent" and _act_name(activation) == "sigmoid":
+            # stable sigmoid BCE from logits
+            z, y = preout, labels
+            return jnp.maximum(z, 0) - z * y + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        out = _activated(preout, activation)
+        return self._elementwise(labels, out)
+
+    def per_example(
+        self,
+        labels: Array,
+        preout: Array,
+        activation="identity",
+        mask: Optional[Array] = None,
+    ) -> Array:
+        el = self.per_element(labels, preout, activation)
+        if mask is not None:
+            el = el * _broadcast_mask(mask, el.shape)
+        return jnp.sum(el, axis=-1)
+
+    def __call__(
+        self,
+        labels: Array,
+        preout: Array,
+        activation="identity",
+        mask: Optional[Array] = None,
+    ) -> Array:
+        """Reduce to the network score.  Mask shapes supported (reference
+        ILossFunction computeScore + MaskedReductionUtil semantics):
+          - mask.shape == per-example shape ([mb] or [mb, t]): average over
+            present entries only (per-timestep / per-example masking)
+          - mask.shape == labels.shape: per-output weighting; average over
+            entries with any unmasked output
+        """
+        pe = self.per_example(labels, preout, activation, mask)
+        if mask is not None:
+            if mask.shape == pe.shape:
+                present = mask
+            elif mask.shape == labels.shape:
+                present = (jnp.max(mask, axis=-1) > 0).astype(pe.dtype)
+            else:  # broadcastable per-example mask, e.g. [mb, 1]
+                present = jnp.broadcast_to(mask.reshape(mask.shape[: pe.ndim]), pe.shape)
+            return jnp.sum(pe) / jnp.maximum(jnp.sum(present), 1.0)
+        return jnp.mean(pe)
+
+
+def _act_name(activation) -> str:
+    return activation if isinstance(activation, str) else getattr(activation, "__name__", "")
+
+
+def _broadcast_mask(mask: Array, shape) -> Array:
+    m = mask
+    while m.ndim < len(shape):
+        m = m[..., None]
+    return jnp.broadcast_to(m, shape)
+
+
+def _mse(y, out):
+    d = out - y
+    return d * d
+
+
+def _l2(y, out):
+    d = out - y
+    return d * d
+
+
+def _l1(y, out):
+    return jnp.abs(out - y)
+
+
+def _mae(y, out):
+    return jnp.abs(out - y)
+
+
+def _xent(y, out):
+    out = jnp.clip(out, _EPS, 1.0 - _EPS)
+    return -(y * jnp.log(out) + (1.0 - y) * jnp.log1p(-out))
+
+
+def _mcxent(y, out):
+    return -y * jnp.log(jnp.clip(out, _EPS, 1.0))
+
+
+def _hinge(y, out):
+    yy = jnp.where(y > 0.5, 1.0, -1.0)
+    return jnp.maximum(0.0, 1.0 - yy * out)
+
+
+def _squared_hinge(y, out):
+    yy = jnp.where(y > 0.5, 1.0, -1.0)
+    h = jnp.maximum(0.0, 1.0 - yy * out)
+    return h * h
+
+
+def _kld(y, out):
+    yc = jnp.clip(y, _EPS, 1.0)
+    oc = jnp.clip(out, _EPS, 1.0)
+    return yc * (jnp.log(yc) - jnp.log(oc))
+
+
+def _poisson(y, out):
+    return out - y * jnp.log(jnp.clip(out, _EPS, None))
+
+
+def _cosine_proximity(y, out):
+    # summed over the feature axis downstream; spread the scalar across elements
+    yn = y / jnp.clip(jnp.linalg.norm(y, axis=-1, keepdims=True), _EPS)
+    on = out / jnp.clip(jnp.linalg.norm(out, axis=-1, keepdims=True), _EPS)
+    return -(yn * on)
+
+
+def _mape(y, out):
+    return 100.0 * jnp.abs((y - out) / jnp.clip(jnp.abs(y), _EPS))
+
+
+def _msle(y, out):
+    d = jnp.log1p(jnp.clip(out, -1 + _EPS, None)) - jnp.log1p(jnp.clip(y, -1 + _EPS, None))
+    return d * d
+
+
+_REGISTRY = {
+    "mse": Loss("mse", _mse),
+    "l2": Loss("l2", _l2),
+    "l1": Loss("l1", _l1),
+    "mae": Loss("mae", _mae),
+    "xent": Loss("xent", _xent),
+    "mcxent": Loss("mcxent", _mcxent),
+    "negativeloglikelihood": Loss("negativeloglikelihood", _mcxent),
+    "hinge": Loss("hinge", _hinge),
+    "squared_hinge": Loss("squared_hinge", _squared_hinge),
+    "kl_divergence": Loss("kl_divergence", _kld),
+    "poisson": Loss("poisson", _poisson),
+    "cosine_proximity": Loss("cosine_proximity", _cosine_proximity),
+    "mape": Loss("mape", _mape),
+    "msle": Loss("msle", _msle),
+}
+
+
+def get_loss(name) -> Loss:
+    if isinstance(name, Loss):
+        return name
+    key = name.lower()
+    if key not in _REGISTRY:
+        raise ValueError(f"Unknown loss '{name}'. Known: {sorted(_REGISTRY)}")
+    return _REGISTRY[key]
+
+
+def loss_names() -> list[str]:
+    return sorted(_REGISTRY)

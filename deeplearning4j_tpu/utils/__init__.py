@@ -1,0 +1,2 @@
+from .serializer import save_model, load_model
+from .gradient_check import check_gradients

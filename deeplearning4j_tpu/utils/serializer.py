@@ -1,0 +1,112 @@
+"""Model serialization — the checkpoint format.
+
+Parity target: reference util/ModelSerializer.java:37 — a single zip
+containing config JSON + flat params + updater state (``writeModel():52``,
+``restoreMultiLayerNetwork():137-296``, ``saveUpdater`` flag).  Here the zip
+holds:
+
+    configuration.json   — MultiLayerConfiguration.to_dict() JSON
+    meta.json            — {format_version, iteration, epoch, model_class}
+    params.npz           — entries "<layer_idx>/<param_name>"
+    state.npz            — non-trainable state (BN running stats, centers)
+    updater.npz          — optimizer state, "<layer_idx>/<slot>/<param_name>"
+
+Unlike the reference's single flat coefficient buffer, params stay named —
+robust to layout changes and directly shardable on restore.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _flatten_tree(tree, prefix="") -> Dict[str, np.ndarray]:
+    out: Dict[str, np.ndarray] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten_tree(v, f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten_tree(v, f"{prefix}{i}/"))
+    elif tree is None:
+        pass
+    else:
+        out[prefix[:-1]] = np.asarray(tree)
+    return out
+
+
+def _npz_bytes(arrays: Dict[str, np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
+
+
+def _load_npz(data: bytes) -> Dict[str, np.ndarray]:
+    with np.load(io.BytesIO(data)) as z:
+        return {k: z[k] for k in z.files}
+
+
+def _unflatten_into(template, flat: Dict[str, np.ndarray], prefix=""):
+    """Rebuild a pytree with the template's structure from name→array."""
+    if isinstance(template, dict):
+        return {k: _unflatten_into(v, flat, f"{prefix}{k}/") for k, v in template.items()}
+    if isinstance(template, (list, tuple)):
+        seq = [_unflatten_into(v, flat, f"{prefix}{i}/") for i, v in enumerate(template)]
+        return type(template)(seq) if isinstance(template, tuple) else seq
+    if template is None:
+        return None
+    key = prefix[:-1]
+    if key not in flat:
+        raise KeyError(f"checkpoint missing parameter '{key}'")
+    return jnp.asarray(flat[key])
+
+
+def save_model(net, path: str, save_updater: bool = True) -> None:
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("configuration.json", json.dumps(net.conf.to_dict(), indent=1))
+        zf.writestr("meta.json", json.dumps({
+            "format_version": FORMAT_VERSION,
+            "iteration": net.iteration,
+            "epoch": net.epoch,
+            "model_class": type(net).__name__,
+        }))
+        zf.writestr("params.npz", _npz_bytes(_flatten_tree(net.params)))
+        zf.writestr("state.npz", _npz_bytes(_flatten_tree(net.state)))
+        if save_updater:
+            zf.writestr("updater.npz", _npz_bytes(_flatten_tree(net.opt_state)))
+
+
+def load_model(path: str, load_updater: bool = True):
+    with zipfile.ZipFile(path, "r") as zf:
+        conf_d = json.loads(zf.read("configuration.json"))
+        meta = json.loads(zf.read("meta.json"))
+        params_flat = _load_npz(zf.read("params.npz"))
+        state_flat = _load_npz(zf.read("state.npz"))
+        upd_flat = _load_npz(zf.read("updater.npz")) if (
+            load_updater and "updater.npz" in zf.namelist()) else None
+
+    if conf_d.get("type") == "ComputationGraphConfiguration":
+        from ..nn.graph import ComputationGraph, ComputationGraphConfiguration
+        conf = ComputationGraphConfiguration.from_dict(conf_d)
+        net = ComputationGraph(conf)
+    else:
+        from ..nn.multilayer import MultiLayerConfiguration, MultiLayerNetwork
+        conf = MultiLayerConfiguration.from_dict(conf_d)
+        net = MultiLayerNetwork(conf)
+    net.init()  # builds templates with correct structure
+    net.params = _unflatten_into(net.params, params_flat)
+    net.state = _unflatten_into(net.state, state_flat)
+    if upd_flat is not None:
+        net.opt_state = _unflatten_into(net.opt_state, upd_flat)
+    net.iteration = meta.get("iteration", 0)
+    net.epoch = meta.get("epoch", 0)
+    return net

@@ -1,0 +1,17 @@
+"""Test configuration: force CPU with 8 virtual devices.
+
+This is the reference's `local[N]` Spark-test analog (SURVEY.md §4.5): all
+multi-device/sharding tests run on a virtual 8-device CPU mesh via
+--xla_force_host_platform_device_count, no TPU pod required.  Must run
+before jax initializes its backend, hence top of conftest.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

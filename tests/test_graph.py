@@ -1,0 +1,150 @@
+"""ComputationGraph: topo sort, vertices, multi-input/output, serde."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet, MultiDataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.graph import (
+    ComputationGraph, ComputationGraphConfiguration, GraphBuilder,
+    ElementWiseVertex, MergeVertex, L2NormalizeVertex, StackVertex, UnstackVertex,
+    SubsetVertex, LastTimeStepVertex,
+)
+from deeplearning4j_tpu.nn.layers import Dense, OutputLayer, LSTM
+from deeplearning4j_tpu.nn.updaters import Adam
+
+
+def blobs(n=256, f=10, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(classes, f)) * 3
+    ys = rng.integers(0, classes, size=n)
+    xs = (centers[ys] + rng.normal(size=(n, f))).astype(np.float32)
+    return xs, np.eye(classes, dtype=np.float32)[ys]
+
+
+def residual_graph():
+    return (GraphBuilder()
+            .seed(0).updater(Adam(lr=1e-2))
+            .add_inputs("in")
+            .set_input_types(**{"in": InputType.feed_forward(10)})
+            .add_layer("fc1", Dense(n_out=10, activation="relu"), "in")
+            .add_vertex("res", ElementWiseVertex(op="add"), "fc1", "in")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax", loss="mcxent"), "res")
+            .set_outputs("out")
+            .build())
+
+
+class TestGraphStructure:
+    def test_topo_sort_and_shapes(self):
+        net = ComputationGraph(residual_graph())
+        assert net.topo_order.index("fc1") < net.topo_order.index("res")
+        assert net.vertex_out_types["res"].size == 10
+        net.init()
+        assert net.num_params() == 10 * 10 + 10 + 10 * 3 + 3
+
+    def test_cycle_detection(self):
+        conf = (GraphBuilder().add_inputs("in")
+                .add_layer("a", Dense(n_in=4, n_out=4), "b")
+                .add_layer("b", Dense(n_in=4, n_out=4), "a")
+                .set_outputs("b").build())
+        with pytest.raises(ValueError, match="cycle"):
+            ComputationGraph(conf)
+
+    def test_unknown_input_rejected(self):
+        conf = (GraphBuilder().add_inputs("in")
+                .add_layer("a", Dense(n_in=4, n_out=4), "nope")
+                .set_outputs("a").build())
+        with pytest.raises(ValueError, match="unknown input"):
+            ComputationGraph(conf)
+
+
+class TestGraphTraining:
+    def test_residual_net_learns(self):
+        xs, ys = blobs()
+        net = ComputationGraph(residual_graph())
+        net.init()
+        from deeplearning4j_tpu.datasets import ListDataSetIterator
+        losses = net.fit(ListDataSetIterator.from_arrays(xs, ys, 64), epochs=15)
+        assert losses[-1] < 0.3 * losses[0]
+        assert net.evaluate(ListDataSetIterator.from_arrays(xs, ys, 64)).accuracy() > 0.9
+
+    def test_multi_input_merge(self):
+        rng = np.random.default_rng(0)
+        xa = rng.normal(size=(128, 4)).astype(np.float32)
+        xb = rng.normal(size=(128, 6)).astype(np.float32)
+        ys = np.eye(2, dtype=np.float32)[(xa.sum(1) + xb.sum(1) > 0).astype(int)]
+        conf = (GraphBuilder().seed(1).updater(Adam(lr=1e-2))
+                .add_inputs("a", "b")
+                .set_input_types(a=InputType.feed_forward(4), b=InputType.feed_forward(6))
+                .add_vertex("merge", MergeVertex(), "a", "b")
+                .add_layer("fc", Dense(n_out=16, activation="relu"), "merge")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax", loss="mcxent"), "fc")
+                .set_outputs("out").build())
+        net = ComputationGraph(conf)
+        assert net.vertex_out_types["merge"].size == 10
+        net.init()
+        mds = MultiDataSet([xa, xb], [ys])
+        l0 = net.fit_batch(mds)
+        for _ in range(60):
+            l1 = net.fit_batch(mds)
+        assert l1 < 0.5 * l0
+        out = net.output(xa, xb)[0]
+        assert out.shape == (128, 2)
+
+    def test_multi_output(self):
+        xs, ys = blobs(classes=3)
+        reg_targets = xs[:, :2].astype(np.float32)
+        conf = (GraphBuilder().seed(1).updater(Adam(lr=1e-2))
+                .add_inputs("in")
+                .set_input_types(**{"in": InputType.feed_forward(10)})
+                .add_layer("fc", Dense(n_out=16, activation="relu"), "in")
+                .add_layer("cls", OutputLayer(n_out=3, activation="softmax", loss="mcxent"), "fc")
+                .add_layer("reg", OutputLayer(n_out=2, activation="identity", loss="mse"), "fc")
+                .set_outputs("cls", "reg").build())
+        net = ComputationGraph(conf)
+        net.init()
+        mds = MultiDataSet([xs], [np.asarray(ys), reg_targets])
+        l0 = net.fit_batch(mds)
+        for _ in range(50):
+            l1 = net.fit_batch(mds)
+        assert l1 < 0.7 * l0
+        outs = net.output(xs)
+        assert outs[0].shape == (256, 3) and outs[1].shape == (256, 2)
+
+    def test_lstm_last_timestep_vertex(self):
+        rng = np.random.default_rng(0)
+        xs = rng.normal(size=(32, 9, 5)).astype(np.float32)
+        ys = np.eye(2, dtype=np.float32)[(xs.mean((1, 2)) > 0).astype(int)]
+        conf = (GraphBuilder().seed(0).updater(Adam(lr=5e-3))
+                .add_inputs("in")
+                .set_input_types(**{"in": InputType.recurrent(5)})
+                .add_layer("lstm", LSTM(n_out=8), "in")
+                .add_vertex("last", LastTimeStepVertex(), "lstm")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax", loss="mcxent"), "last")
+                .set_outputs("out").build())
+        net = ComputationGraph(conf)
+        net.init()
+        loss = net.fit_batch(DataSet(xs, ys))
+        assert np.isfinite(loss)
+
+    def test_stack_unstack_subset(self):
+        import jax.numpy as jnp
+        sv = StackVertex()
+        a, b = jnp.ones((2, 3)), jnp.zeros((2, 3))
+        stacked = sv.forward([a, b], [None, None])
+        assert stacked.shape == (4, 3)
+        uv = UnstackVertex(index=1, stack_size=2)
+        np.testing.assert_allclose(uv.forward([stacked], [None]), b)
+        sub = SubsetVertex(from_idx=1, to_idx=2)
+        assert sub.forward([jnp.ones((2, 5))], [None]).shape == (2, 2)
+
+    def test_graph_save_restore(self, tmp_path):
+        import os
+        xs, ys = blobs(n=64)
+        net = ComputationGraph(residual_graph())
+        net.init()
+        net.fit_batch(DataSet(xs, ys))
+        path = os.path.join(tmp_path, "graph.zip")
+        net.save(path)
+        restored = ComputationGraph.load(path)
+        np.testing.assert_allclose(net.output(xs)[0], restored.output(xs)[0], rtol=1e-6)

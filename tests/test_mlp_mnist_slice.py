@@ -1,0 +1,124 @@
+"""M2 end-to-end slice: MLP training on synthetic MNIST-shaped data.
+
+Mirrors the reference's core acceptance path (SURVEY.md §7 M2): build conf →
+init → fit(iterator) → evaluate → save/restore round-trip.  Uses a
+synthetic separable problem so the test is hermetic (no downloads) and must
+reach high accuracy — a real learning check, not a smoke test.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator, AsyncDataSetIterator
+from deeplearning4j_tpu.evaluation import Evaluation
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.updaters import Adam, Nesterovs
+
+
+def synthetic_classification(n=512, n_features=20, n_classes=4, seed=0):
+    """Gaussian blobs — separable, so a trained MLP must fit them."""
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(n_classes, n_features)) * 3.0
+    ys = rng.integers(0, n_classes, size=n)
+    xs = centers[ys] + rng.normal(size=(n, n_features))
+    labels = np.eye(n_classes, dtype=np.float32)[ys]
+    return xs.astype(np.float32), labels
+
+
+def build_mlp(n_in=20, n_classes=4, seed=42):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(Adam(lr=1e-2))
+            .layer(Dense(n_out=64, activation="relu"))
+            .layer(OutputLayer(n_out=n_classes, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+class TestEndToEnd:
+    def test_shapes_inferred(self):
+        net = build_mlp()
+        assert net.conf.layers[0].n_in == 20
+        assert net.conf.layers[1].n_in == 64
+        assert net.num_params() == 20 * 64 + 64 + 64 * 4 + 4
+
+    def test_training_reduces_loss_and_learns(self):
+        xs, ys = synthetic_classification()
+        net = build_mlp()
+        it = ListDataSetIterator.from_arrays(xs, ys, batch_size=64, shuffle=True, seed=1)
+        losses = net.fit(it, epochs=15)
+        assert losses[-1] < 0.25 * losses[0], f"loss did not drop: {losses[0]} -> {losses[-1]}"
+        ev = net.evaluate(it)
+        assert ev.accuracy() > 0.95, ev.stats()
+
+    def test_async_iterator_equivalent(self):
+        xs, ys = synthetic_classification(n=256)
+        base = ListDataSetIterator.from_arrays(xs, ys, batch_size=64)
+        async_it = AsyncDataSetIterator(base, prefetch=2)
+        batches = list(async_it)
+        assert sum(b.num_examples() for b in batches) == 256
+        # reset works
+        batches2 = list(async_it)
+        assert len(batches2) == len(batches)
+
+    def test_output_deterministic(self):
+        xs, _ = synthetic_classification(n=32)
+        net = build_mlp()
+        o1, o2 = net.output(xs), net.output(xs)
+        np.testing.assert_array_equal(o1, o2)
+        np.testing.assert_allclose(o1.sum(-1), np.ones(32), rtol=1e-5)
+
+    def test_save_restore_roundtrip(self, tmp_path):
+        xs, ys = synthetic_classification(n=128)
+        net = build_mlp()
+        net.fit(ListDataSetIterator.from_arrays(xs, ys, 64), epochs=2)
+        path = os.path.join(tmp_path, "model.zip")
+        net.save(path)
+        restored = MultiLayerNetwork.load(path)
+        np.testing.assert_allclose(net.output(xs), restored.output(xs), rtol=1e-6)
+        assert restored.iteration == net.iteration
+        # training continues identically: updater state restored
+        l1 = net.fit_batch(DataSet(xs[:64], ys[:64]))
+        l2 = restored.fit_batch(DataSet(xs[:64], ys[:64]))
+        # same data, same params, same opt state — but different dropout rng
+        # (none here), so losses match
+        np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+    def test_score(self):
+        xs, ys = synthetic_classification(n=64)
+        net = build_mlp()
+        s = net.score(DataSet(xs, ys))
+        assert np.isfinite(s) and s > 0
+
+    def test_nesterov_updater(self):
+        xs, ys = synthetic_classification(n=256)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(0)
+                .updater(Nesterovs(lr=0.05, momentum=0.9))
+                .layer(Dense(n_out=32, activation="tanh"))
+                .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(20))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        losses = net.fit(ListDataSetIterator.from_arrays(xs, ys, 64), epochs=10)
+        assert losses[-1] < 0.5 * losses[0]
+
+    def test_json_roundtrip(self):
+        net = build_mlp()
+        d = net.conf.to_dict()
+        import json
+        s = json.dumps(d)
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerConfiguration
+        conf2 = MultiLayerConfiguration.from_dict(json.loads(s))
+        assert len(conf2.layers) == 2
+        assert conf2.layers[0].n_out == 64
+        assert isinstance(conf2.updater, Adam)
+        assert conf2.updater.lr == 1e-2

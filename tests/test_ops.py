@@ -1,0 +1,96 @@
+"""Substrate tests: activations, initializers, losses."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.activations import get_activation, activation_names
+from deeplearning4j_tpu.ops.initializers import init_weight
+from deeplearning4j_tpu.ops.losses import get_loss, loss_names
+
+
+class TestActivations:
+    @pytest.mark.parametrize("name", activation_names())
+    def test_finite_and_shape(self, name):
+        x = jnp.linspace(-3, 3, 24).reshape(4, 6)
+        y = get_activation(name)(x)
+        assert y.shape == x.shape
+        assert bool(jnp.all(jnp.isfinite(y)))
+
+    def test_relu(self):
+        y = get_activation("relu")(jnp.asarray([-1.0, 0.0, 2.0]))
+        np.testing.assert_allclose(y, [0.0, 0.0, 2.0])
+
+    def test_softmax_rows_sum_to_one(self):
+        y = get_activation("softmax")(jax.random.normal(jax.random.PRNGKey(0), (5, 7)))
+        np.testing.assert_allclose(np.asarray(jnp.sum(y, -1)), np.ones(5), rtol=1e-6)
+
+    def test_unknown_raises(self):
+        with pytest.raises(ValueError):
+            get_activation("nope")
+
+
+class TestInitializers:
+    def test_xavier_std(self):
+        w = init_weight(jax.random.PRNGKey(0), (400, 300), "xavier", 400, 300)
+        expected = np.sqrt(2.0 / 700)
+        assert abs(float(jnp.std(w)) - expected) < 0.1 * expected
+
+    def test_relu_std(self):
+        w = init_weight(jax.random.PRNGKey(1), (500, 100), "relu", 500, 100)
+        expected = np.sqrt(2.0 / 500)
+        assert abs(float(jnp.std(w)) - expected) < 0.1 * expected
+
+    def test_zero_ones_identity(self):
+        assert float(jnp.sum(init_weight(jax.random.PRNGKey(0), (3, 3), "zero", 3, 3))) == 0
+        assert float(jnp.sum(init_weight(jax.random.PRNGKey(0), (3, 3), "ones", 3, 3))) == 9
+        np.testing.assert_allclose(
+            init_weight(jax.random.PRNGKey(0), (3, 3), "identity", 3, 3), np.eye(3))
+
+    def test_uniform_bounds(self):
+        w = init_weight(jax.random.PRNGKey(2), (100, 100), "xavier_uniform", 100, 100)
+        limit = np.sqrt(6.0 / 200)
+        assert float(jnp.max(jnp.abs(w))) <= limit + 1e-6
+
+
+class TestLosses:
+    def test_mse_known_value(self):
+        loss = get_loss("mse")
+        y = jnp.asarray([[1.0, 2.0]])
+        out = jnp.asarray([[1.5, 1.0]])
+        # per-example = 0.25 + 1.0 = 1.25
+        np.testing.assert_allclose(float(loss(y, out)), 1.25, rtol=1e-6)
+
+    def test_mcxent_softmax_fused_matches_plain(self):
+        rng = jax.random.PRNGKey(0)
+        logits = jax.random.normal(rng, (8, 5))
+        labels = jax.nn.one_hot(jnp.arange(8) % 5, 5)
+        loss = get_loss("mcxent")
+        fused = float(loss(labels, logits, "softmax"))
+        probs = jax.nn.softmax(logits)
+        plain = float(jnp.mean(-jnp.sum(labels * jnp.log(probs), -1)))
+        np.testing.assert_allclose(fused, plain, rtol=1e-5)
+
+    def test_xent_sigmoid_fused_stable(self):
+        logits = jnp.asarray([[100.0, -100.0]])
+        labels = jnp.asarray([[1.0, 0.0]])
+        v = float(get_loss("xent")(labels, logits, "sigmoid"))
+        assert np.isfinite(v) and v < 1e-3
+
+    @pytest.mark.parametrize("name", loss_names())
+    def test_all_losses_finite(self, name):
+        rng = jax.random.PRNGKey(3)
+        preout = jax.random.normal(rng, (4, 6)) * 0.1
+        labels = jnp.abs(jax.random.normal(jax.random.PRNGKey(4), (4, 6))) * 0.1 + 0.1
+        act = "softmax" if name in ("mcxent", "negativeloglikelihood") else "sigmoid"
+        v = float(get_loss(name)(labels, preout, act))
+        assert np.isfinite(v)
+
+    def test_masked_loss(self):
+        loss = get_loss("mse")
+        y = jnp.ones((2, 3, 4))
+        out = jnp.zeros((2, 3, 4))
+        mask = jnp.asarray([[1.0, 1.0, 0.0], [1.0, 0.0, 0.0]])
+        # per-element loss 1; per present timestep sum=4; mean over 3 present = 4
+        np.testing.assert_allclose(float(loss(y, out, "identity", mask)), 4.0, rtol=1e-6)

@@ -1,0 +1,130 @@
+"""Recurrent path: LSTM variants, masking, TBPTT, streaming inference."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (
+    LSTM, GravesLSTM, GravesBidirectionalLSTM, SimpleRnn, RnnOutputLayer, LastTimeStep,
+    Bidirectional, OutputLayer,
+)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.updaters import Adam
+
+
+def seq_problem(n=128, t=12, f=6, classes=3, seed=0):
+    """Label = argmax of the mean of features over time → learnable by RNN."""
+    rng = np.random.default_rng(seed)
+    xs = rng.normal(size=(n, t, f)).astype(np.float32)
+    ys_idx = xs.mean(axis=1)[:, :classes].argmax(-1)
+    labels_last = np.eye(classes, dtype=np.float32)[ys_idx]
+    return xs, labels_last
+
+
+class TestLSTMForward:
+    @pytest.mark.parametrize("cls", [LSTM, GravesLSTM, SimpleRnn])
+    def test_shapes(self, cls):
+        layer = cls(n_in=5, n_out=7)
+        p = layer.init_params(jax.random.PRNGKey(0), InputType.recurrent(5))
+        out = layer.forward(p, {}, jnp.ones((3, 11, 5)))
+        assert out.y.shape == (3, 11, 7)
+
+    def test_bidirectional_sum_shape(self):
+        layer = GravesBidirectionalLSTM(n_in=4, n_out=6)
+        p = layer.init_params(jax.random.PRNGKey(0), InputType.recurrent(4))
+        out = layer.forward(p, {}, jnp.ones((2, 9, 4)))
+        assert out.y.shape == (2, 9, 6)
+
+    def test_bidirectional_wrapper_concat(self):
+        layer = Bidirectional(layer=LSTM(n_in=4, n_out=6))
+        layer.infer_nin(InputType.recurrent(4))
+        p = layer.init_params(jax.random.PRNGKey(0), InputType.recurrent(4))
+        out = layer.forward(p, {}, jnp.ones((2, 9, 4)))
+        assert out.y.shape == (2, 9, 12)
+
+    def test_forget_gate_bias(self):
+        layer = LSTM(n_in=3, n_out=4, forget_gate_bias_init=1.0)
+        p = layer.init_params(jax.random.PRNGKey(0), InputType.recurrent(3))
+        b = np.asarray(p["b"])
+        np.testing.assert_allclose(b[4:8], np.ones(4))
+        np.testing.assert_allclose(b[:4], np.zeros(4))
+
+    def test_mask_freezes_state(self):
+        """Masked timesteps must not change the hidden state."""
+        layer = LSTM(n_in=3, n_out=4)
+        p = layer.init_params(jax.random.PRNGKey(0), InputType.recurrent(3))
+        x = jnp.asarray(np.random.default_rng(0).normal(size=(1, 6, 3)).astype(np.float32))
+        mask = jnp.asarray([[1, 1, 1, 0, 0, 0]], jnp.float32)
+        out = layer.forward(p, {}, x, mask=mask)
+        # outputs at masked steps hold the last unmasked h
+        np.testing.assert_allclose(out.y[0, 3], out.y[0, 2], rtol=1e-6)
+        np.testing.assert_allclose(out.y[0, 5], out.y[0, 2], rtol=1e-6)
+
+
+class TestEndToEndRNN:
+    def _net(self, f=6, classes=3, last_step=True):
+        layers = [LSTM(n_out=16)]
+        if last_step:
+            layers = [LastTimeStep(layer=LSTM(n_out=16))]
+        b = NeuralNetConfiguration.builder().seed(1).updater(Adam(lr=5e-3))
+        for l in layers:
+            b.layer(l)
+        b.layer(OutputLayer(n_out=classes, activation="softmax", loss="mcxent"))
+        b.set_input_type(InputType.recurrent(f))
+        net = MultiLayerNetwork(b.build())
+        net.init()
+        return net
+
+    def test_learns_sequence_classification(self):
+        xs, ys = seq_problem()
+        net = self._net()
+        from deeplearning4j_tpu.datasets import ListDataSetIterator
+        it = ListDataSetIterator.from_arrays(xs, ys, 32)
+        losses = net.fit(it, epochs=30)
+        assert losses[-1] < 0.6 * losses[0], (losses[0], losses[-1])
+
+    def test_rnn_output_layer_per_timestep(self):
+        xs = np.random.default_rng(0).normal(size=(8, 10, 6)).astype(np.float32)
+        ys = np.eye(4, dtype=np.float32)[np.random.default_rng(1).integers(0, 4, (8, 10))]
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(lr=1e-3))
+                .layer(LSTM(n_out=12))
+                .layer(RnnOutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.recurrent(6)).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        loss = net.fit_batch(DataSet(xs, ys))
+        assert np.isfinite(loss)
+        out = net.output(xs)
+        assert out.shape == (8, 10, 4)
+
+    def test_tbptt_runs_and_matches_carry_semantics(self):
+        xs = np.random.default_rng(0).normal(size=(4, 20, 6)).astype(np.float32)
+        ys = np.eye(4, dtype=np.float32)[np.random.default_rng(1).integers(0, 4, (4, 20))]
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(lr=1e-3))
+                .layer(LSTM(n_out=8))
+                .layer(RnnOutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+                .tbptt(5)
+                .set_input_type(InputType.recurrent(6)).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        it0 = net.iteration
+        loss = net.fit_batch(DataSet(xs, ys))
+        assert np.isfinite(loss)
+        assert net.iteration == it0 + 4  # 20/5 chunks = 4 optimizer steps
+
+    def test_stream_matches_full_forward(self):
+        """rnnTimeStep fed step-by-step must reproduce the full-sequence output."""
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Adam(lr=1e-3))
+                .layer(LSTM(n_out=8))
+                .layer(RnnOutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.recurrent(6)).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        xs = np.random.default_rng(5).normal(size=(2, 7, 6)).astype(np.float32)
+        full = net.output(xs)  # [2, 7, 4]
+        net.rnn_clear_previous_state()
+        stepped = np.stack([net.rnn_time_step(xs[:, t]) for t in range(7)], axis=1)
+        np.testing.assert_allclose(full, stepped, rtol=1e-5, atol=1e-6)
