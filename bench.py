@@ -1,0 +1,78 @@
+"""Benchmark: ResNet-50 training throughput (images/sec) on one chip.
+
+BASELINE.md protocol: steady-state post-compile window, images/sec/chip.
+The reference publishes no numbers (BASELINE.md: "NONE"); the driver target
+is >=0.8x per-chip of H100+nd4j-cuda on ResNet-50.  H100 ResNet-50 training
+throughput is ~2.5k img/s mixed precision, so vs_baseline is reported
+against BASELINE_IMG_S = 2000.0 (the 0.8x bar).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_S = 2000.0  # 0.8 x H100 nd4j-cuda ResNet-50 (BASELINE.md target)
+
+BATCH = 128
+WARMUP = 5
+STEPS = 30
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import ResNet50
+    from deeplearning4j_tpu.nn.updaters import Nesterovs
+
+    platform = jax.devices()[0].platform
+    # bf16 compute on TPU (MXU-native), f32 on CPU fallback
+    net = ResNet50(height=224, width=224, channels=3, num_classes=1000,
+                   updater=Nesterovs(lr=0.1, momentum=0.9))
+    if platform != "cpu":
+        net.conf.compute_dtype = "bfloat16"
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(BATCH, 224, 224, 3)).astype(np.float32))
+    y = jnp.asarray(np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, BATCH)])
+
+    if net._jit_step is None:
+        net._jit_step = net._make_step()
+    import jax.random as jrandom
+
+    params, state, opt = net.params, net.state, net.opt_state
+    inputs = {"in": x}
+    labels = {"out": y}
+    masks = {"in": None}
+    lmasks = {"out": None}
+
+    def step(params, state, opt, i):
+        return net._jit_step(params, state, opt, jnp.asarray(i, jnp.int32),
+                             inputs, labels, jrandom.PRNGKey(i), masks, lmasks)
+
+    for i in range(WARMUP):
+        params, state, opt, loss = step(params, state, opt, i)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for i in range(WARMUP, WARMUP + STEPS):
+        params, state, opt, loss = step(params, state, opt, i)
+    jax.block_until_ready(loss)
+    elapsed = time.perf_counter() - t0
+
+    img_s = BATCH * STEPS / elapsed
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(img_s, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(img_s / BASELINE_IMG_S, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

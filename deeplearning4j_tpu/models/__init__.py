@@ -1,0 +1,29 @@
+"""Model zoo (replaces deeplearning4j-zoo, reference zoo/model/*).
+
+Each zoo model is a function returning an initialized-config network
+(MultiLayerNetwork or ComputationGraph), mirroring the reference's 12
+instantiable architectures (zoo/ZooModel.java:23).  Pretrained-weight
+loading hooks exist but no weights ship in-repo (zero-egress environment);
+the checkpoint format is the framework zip.
+"""
+
+from .lenet import LeNet
+from .simplecnn import SimpleCNN
+from .alexnet import AlexNet
+from .vgg import VGG16, VGG19
+from .resnet50 import ResNet50
+from .darknet19 import Darknet19
+from .tinyyolo import TinyYOLO
+from .textgen_lstm import TextGenerationLSTM
+
+ZOO = {
+    "lenet": LeNet,
+    "simplecnn": SimpleCNN,
+    "alexnet": AlexNet,
+    "vgg16": VGG16,
+    "vgg19": VGG19,
+    "resnet50": ResNet50,
+    "darknet19": Darknet19,
+    "tinyyolo": TinyYOLO,
+    "textgenerationlstm": TextGenerationLSTM,
+}

@@ -1,0 +1,39 @@
+"""Darknet19 — reference zoo/model/Darknet19.java (YOLOv2 backbone:
+19 conv layers, BN + leaky-relu, 5 maxpools)."""
+
+from ..nn.conf.inputs import InputType
+from ..nn.layers import BatchNormalization, Convolution2D, GlobalPooling, OutputLayer, Subsampling2D
+from ..nn.multilayer import MultiLayerNetwork, NeuralNetConfiguration
+from ..nn.updaters import Nesterovs
+
+
+def _cbl(b, n_out, kernel=(3, 3)):
+    b.layer(Convolution2D(n_out=n_out, kernel=kernel, convolution_mode="same",
+                          activation="identity", has_bias=False))
+    b.layer(BatchNormalization(activation="leakyrelu"))
+
+
+def Darknet19(height: int = 224, width: int = 224, channels: int = 3,
+              num_classes: int = 1000, seed: int = 42, updater=None) -> MultiLayerNetwork:
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed)
+         .updater(updater or Nesterovs(lr=1e-3, momentum=0.9)))
+    _cbl(b, 32)
+    b.layer(Subsampling2D(pooling="max", kernel=(2, 2), stride=(2, 2)))
+    _cbl(b, 64)
+    b.layer(Subsampling2D(pooling="max", kernel=(2, 2), stride=(2, 2)))
+    _cbl(b, 128); _cbl(b, 64, (1, 1)); _cbl(b, 128)
+    b.layer(Subsampling2D(pooling="max", kernel=(2, 2), stride=(2, 2)))
+    _cbl(b, 256); _cbl(b, 128, (1, 1)); _cbl(b, 256)
+    b.layer(Subsampling2D(pooling="max", kernel=(2, 2), stride=(2, 2)))
+    _cbl(b, 512); _cbl(b, 256, (1, 1)); _cbl(b, 512); _cbl(b, 256, (1, 1)); _cbl(b, 512)
+    b.layer(Subsampling2D(pooling="max", kernel=(2, 2), stride=(2, 2)))
+    _cbl(b, 1024); _cbl(b, 512, (1, 1)); _cbl(b, 1024); _cbl(b, 512, (1, 1)); _cbl(b, 1024)
+    b.layer(Convolution2D(n_out=num_classes, kernel=(1, 1), convolution_mode="same",
+                          activation="identity"))
+    b.layer(GlobalPooling(pooling="avg"))
+    b.layer(OutputLayer(n_out=num_classes, activation="softmax", loss="mcxent"))
+    b.set_input_type(InputType.convolutional(height, width, channels))
+    net = MultiLayerNetwork(b.build())
+    net.init()
+    return net

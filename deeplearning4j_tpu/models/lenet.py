@@ -1,0 +1,27 @@
+"""LeNet — reference zoo/model/LeNet.java (conv5x5 → pool → conv5x5 → pool
+→ dense 500 → softmax, the dl4j-zoo variant)."""
+
+from ..nn.conf.inputs import InputType
+from ..nn.layers import Convolution2D, Dense, OutputLayer, Subsampling2D
+from ..nn.multilayer import MultiLayerNetwork, NeuralNetConfiguration
+from ..nn.updaters import Adam
+
+
+def LeNet(height: int = 28, width: int = 28, channels: int = 1,
+          num_classes: int = 10, seed: int = 123, updater=None) -> MultiLayerNetwork:
+    conf = (NeuralNetConfiguration.builder()
+            .seed(seed)
+            .updater(updater or Adam(lr=1e-3))
+            .layer(Convolution2D(n_out=20, kernel=(5, 5), stride=(1, 1),
+                                 activation="identity", convolution_mode="same"))
+            .layer(Subsampling2D(pooling="max", kernel=(2, 2), stride=(2, 2)))
+            .layer(Convolution2D(n_out=50, kernel=(5, 5), stride=(1, 1),
+                                 activation="identity", convolution_mode="same"))
+            .layer(Subsampling2D(pooling="max", kernel=(2, 2), stride=(2, 2)))
+            .layer(Dense(n_out=500, activation="relu"))
+            .layer(OutputLayer(n_out=num_classes, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.convolutional(height, width, channels))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net

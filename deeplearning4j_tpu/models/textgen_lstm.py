@@ -1,0 +1,25 @@
+"""TextGenerationLSTM — reference zoo/model/TextGenerationLSTM.java
+(char-RNN: 2×LSTM(256) + RnnOutputLayer, Karpathy-style)."""
+
+from ..nn.conf.inputs import InputType
+from ..nn.layers import GravesLSTM, RnnOutputLayer
+from ..nn.multilayer import MultiLayerNetwork, NeuralNetConfiguration
+from ..nn.updaters import RmsProp
+from ..nn.updaters import GradientNormalization
+
+
+def TextGenerationLSTM(vocab_size: int = 77, hidden: int = 256,
+                       tbptt_length: int = 50, seed: int = 42,
+                       updater=None) -> MultiLayerNetwork:
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed)
+         .updater(updater or RmsProp(lr=1e-2))
+         .gradient_normalization(GradientNormalization.CLIP_ELEMENTWISE_ABSOLUTE, 1.0)
+         .layer(GravesLSTM(n_out=hidden))
+         .layer(GravesLSTM(n_out=hidden))
+         .layer(RnnOutputLayer(n_out=vocab_size, activation="softmax", loss="mcxent"))
+         .tbptt(tbptt_length)
+         .set_input_type(InputType.recurrent(vocab_size)))
+    net = MultiLayerNetwork(b.build())
+    net.init()
+    return net

@@ -547,7 +547,8 @@ class ComputationGraph:
               *, train: bool, rng, masks=None, label_masks=None):
         acts, new_state, mks = self._apply(params, state, inputs, train=train, rng=rng,
                                            masks=masks, stop_before_output_score=True)
-        total = jnp.zeros((), jnp.float32)
+        acc = jnp.float64 if jnp.dtype(self.conf.compute_dtype) == jnp.float64 else jnp.float32
+        total = jnp.zeros((), acc)
         for oi, out_name in enumerate(self.conf.network_outputs):
             spec = self._spec(out_name)
             layer = spec.vertex.layer
@@ -559,14 +560,15 @@ class ComputationGraph:
                 h = layer._maybe_dropout(h, train, jax.random.fold_in(rng, 10_000 + oi))
             lm = (label_masks or {}).get(out_name)
             total = total + layer.score(params[out_name], state[out_name], h,
-                                        labels[out_name], mask=lm).astype(jnp.float32)
+                                        labels[out_name], mask=lm).astype(acc)
             if train and hasattr(layer, "update_centers"):
                 new_state[out_name] = layer.update_centers(
                     state[out_name], jax.lax.stop_gradient(h),
                     jax.lax.stop_gradient(labels[out_name]))
         for spec in self.conf.vertices:
             if isinstance(spec.vertex, LayerVertex) and self.params.get(spec.name):
-                total = total + spec.vertex.layer.regularization_score(params[spec.name])
+                total = total + spec.vertex.layer.regularization_score(
+                    params[spec.name]).astype(acc)
         return total, new_state
 
     # -- training ----------------------------------------------------------

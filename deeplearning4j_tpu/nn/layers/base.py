@@ -210,15 +210,16 @@ class Layer:
         BaseLayer.calcL2/calcL1: biases excluded by default)."""
         if (self.l1 == 0.0 and self.l2 == 0.0) or not params:
             return jnp.zeros((), jnp.float32)
-        score = jnp.zeros((), jnp.float32)
-        for k, v in params.items():
-            if k in ("b", "beta", "gamma", "mean", "var"):
-                continue
-            v32 = v.astype(jnp.float32)
+        leaves = [v for k, v in params.items()
+                  if k not in ("b", "beta", "gamma", "mean", "var")]
+        acc = jnp.promote_types(jnp.float32, leaves[0].dtype) if leaves else jnp.float32
+        score = jnp.zeros((), acc)
+        for v in leaves:
+            va = v.astype(acc)
             if self.l1:
-                score = score + self.l1 * jnp.sum(jnp.abs(v32))
+                score = score + self.l1 * jnp.sum(jnp.abs(va))
             if self.l2:
-                score = score + 0.5 * self.l2 * jnp.sum(v32 * v32)
+                score = score + 0.5 * self.l2 * jnp.sum(va * va)
         return score
 
     def has_params(self) -> bool:

@@ -278,11 +278,13 @@ class MultiLayerNetwork:
             # center-loss moving-average update rides the state path
             new_state[n - 1] = last.update_centers(
                 state[n - 1], jax.lax.stop_gradient(h), jax.lax.stop_gradient(labels))
-        reg = jnp.zeros((), jnp.float32)
+        # accumulate in f64 when computing in f64 (gradient checks), else f32
+        acc = jnp.float64 if jnp.dtype(self.conf.compute_dtype) == jnp.float64 else jnp.float32
+        reg = jnp.zeros((), acc)
         for layer, p in zip(self.conf.layers, params):
             if p:
-                reg = reg + layer.regularization_score(p)
-        total = loss.astype(jnp.float32) + reg
+                reg = reg + layer.regularization_score(p).astype(acc)
+        total = loss.astype(acc) + reg
         if carries is not None:
             return total, (new_state, new_carries)
         return total, new_state

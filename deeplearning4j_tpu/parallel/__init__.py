@@ -1,0 +1,23 @@
+"""Parallelism (L4): mesh builders + sharded training + parallel inference.
+
+Replaces the reference's entire scale-out menu (SURVEY.md §2.3) with ONE
+mechanism: a jax.sharding.Mesh + GSPMD-partitioned jit programs.
+
+  reference ParallelWrapper (model clones + averaging every N iters,
+    parallelism/ParallelWrapper.java:58,326)           → data-axis sharding;
+    the per-step psum XLA inserts is mathematically the averagingFrequency=1
+    case, strictly stronger
+  reference Spark ParameterAveragingTrainingMaster     → same code path,
+    multi-host via jax.distributed
+  reference SharedTrainingMaster (threshold-compressed
+    gradients over Aeron UDP, SharedTrainingMaster.java:55)
+                                                       → dense grad allreduce
+    over ICI; no compression needed at ICI bandwidth
+  reference ParameterServerTrainer                     → subsumed by
+    collectives (documented non-goal)
+  TP / PP / SP — absent in the reference — are first-class here.
+"""
+
+from .mesh import build_mesh, replicated, shard_batch, infer_param_shardings
+from .trainer import ShardedTrainer
+from .inference import ParallelInference

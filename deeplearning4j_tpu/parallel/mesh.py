@@ -1,0 +1,80 @@
+"""Mesh + sharding utilities — the collectives layer (SURVEY.md §7 M0).
+
+The reference's communication backends (libnd4j device copies +
+`Nd4j.averageAndPropagate`, Aeron UDP VoidParameterServer — SURVEY.md §5
+"Distributed communication backend") are replaced by a device mesh with
+named axes; XLA GSPMD inserts the psum/all-gather/reduce-scatter collectives
+that ride ICI intra-slice and DCN across slices.
+
+Axis convention: ``data`` (DP), ``model`` (TP), ``seq`` (SP/CP),
+``pipe`` (PP).  Build a mesh with the axes you use; absent axes = size 1.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
+
+
+def build_mesh(axes: Optional[Dict[str, int]] = None,
+               devices: Optional[Sequence] = None) -> Mesh:
+    """Create a Mesh from {axis_name: size}.  Default: all local devices on
+    the data axis (the ParallelWrapper-equivalent ceremony: one line).
+
+    Sizes must multiply to the device count; use -1 for one inferred axis.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    axes = dict(axes) if axes else {DATA_AXIS: n}
+    names = list(axes.keys())
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = n // known
+    if int(np.prod(sizes)) != n:
+        raise ValueError(f"mesh axes {dict(zip(names, sizes))} != {n} devices")
+    arr = np.asarray(devices).reshape(sizes)
+    return Mesh(arr, names)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, batch_axis: str = DATA_AXIS):
+    """Sharding for [batch, ...] arrays: batch split on the data axis."""
+    return NamedSharding(mesh, P(batch_axis))
+
+
+def _shard_leaf(mesh: Mesh, arr, model_axis: str, min_size: int = 2):
+    """Tensor-parallel rule for one weight tensor: shard the largest axis
+    divisible by the model-axis size; replicate if none divides.
+
+    This is the generic Megatron-ish default — XLA GSPMD propagates the
+    choice through the graph and inserts the all-gathers/reduce-scatters.
+    Layer-specific overrides can refine it later without changing callers.
+    """
+    msize = mesh.shape.get(model_axis, 1)
+    if msize <= 1 or arr.ndim == 0:
+        return NamedSharding(mesh, P())
+    # prefer trailing axes (output features) — weight layouts here are
+    # [in, out] / HWIO, so the last axis is the output-feature axis
+    for ax in reversed(range(arr.ndim)):
+        if arr.shape[ax] % msize == 0 and arr.shape[ax] >= msize * min_size:
+            spec = [None] * arr.ndim
+            spec[ax] = model_axis
+            return NamedSharding(mesh, P(*spec))
+    return NamedSharding(mesh, P())
+
+
+def infer_param_shardings(params, mesh: Mesh, model_axis: str = MODEL_AXIS):
+    """Pytree of NamedShardings for a params tree (TP rules, DP-replicated)."""
+    return jax.tree_util.tree_map(lambda a: _shard_leaf(mesh, a, model_axis), params)

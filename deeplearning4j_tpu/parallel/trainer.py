@@ -1,0 +1,117 @@
+"""ShardedTrainer — DP/TP training over a mesh.
+
+The reference's ParallelWrapper (parallelism/ParallelWrapper.java:58: clone
+model per device, round-robin DataSets into per-worker queues, average
+params every ``averagingFrequency`` iterations via Nd4j.averageAndPropagate
+:326) collapses into: put params on the mesh with TP shardings, put the
+batch on the data axis, call the SAME jit step the single-device path uses.
+GSPMD partitions the program; gradient allreduce appears as a fused psum
+over ICI (the Aeron/NCCL role).  Per-step summation ≡ averagingFrequency=1,
+mathematically stronger than the reference's periodic averaging.
+
+Multi-host: call jax.distributed.initialize() first (the Spark master's
+process-placement role is played by the launcher — GKE/Ray/mpirun), then
+build the mesh over jax.devices() spanning all hosts.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..datasets.dataset import DataSet
+from ..datasets.iterators import DataSetIterator
+from .mesh import DATA_AXIS, MODEL_AXIS, build_mesh, infer_param_shardings, replicated
+
+
+class ShardedTrainer:
+    """Wraps a MultiLayerNetwork or ComputationGraph for mesh training.
+
+    >>> mesh = build_mesh({"data": 4, "model": 2})
+    >>> trainer = ShardedTrainer(net, mesh)
+    >>> trainer.fit(iterator, epochs=2)
+
+    The wrapped net keeps working as usual afterwards; its params simply
+    live sharded on the mesh.
+    """
+
+    def __init__(self, net, mesh: Optional[Mesh] = None,
+                 data_axis: str = DATA_AXIS, model_axis: str = MODEL_AXIS):
+        self.net = net
+        self.mesh = mesh if mesh is not None else build_mesh()
+        self.data_axis = data_axis
+        self.model_axis = model_axis
+        self.batch_sharding = NamedSharding(self.mesh, P(data_axis))
+        self._place_model()
+
+    # -- placement ---------------------------------------------------------
+
+    def _place_model(self) -> None:
+        """Move params/opt-state onto the mesh (TP rules), replicate state."""
+        net = self.net
+        self.param_shardings = infer_param_shardings(net.params, self.mesh, self.model_axis)
+        net.params = jax.device_put(net.params, self.param_shardings)
+        # opt state mirrors param shapes (Adam m/v etc.) → same shardings
+        net.opt_state = self._put_like_params(net.opt_state)
+        rep = replicated(self.mesh)
+        net.state = jax.device_put(net.state, rep)
+
+    def _put_like_params(self, opt_state):
+        """Shard each optimizer-state leaf like its corresponding param when
+        shapes match (Adam m/v etc.); replicate scalars/mismatches."""
+        flat_p = jax.tree_util.tree_leaves(self.net.params)
+        shard_by_shape = {}
+        flat_s = jax.tree_util.tree_leaves(self.param_shardings)
+        for a, s in zip(flat_p, flat_s):
+            shard_by_shape.setdefault(a.shape, s)
+        rep = replicated(self.mesh)
+
+        def put(a):
+            return jax.device_put(a, shard_by_shape.get(a.shape, rep))
+
+        return jax.tree_util.tree_map(put, opt_state)
+
+    # -- batch placement ---------------------------------------------------
+
+    def _shard_batch_arr(self, a):
+        if a is None:
+            return None
+        arr = np.asarray(a)
+        dp = self.mesh.shape.get(self.data_axis, 1)
+        if arr.shape[0] % dp != 0:
+            raise ValueError(
+                f"global batch {arr.shape[0]} not divisible by data axis {dp} "
+                "(pad or drop the remainder — XLA needs static shapes)")
+        return jax.device_put(jnp.asarray(arr), self.batch_sharding)
+
+    def _shard_dataset(self, ds: DataSet) -> DataSet:
+        return DataSet(
+            self._shard_batch_arr(ds.features),
+            None if ds.labels is None else jax.tree_util.tree_map(self._shard_batch_arr, ds.labels),
+            self._shard_batch_arr(ds.features_mask),
+            self._shard_batch_arr(ds.labels_mask),
+        )
+
+    # -- training ----------------------------------------------------------
+
+    def fit_batch(self, ds: DataSet) -> float:
+        """One global step: batch split over data axis, grads psum'd by GSPMD."""
+        with jax.sharding.set_mesh(self.mesh):
+            return self.net.fit_batch(self._shard_dataset(ds))
+
+    def fit(self, data, epochs: int = 1) -> List[float]:
+        losses = []
+        it = self.net._as_iterator(data)
+        for _ in range(epochs):
+            for ds in it:
+                losses.append(self.fit_batch(ds))
+            self.net.epoch += 1
+        return losses
+
+    def output(self, x, **kw):
+        with jax.sharding.set_mesh(self.mesh):
+            return self.net.output(self._shard_batch_arr(x), **kw)

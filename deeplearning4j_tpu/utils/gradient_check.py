@@ -43,12 +43,13 @@ def check_gradients(
     m = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
     lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
 
+    @jax.jit
     def score_fn(params):
         loss, _ = net._loss(params, net.state, x, y, train=False, rng=None,
                             mask=m, label_mask=lm)
         return loss
 
-    analytic = jax.grad(score_fn)(net.params)
+    analytic = jax.jit(jax.grad(score_fn))(net.params)
     flat_params, treedef = jax.tree_util.tree_flatten(net.params)
     flat_grads = treedef.flatten_up_to(analytic)
     # Use numpy copies for perturbation

@@ -1,17 +1,24 @@
 """Test configuration: force CPU with 8 virtual devices.
 
 This is the reference's `local[N]` Spark-test analog (SURVEY.md §4.5): all
-multi-device/sharding tests run on a virtual 8-device CPU mesh via
---xla_force_host_platform_device_count, no TPU pod required.  Must run
-before jax initializes its backend, hence top of conftest.
+multi-device/sharding tests run on a virtual 8-device CPU mesh, no TPU pod
+required.
+
+The axon TPU tunnel's sitecustomize imports jax at interpreter startup with
+JAX_PLATFORMS=axon, but backend *clients* initialize lazily — so flipping
+jax.config to cpu here (before any computation) is sufficient, and the
+XLA_FLAGS device-count flag is read when the CPU client is created.
 """
 
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402  (may already be imported by sitecustomize — fine)
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

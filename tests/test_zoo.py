@@ -1,0 +1,83 @@
+"""Model zoo: construction, forward shapes, and a small learning check."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.models import (
+    AlexNet, Darknet19, LeNet, ResNet50, SimpleCNN, TextGenerationLSTM, TinyYOLO,
+    VGG16, ZOO,
+)
+
+
+class TestZooConstruction:
+    def test_lenet_params(self):
+        net = LeNet()
+        assert net.num_params() > 1_000_000
+        out = net.output(np.zeros((2, 28, 28, 1), np.float32))
+        assert out.shape == (2, 10)
+
+    def test_resnet50_structure(self):
+        net = ResNet50(height=32, width=32, num_classes=10)
+        # canonical ResNet-50 conv/bn param count (~23.5M at 10 classes)
+        assert 23_000_000 < net.num_params() < 24_000_000
+        outs = net.output(np.zeros((1, 32, 32, 3), np.float32))
+        assert outs[0].shape == (1, 10)
+
+    def test_simplecnn(self):
+        net = SimpleCNN(height=32, width=32, channels=3, num_classes=5)
+        out = net.output(np.zeros((2, 32, 32, 3), np.float32))
+        assert out.shape == (2, 5)
+
+    def test_textgen_lstm(self):
+        net = TextGenerationLSTM(vocab_size=20, hidden=32)
+        out = net.output(np.zeros((2, 7, 20), np.float32))
+        assert out.shape == (2, 7, 20)
+
+    def test_tinyyolo_grid(self):
+        net = TinyYOLO(height=64, width=64, num_classes=3)
+        out = net.output(np.zeros((1, 64, 64, 3), np.float32))
+        assert out.shape == (1, 2, 2, 5 * (5 + 3))  # 64/32=2 grid, 5 anchors
+
+    def test_zoo_registry(self):
+        assert set(ZOO) >= {"lenet", "resnet50", "vgg16", "alexnet",
+                            "simplecnn", "darknet19", "tinyyolo",
+                            "textgenerationlstm"}
+
+
+class TestZooTraining:
+    def test_lenet_learns_synthetic(self):
+        rng = np.random.default_rng(0)
+        n = 64
+        # class 0: bright top-left quadrant; class 1: bright bottom-right
+        xs = rng.normal(0, 0.1, size=(n, 28, 28, 1)).astype(np.float32)
+        ys_idx = rng.integers(0, 2, n)
+        xs[ys_idx == 0, :14, :14, 0] += 1.0
+        xs[ys_idx == 1, 14:, 14:, 0] += 1.0
+        ys = np.eye(10, dtype=np.float32)[ys_idx]
+        net = LeNet()
+        it = ListDataSetIterator.from_arrays(xs, ys, 32)
+        losses = net.fit(it, epochs=6)
+        assert losses[-1] < 0.5 * losses[0]
+
+    def test_resnet50_trains_step(self):
+        net = ResNet50(height=32, width=32, num_classes=10)
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(4, 32, 32, 3)).astype(np.float32)
+        y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, 4)]
+        l1 = net.fit_batch(DataSet(x, y))
+        l2 = net.fit_batch(DataSet(x, y))
+        assert np.isfinite(l1) and np.isfinite(l2)
+
+    def test_tinyyolo_trains_step(self):
+        net = TinyYOLO(height=32, width=32, num_classes=3)
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(2, 32, 32, 3)).astype(np.float32)
+        grid = 1  # 32 / 32
+        labels = {
+            "boxes": rng.uniform(0, 1, size=(2, grid, grid, 5, 4)).astype(np.float32),
+            "obj": (rng.uniform(size=(2, grid, grid, 5)) > 0.8).astype(np.float32),
+            "cls": np.eye(3, dtype=np.float32)[rng.integers(0, 3, (2, grid, grid))],
+        }
+        loss = net.fit_batch(DataSet(x, labels))
+        assert np.isfinite(loss)
