@@ -1,0 +1,13 @@
+from .earlystopping import (
+    EarlyStoppingConfiguration,
+    EarlyStoppingResult,
+    EarlyStoppingTrainer,
+    DataSetLossCalculator,
+    AccuracyScoreCalculator,
+    MaxEpochsTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    InMemoryModelSaver,
+    LocalFileModelSaver,
+)

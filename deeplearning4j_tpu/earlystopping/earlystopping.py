@@ -1,0 +1,254 @@
+"""Early stopping — config-driven training driver.
+
+Parity target: reference earlystopping/ (EarlyStoppingConfiguration,
+trainer/EarlyStoppingTrainer, 8 termination conditions, scorecalc/
+DataSetLossCalculator, saver/LocalFileModelSaver|InMemoryModelSaver;
+SURVEY.md §2.1 "Early stopping").  Epoch terminations stop between epochs;
+iteration terminations can stop mid-epoch (checked every
+``evaluate_every_n_epochs`` per the reference's semantics).
+"""
+
+from __future__ import annotations
+
+import copy
+import dataclasses
+import os
+import time
+from typing import Any, Callable, List, Optional
+
+import jax
+
+
+# ---------------------------------------------------------------------------
+# score calculators (reference scorecalc/)
+# ---------------------------------------------------------------------------
+
+
+class DataSetLossCalculator:
+    """Validation loss (reference DataSetLossCalculator).  minimize=True."""
+
+    minimize_score = True
+
+    def __init__(self, data):
+        self.data = data
+
+    def calculate_score(self, model) -> float:
+        total, n = 0.0, 0
+        for ds in model._as_iterator(self.data):
+            total += model.score(ds) * ds.num_examples()
+            n += ds.num_examples()
+        return total / max(n, 1)
+
+
+class AccuracyScoreCalculator:
+    """Validation accuracy (maximize)."""
+
+    minimize_score = False
+
+    def __init__(self, data):
+        self.data = data
+
+    def calculate_score(self, model) -> float:
+        return model.evaluate(self.data).accuracy()
+
+
+# ---------------------------------------------------------------------------
+# termination conditions (reference termination/)
+# ---------------------------------------------------------------------------
+
+
+class MaxEpochsTerminationCondition:
+    def __init__(self, max_epochs: int):
+        self.max_epochs = max_epochs
+
+    def terminate(self, epoch: int, score: float, best_score: float) -> bool:
+        return epoch >= self.max_epochs
+
+
+class ScoreImprovementEpochTerminationCondition:
+    """Stop after N epochs without (minimal) improvement (reference
+    ScoreImprovementEpochTerminationCondition)."""
+
+    def __init__(self, max_epochs_without_improvement: int, min_improvement: float = 0.0):
+        self.patience = max_epochs_without_improvement
+        self.min_improvement = min_improvement
+        self._epochs_since_best = 0
+
+    def on_epoch(self, improved: bool) -> None:
+        self._epochs_since_best = 0 if improved else self._epochs_since_best + 1
+
+    def terminate(self, epoch, score, best_score) -> bool:
+        return self._epochs_since_best > self.patience
+
+
+class MaxScoreIterationTerminationCondition:
+    """Abort when the training score explodes past a bound (reference
+    MaxScoreIterationTerminationCondition)."""
+
+    def __init__(self, max_score: float):
+        self.max_score = max_score
+
+    def terminate_iteration(self, score: float) -> bool:
+        import math
+        return (not math.isfinite(score)) or score > self.max_score
+
+
+class MaxTimeIterationTerminationCondition:
+    def __init__(self, max_seconds: float):
+        self.max_seconds = max_seconds
+        self._start: Optional[float] = None
+
+    def terminate_iteration(self, score: float) -> bool:
+        if self._start is None:
+            self._start = time.monotonic()
+            return False
+        return (time.monotonic() - self._start) > self.max_seconds
+
+
+# ---------------------------------------------------------------------------
+# model savers (reference saver/)
+# ---------------------------------------------------------------------------
+
+
+class InMemoryModelSaver:
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    @staticmethod
+    def _snapshot(model):
+        return (jax.tree_util.tree_map(lambda a: a, model.params),
+                jax.tree_util.tree_map(lambda a: a, model.state),
+                jax.tree_util.tree_map(lambda a: a, model.opt_state))
+
+    def save_best(self, model) -> None:
+        self._best = self._snapshot(model)
+
+    def save_latest(self, model) -> None:
+        self._latest = self._snapshot(model)
+
+    def restore_best(self, model) -> None:
+        if self._best is not None:
+            model.params, model.state, model.opt_state = self._best
+
+
+class LocalFileModelSaver:
+    """Best/latest zips in a directory (reference LocalFileModelSaver)."""
+
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self.best_path = os.path.join(directory, "bestModel.zip")
+        self.latest_path = os.path.join(directory, "latestModel.zip")
+
+    def save_best(self, model) -> None:
+        model.save(self.best_path)
+
+    def save_latest(self, model) -> None:
+        model.save(self.latest_path)
+
+    def restore_best(self, model) -> None:
+        if os.path.exists(self.best_path):
+            restored = type(model).load(self.best_path)
+            model.params, model.state, model.opt_state = (
+                restored.params, restored.state, restored.opt_state)
+
+
+# ---------------------------------------------------------------------------
+# configuration + trainer
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class EarlyStoppingConfiguration:
+    score_calculator: Any = None
+    epoch_terminations: List[Any] = dataclasses.field(default_factory=list)
+    iteration_terminations: List[Any] = dataclasses.field(default_factory=list)
+    model_saver: Any = dataclasses.field(default_factory=InMemoryModelSaver)
+    evaluate_every_n_epochs: int = 1
+    save_last_model: bool = False
+
+
+@dataclasses.dataclass
+class EarlyStoppingResult:
+    termination_reason: str
+    termination_details: str
+    score_vs_epoch: List[float]
+    best_model_epoch: int
+    best_model_score: float
+    total_epochs: int
+    best_model: Any
+
+
+class EarlyStoppingTrainer:
+    """Drives fit-epoch / score / save / terminate (reference
+    trainer/EarlyStoppingTrainer + EarlyStoppingGraphTrainer — one class
+    here since MLN and CG share the fit surface)."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, model, train_data):
+        self.config = config
+        self.model = model
+        self.train_data = train_data
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        minimize = getattr(cfg.score_calculator, "minimize_score", True)
+        best_score = float("inf") if minimize else float("-inf")
+        best_epoch = -1
+        scores: List[float] = []
+        epoch = 0
+        reason, details = "MaxEpochs", ""
+
+        while True:
+            # -- one epoch with iteration terminations ----------------------
+            aborted = False
+            for ds in self.model._as_iterator(self.train_data):
+                loss = self.model.fit_batch(ds)
+                for t in cfg.iteration_terminations:
+                    if t.terminate_iteration(loss):
+                        reason = "IterationTermination"
+                        details = f"{type(t).__name__} at loss {loss}"
+                        aborted = True
+                        break
+                if aborted:
+                    break
+            self.model.epoch += 1
+            epoch += 1
+            if aborted:
+                break
+
+            # -- score + save best ------------------------------------------
+            improved = False
+            if cfg.score_calculator is not None and epoch % cfg.evaluate_every_n_epochs == 0:
+                score = cfg.score_calculator.calculate_score(self.model)
+                scores.append(score)
+                improved = score < best_score if minimize else score > best_score
+                if improved:
+                    best_score, best_epoch = score, epoch
+                    cfg.model_saver.save_best(self.model)
+            if cfg.save_last_model:
+                cfg.model_saver.save_latest(self.model)
+
+            # -- epoch terminations -----------------------------------------
+            stop = False
+            for t in cfg.epoch_terminations:
+                if hasattr(t, "on_epoch"):
+                    t.on_epoch(improved)
+                if t.terminate(epoch, scores[-1] if scores else float("nan"), best_score):
+                    reason = "EpochTermination"
+                    details = type(t).__name__
+                    stop = True
+                    break
+            if stop:
+                break
+
+        cfg.model_saver.restore_best(self.model)
+        return EarlyStoppingResult(
+            termination_reason=reason,
+            termination_details=details,
+            score_vs_epoch=scores,
+            best_model_epoch=best_epoch,
+            best_model_score=best_score,
+            total_epochs=epoch,
+            best_model=self.model,
+        )

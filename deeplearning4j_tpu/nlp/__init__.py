@@ -1,0 +1,14 @@
+"""NLP / embeddings (replaces deeplearning4j-nlp-parent, SURVEY.md §2.4).
+
+The reference trains Word2Vec with Hogwild threads mutating shared syn0/syn1
+tables through JNI AggregateSkipGram ops (SequenceVectors.java:292-296,
+SkipGram.java:271-283).  Here training is the TPU-native formulation:
+host-side window/negative sampling feeds a jit-compiled batched
+negative-sampling objective — embedding gathers + batched dot products on
+the MXU, one XLA program per step, no lock-free mutation needed.
+"""
+
+from .tokenization import DefaultTokenizerFactory, CommonPreprocessor
+from .vocab import VocabCache, VocabWord, build_vocab, Huffman
+from .word2vec import Word2Vec
+from .serializer import write_word_vectors, read_word_vectors

@@ -1,0 +1,74 @@
+"""Embedding serialization — word2vec C formats.
+
+Parity target: reference models/embeddings/loader/WordVectorSerializer.java
+(2,824 LoC): read/write the original word2vec C text and binary formats so
+vectors interoperate with gensim/word2vec tooling, plus the framework's own
+loader that reconstructs a queryable table.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Dict, Tuple
+
+import numpy as np
+
+
+def write_word_vectors(model_or_pairs, path: str, binary: bool = False) -> None:
+    """Write 'V D\\n' header + one word per line (text) or packed floats
+    (binary), the word2vec C convention (WordVectorSerializer.writeWordVectors)."""
+    if hasattr(model_or_pairs, "vocab"):
+        vocab = model_or_pairs.vocab
+        vectors = model_or_pairs.syn0
+        items = [(vocab.word_for(i), vectors[i]) for i in range(len(vocab))]
+    else:
+        items = list(model_or_pairs.items())
+    if not items:
+        raise ValueError("no vectors to write")
+    d = len(items[0][1])
+    if binary:
+        with open(path, "wb") as f:
+            f.write(f"{len(items)} {d}\n".encode())
+            for word, vec in items:
+                f.write(word.encode("utf-8") + b" ")
+                f.write(np.asarray(vec, np.float32).tobytes())
+                f.write(b"\n")
+    else:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(f"{len(items)} {d}\n")
+            for word, vec in items:
+                f.write(word + " " + " ".join(f"{x:.6f}" for x in vec) + "\n")
+
+
+def read_word_vectors(path: str, binary: bool = False) -> Dict[str, np.ndarray]:
+    """Inverse of write_word_vectors (WordVectorSerializer.loadTxtVectors /
+    readBinaryModel)."""
+    out: Dict[str, np.ndarray] = {}
+    if binary:
+        with open(path, "rb") as f:
+            header = f.readline().split()
+            n, d = int(header[0]), int(header[1])
+            for _ in range(n):
+                word = bytearray()
+                while True:
+                    ch = f.read(1)
+                    if ch == b" ":
+                        break
+                    if ch == b"":
+                        raise ValueError("truncated binary vectors file")
+                    word.extend(ch)
+                vec = np.frombuffer(f.read(4 * d), dtype=np.float32)
+                out[word.decode("utf-8")] = np.array(vec)
+                nl = f.read(1)
+                if nl not in (b"\n", b""):
+                    f.seek(-1, 1)
+        return out
+    with open(path, "r", encoding="utf-8") as f:
+        header = f.readline().split()
+        n, d = int(header[0]), int(header[1])
+        for line in f:
+            parts = line.rstrip("\n").split(" ")
+            if len(parts) < d + 1:
+                continue
+            out[parts[0]] = np.asarray([float(x) for x in parts[1:d + 1]], np.float32)
+    return out

@@ -1,0 +1,67 @@
+"""Tokenization — TokenizerFactory/Tokenizer + preprocessors.
+
+Parity target: reference text/tokenization/ (DefaultTokenizerFactory wraps
+a streaming whitespace tokenizer; CommonPreprocessor lowercases and strips
+punctuation).  The CJK language packs (chinese/japanese/korean vendored
+analyzers, 19,739 LoC) are out of scope for round 1 — the factory interface
+accepts pluggable tokenizers so they can slot in.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Iterable, List, Optional
+
+
+class CommonPreprocessor:
+    """Lowercase + strip punctuation (reference CommonPreprocessor)."""
+
+    _PUNCT = re.compile(r"[!\"#$%&'()*+,\-./:;<=>?@\[\\\]^_`{|}~«»“”‘’]")
+
+    def pre_process(self, token: str) -> str:
+        return self._PUNCT.sub("", token.lower())
+
+
+class EndingPreProcessor:
+    """Crude English stemmer (reference EndingPreProcessor: strips s/ed/ing/ly)."""
+
+    def pre_process(self, token: str) -> str:
+        for suffix in ("ing", "ed", "ly", "s"):
+            if token.endswith(suffix) and len(token) > len(suffix) + 2:
+                return token[: -len(suffix)]
+        return token
+
+
+class DefaultTokenizerFactory:
+    """Whitespace/regex tokenizer factory (reference DefaultTokenizerFactory)."""
+
+    def __init__(self, preprocessor=None):
+        self.preprocessor = preprocessor or CommonPreprocessor()
+
+    def tokenize(self, sentence: str) -> List[str]:
+        tokens = sentence.split()
+        if self.preprocessor is not None:
+            tokens = [self.preprocessor.pre_process(t) for t in tokens]
+        return [t for t in tokens if t]
+
+
+class LineSentenceIterator:
+    """Sentence-per-line corpus iterator (reference BasicLineIterator)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def __iter__(self) -> Iterable[str]:
+        with open(self.path, "r", encoding="utf-8", errors="replace") as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    yield line
+
+
+class CollectionSentenceIterator:
+    def __init__(self, sentences: List[str]):
+        self.sentences = sentences
+
+    def __iter__(self):
+        return iter(self.sentences)

@@ -1,0 +1,169 @@
+"""Transfer learning — surgery on trained networks.
+
+Parity target: reference nn/transferlearning/TransferLearning.java (847 LoC
+Builder/GraphBuilder), FineTuneConfiguration, TransferLearningHelper
+(featurization), nn/layers/FrozenLayer.
+
+Because params are per-layer dicts (not one flat buffer), surgery is
+structural: freeze = wrap conf layer in FrozenLayer (same param tree, zero
+gradients via stop_gradient); replace/append layers = re-init just those
+entries.  The reference's nOutReplace weight re-init is ``n_out_replace``.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..datasets.dataset import DataSet
+from .layers.base import Layer
+from .layers.special import FrozenLayer
+from .multilayer import MultiLayerConfiguration, MultiLayerNetwork
+
+
+class TransferLearning:
+    """Builder for network surgery (reference TransferLearning.Builder).
+
+    >>> new_net = (TransferLearning(trained)
+    ...            .fine_tune_configuration(updater=Adam(lr=1e-4))
+    ...            .set_feature_extractor(1)        # freeze layers 0..1
+    ...            .remove_output_layer()
+    ...            .add_layer(OutputLayer(n_out=5, activation="softmax"))
+    ...            .build())
+    """
+
+    def __init__(self, net: MultiLayerNetwork):
+        self._src = net
+        self._conf = copy.deepcopy(net.conf)
+        self._params = [dict(p) for p in net.params]
+        self._state = [dict(s) for s in net.state]
+        self._freeze_upto: Optional[int] = None
+        self._appended: List[Layer] = []
+        self._removed = 0
+        self._nout_replace: Optional[tuple] = None
+
+    def fine_tune_configuration(self, updater=None, seed: Optional[int] = None,
+                                **conf_overrides) -> "TransferLearning":
+        """Override global training conf (reference FineTuneConfiguration)."""
+        if updater is not None:
+            self._conf.updater = updater
+        if seed is not None:
+            self._conf.seed = seed
+        for k, v in conf_overrides.items():
+            if not hasattr(self._conf, k):
+                raise ValueError(f"unknown conf field '{k}'")
+            setattr(self._conf, k, v)
+        return self
+
+    def set_feature_extractor(self, layer_index: int) -> "TransferLearning":
+        """Freeze layers [0, layer_index] (reference setFeatureExtractor)."""
+        self._freeze_upto = layer_index
+        return self
+
+    def remove_output_layer(self) -> "TransferLearning":
+        return self.remove_last_layers(1)
+
+    def remove_last_layers(self, n: int) -> "TransferLearning":
+        self._removed += n
+        return self
+
+    def add_layer(self, layer: Layer) -> "TransferLearning":
+        self._appended.append(layer)
+        return self
+
+    def n_out_replace(self, layer_index: int, n_out: int,
+                      weight_init: str = "xavier") -> "TransferLearning":
+        """Change a layer's n_out and re-init it + the next layer's n_in
+        (reference nOutReplace)."""
+        self._nout_replace = (layer_index, n_out, weight_init)
+        return self
+
+    def build(self) -> MultiLayerNetwork:
+        conf = self._conf
+        params = self._params
+        state = self._state
+
+        # 1. remove tail layers
+        if self._removed:
+            conf.layers = conf.layers[:-self._removed]
+            params = params[:-self._removed]
+            state = state[:-self._removed]
+            for i in list(conf.preprocessors):
+                if i >= len(conf.layers):
+                    del conf.preprocessors[i]
+
+        # 2. append new layers (params initialized after type inference)
+        n_carried = len(conf.layers)
+        conf.layers = conf.layers + list(self._appended)
+
+        # 3. nOut replacement
+        if self._nout_replace is not None:
+            idx, n_out, winit = self._nout_replace
+            conf.layers[idx].n_out = n_out
+            conf.layers[idx].weight_init = winit
+            conf.layers[idx].n_in = 0  # re-infer
+            if idx + 1 < len(conf.layers) and hasattr(conf.layers[idx + 1], "n_in"):
+                conf.layers[idx + 1].n_in = 0
+
+        # 4. freeze
+        if self._freeze_upto is not None:
+            for i in range(self._freeze_upto + 1):
+                if not isinstance(conf.layers[i], FrozenLayer):
+                    conf.layers[i] = FrozenLayer(layer=conf.layers[i])
+
+        # 5. build net, re-init, then splice carried params back in
+        net = MultiLayerNetwork(conf)
+        net.init()
+        reinit = set()
+        if self._nout_replace is not None:
+            reinit = {self._nout_replace[0], self._nout_replace[0] + 1}
+        for i in range(min(n_carried, len(conf.layers))):
+            if i in reinit:
+                continue
+            if params[i]:
+                net.params[i] = params[i]
+                net.state[i] = state[i]
+        return net
+
+
+class TransferLearningHelper:
+    """Featurization helper (reference TransferLearningHelper): run the
+    frozen front once per dataset, then train only the unfrozen tail —
+    saving the frozen forward on every epoch."""
+
+    def __init__(self, net: MultiLayerNetwork, frozen_upto: int):
+        self.full = net
+        self.frozen_upto = frozen_upto
+        # tail net: layers after the frozen point, sharing param arrays
+        tail_conf = copy.deepcopy(net.conf)
+        tail_conf.layers = net.conf.layers[frozen_upto + 1:]
+        tail_conf.preprocessors = {
+            i - (frozen_upto + 1): p for i, p in net.conf.preprocessors.items()
+            if i > frozen_upto}
+        tail_conf.input_type = net.input_types[frozen_upto + 1] \
+            if frozen_upto + 1 < len(net.input_types) else net.output_type
+        self.tail = MultiLayerNetwork(tail_conf)
+        self.tail.init()
+        self.tail.params = net.params[frozen_upto + 1:]
+        self.tail.state = net.state[frozen_upto + 1:]
+
+    def featurize(self, ds: DataSet) -> DataSet:
+        """Forward through the frozen front (reference featurize)."""
+        acts = self.full.feed_forward(ds.features)
+        return DataSet(np.asarray(acts[self.frozen_upto]), ds.labels,
+                       ds.features_mask, ds.labels_mask)
+
+    def fit_featurized(self, ds: DataSet, epochs: int = 1):
+        losses = self.tail.fit(ds, epochs=epochs)
+        # write trained tail params back into the full network
+        for j, p in enumerate(self.tail.params):
+            self.full.params[self.frozen_upto + 1 + j] = p
+            self.full.state[self.frozen_upto + 1 + j] = self.tail.state[j]
+        return losses
+
+    def output(self, x):
+        return self.full.output(x)

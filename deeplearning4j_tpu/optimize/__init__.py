@@ -1,0 +1,10 @@
+from .listeners import (
+    TrainingListener,
+    ScoreIterationListener,
+    PerformanceListener,
+    CollectScoresIterationListener,
+    TimeIterationListener,
+    EvaluativeListener,
+    CheckpointListener,
+    ComposableListener,
+)

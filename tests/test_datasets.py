@@ -1,0 +1,144 @@
+"""Datasets: fetchers (IDX parsing, IRIS, synthetic fallback) + native loader."""
+
+import gzip
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.datasets.fetchers import (
+    IrisDataSetIterator, MnistDataSetIterator, load_iris, load_mnist,
+    read_idx_images, read_idx_labels,
+)
+from deeplearning4j_tpu.datasets.native_loader import (
+    NativeDataSetIterator, load_native_lib,
+)
+
+
+class TestIris:
+    def test_shape_and_classes(self):
+        xs, ys = load_iris()
+        assert xs.shape == (150, 4)
+        assert set(np.unique(ys)) == {0, 1, 2}
+        np.testing.assert_allclose(xs[0], [5.1, 3.5, 1.4, 0.2])
+
+    def test_iterator_trains_mlp(self):
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.updaters import Adam
+        it = IrisDataSetIterator(batch_size=50)
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(lr=0.02))
+                .layer(Dense(n_out=16, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        net.fit(it, epochs=60)
+        assert net.evaluate(it).accuracy() > 0.9
+
+
+class TestIdx:
+    def test_roundtrip(self, tmp_path):
+        """Write canonical IDX files, read them back (reference MnistManager)."""
+        imgs = np.arange(2 * 28 * 28, dtype=np.uint8).reshape(2, 28, 28)
+        lbls = np.asarray([3, 7], np.uint8)
+        img_path = os.path.join(tmp_path, "train-images-idx3-ubyte.gz")
+        lbl_path = os.path.join(tmp_path, "train-labels-idx1-ubyte.gz")
+        with gzip.open(img_path, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 2, 28, 28))
+            f.write(imgs.tobytes())
+        with gzip.open(lbl_path, "wb") as f:
+            f.write(struct.pack(">II", 2049, 2))
+            f.write(lbls.tobytes())
+        np.testing.assert_array_equal(read_idx_images(img_path), imgs)
+        np.testing.assert_array_equal(read_idx_labels(lbl_path), lbls)
+
+    def test_load_mnist_from_cache_dir(self, tmp_path, monkeypatch):
+        imgs = np.random.default_rng(0).integers(0, 255, (4, 28, 28)).astype(np.uint8)
+        lbls = np.asarray([0, 1, 2, 3], np.uint8)
+        with open(os.path.join(tmp_path, "train-images-idx3-ubyte"), "wb") as f:
+            f.write(struct.pack(">IIII", 2051, 4, 28, 28))
+            f.write(imgs.tobytes())
+        with open(os.path.join(tmp_path, "train-labels-idx1-ubyte"), "wb") as f:
+            f.write(struct.pack(">II", 2049, 4))
+            f.write(lbls.tobytes())
+        monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+        xs, ys = load_mnist(train=True)
+        assert xs.shape == (4, 28, 28, 1)
+        np.testing.assert_array_equal(ys, lbls)
+        assert xs.max() <= 1.0
+
+    def test_synthetic_fallback_learnable(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))  # empty dir
+        it = MnistDataSetIterator(batch_size=128, allow_synthetic=True,
+                                  synthetic_n=256)
+        total = sum(b.num_examples() for b in it)
+        assert total == 256
+        b = next(iter(it))
+        assert b.features.shape[1:] == (28, 28, 1)
+        assert b.labels.shape[1:] == (10,)
+
+    def test_no_synthetic_raises(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+        with pytest.raises(FileNotFoundError, match="zero-egress"):
+            load_mnist(train=True, allow_synthetic=False)
+
+
+class TestNativeLoader:
+    def test_builds(self):
+        assert load_native_lib() is not None, "g++ build of native loader failed"
+
+    def test_covers_all_examples_shuffled(self):
+        rng = np.random.default_rng(0)
+        xs = rng.normal(size=(100, 7)).astype(np.float32)
+        ys = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 100)]
+        it = NativeDataSetIterator(xs, ys, batch_size=32, seed=5)
+        batches = list(it)
+        assert sum(b.num_examples() for b in batches) == 100
+        assert batches[-1].num_examples() == 4  # remainder kept
+        # every source row appears exactly once
+        seen = np.concatenate([b.features for b in batches])
+        assert seen.shape == (100, 7)
+        src_sorted = xs[np.lexsort(xs.T)]
+        seen_sorted = seen[np.lexsort(seen.T)]
+        np.testing.assert_allclose(src_sorted, seen_sorted)
+        # and it IS shuffled
+        assert not np.allclose(seen, xs)
+        it.close()
+
+    def test_reset_reshuffles_deterministically(self):
+        xs = np.arange(60, dtype=np.float32).reshape(60, 1)
+        it = NativeDataSetIterator(xs, None, batch_size=20, seed=9)
+        e1 = np.concatenate([b.features for b in it])[:, 0]
+        e2 = np.concatenate([b.features for b in it])[:, 0]
+        assert not np.array_equal(e1, e2)  # new shuffle per epoch
+        assert set(e1) == set(e2) == set(range(60))
+        it2 = NativeDataSetIterator(xs, None, batch_size=20, seed=9)
+        e1b = np.concatenate([b.features for b in it2])[:, 0]
+        np.testing.assert_array_equal(e1, e1b)  # same seed → same order
+        it.close()
+        it2.close()
+
+    def test_trains_net(self):
+        rng = np.random.default_rng(1)
+        centers = rng.normal(size=(3, 8)) * 3
+        idx = rng.integers(0, 3, 192)
+        xs = (centers[idx] + rng.normal(size=(192, 8))).astype(np.float32)
+        ys = np.eye(3, dtype=np.float32)[idx]
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.updaters import Adam
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(lr=1e-2))
+                .layer(Dense(n_out=16, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(8)).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        it = NativeDataSetIterator(xs, ys, batch_size=64, seed=2)
+        losses = net.fit(it, epochs=15)
+        assert losses[-1] < 0.3 * losses[0]
+        it.close()

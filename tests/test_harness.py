@@ -1,0 +1,170 @@
+"""Training harness: listeners, early stopping, transfer learning."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    InMemoryModelSaver, LocalFileModelSaver, MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition, ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+from deeplearning4j_tpu.nn.layers.special import FrozenLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.transferlearning import TransferLearning, TransferLearningHelper
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.optimize import (
+    CheckpointListener, CollectScoresIterationListener, PerformanceListener,
+    ScoreIterationListener,
+)
+
+
+def blobs(n=256, f=10, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(classes, f)) * 3
+    ys = rng.integers(0, classes, size=n)
+    xs = (centers[ys] + rng.normal(size=(n, f))).astype(np.float32)
+    return xs, np.eye(classes, dtype=np.float32)[ys]
+
+
+def mlp(f=10, classes=3, seed=1, lr=1e-2):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(lr=lr))
+            .layer(Dense(n_out=32, activation="relu"))
+            .layer(Dense(n_out=16, activation="relu"))
+            .layer(OutputLayer(n_out=classes, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(f)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+class TestListeners:
+    def test_score_and_collect(self):
+        xs, ys = blobs(n=64)
+        net = mlp()
+        logged = []
+        net.set_listeners(ScoreIterationListener(1, out=logged.append),
+                          CollectScoresIterationListener())
+        net.fit(ListDataSetIterator.from_arrays(xs, ys, 32), epochs=2)
+        assert len(logged) == 4
+        collect = net.listeners[1]
+        assert [it for it, _ in collect.scores] == [1, 2, 3, 4]
+
+    def test_performance_listener(self):
+        xs, ys = blobs(n=128)
+        net = mlp()
+        perf = PerformanceListener(report_every=2, out=lambda s: None)
+        perf.set_batch_size(32)
+        net.set_listeners(perf)
+        net.fit(ListDataSetIterator.from_arrays(xs, ys, 32), epochs=2)
+        assert perf.history and perf.history[0][0] > 0
+
+    def test_checkpoint_listener(self, tmp_path):
+        xs, ys = blobs(n=64)
+        net = mlp()
+        ckpt = CheckpointListener(str(tmp_path), save_every_iterations=2, keep_last=2)
+        net.set_listeners(ckpt)
+        net.fit(ListDataSetIterator.from_arrays(xs, ys, 16), epochs=2)
+        assert len(ckpt.saved) == 2  # rotation kept last 2
+        assert all(os.path.exists(p) for p in ckpt.saved)
+        restored = MultiLayerNetwork.load(ckpt.saved[-1])
+        assert restored.num_params() == net.num_params()
+
+
+class TestEarlyStopping:
+    def test_max_epochs(self):
+        xs, ys = blobs()
+        net = mlp()
+        conf = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(DataSet(xs, ys)),
+            epoch_terminations=[MaxEpochsTerminationCondition(3)])
+        result = EarlyStoppingTrainer(conf, net, ListDataSetIterator.from_arrays(xs, ys, 64)).fit()
+        assert result.total_epochs == 3
+        assert result.termination_reason == "EpochTermination"
+        assert len(result.score_vs_epoch) == 3
+        # improving problem → best near the end
+        assert result.best_model_epoch >= 2
+
+    def test_score_improvement_patience(self):
+        xs, ys = blobs(n=64)
+        # tiny lr → no meaningful improvement → patience fires
+        net = mlp(lr=1e-9)
+        conf = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(DataSet(xs, ys)),
+            epoch_terminations=[
+                MaxEpochsTerminationCondition(50),
+                ScoreImprovementEpochTerminationCondition(2, min_improvement=1e-3)])
+        result = EarlyStoppingTrainer(conf, net, ListDataSetIterator.from_arrays(xs, ys, 64)).fit()
+        assert result.total_epochs < 50
+
+    def test_max_score_abort(self):
+        xs, ys = blobs(n=64)
+        net = mlp(lr=1e3)  # absurd lr → exploding loss
+        conf = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(DataSet(xs, ys)),
+            epoch_terminations=[MaxEpochsTerminationCondition(20)],
+            iteration_terminations=[MaxScoreIterationTerminationCondition(50.0)])
+        result = EarlyStoppingTrainer(conf, net, ListDataSetIterator.from_arrays(xs, ys, 64)).fit()
+        assert result.termination_reason == "IterationTermination"
+
+    def test_local_file_saver_restores_best(self, tmp_path):
+        xs, ys = blobs()
+        net = mlp()
+        saver = LocalFileModelSaver(str(tmp_path))
+        conf = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(DataSet(xs, ys)),
+            epoch_terminations=[MaxEpochsTerminationCondition(2)],
+            model_saver=saver)
+        result = EarlyStoppingTrainer(conf, net, ListDataSetIterator.from_arrays(xs, ys, 64)).fit()
+        assert os.path.exists(saver.best_path)
+        best_score_again = DataSetLossCalculator(DataSet(xs, ys)).calculate_score(result.best_model)
+        np.testing.assert_allclose(best_score_again, result.best_model_score, rtol=1e-4)
+
+
+class TestTransferLearning:
+    def test_freeze_and_replace_head(self):
+        xs, ys = blobs(classes=3)
+        src = mlp(classes=3)
+        src.fit(ListDataSetIterator.from_arrays(xs, ys, 64), epochs=5)
+        frozen_w = np.asarray(src.params[0]["W"])
+
+        # new 4-class problem reusing the feature extractor
+        xs2, ys2 = blobs(classes=4, seed=9)
+        new_net = (TransferLearning(src)
+                   .fine_tune_configuration(updater=Adam(lr=1e-2))
+                   .set_feature_extractor(1)
+                   .remove_output_layer()
+                   .add_layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+                   .build())
+        assert isinstance(new_net.conf.layers[0], FrozenLayer)
+        new_net.fit(ListDataSetIterator.from_arrays(xs2, ys2, 64), epochs=8)
+        # frozen weights unchanged after training
+        np.testing.assert_array_equal(np.asarray(new_net.params[0]["W"]), frozen_w)
+        assert new_net.evaluate(ListDataSetIterator.from_arrays(xs2, ys2, 64)).accuracy() > 0.7
+
+    def test_nout_replace(self):
+        src = mlp()
+        new_net = (TransferLearning(src)
+                   .n_out_replace(1, 24)
+                   .build())
+        assert new_net.conf.layers[1].n_out == 24
+        assert new_net.params[1]["W"].shape == (32, 24)
+        assert new_net.params[2]["W"].shape == (24, 3)
+        # untouched layer keeps source params
+        np.testing.assert_array_equal(np.asarray(new_net.params[0]["W"]),
+                                      np.asarray(src.params[0]["W"]))
+
+    def test_helper_featurize(self):
+        xs, ys = blobs()
+        src = mlp()
+        helper = TransferLearningHelper(src, frozen_upto=0)
+        feats = helper.featurize(DataSet(xs, ys))
+        assert feats.features.shape == (256, 32)
+        losses = helper.fit_featurized(feats, epochs=10)
+        assert losses[-1] < losses[0]
+        out = helper.output(xs)
+        assert out.shape == (256, 3)

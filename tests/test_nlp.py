@@ -1,0 +1,109 @@
+"""NLP: tokenization, vocab/Huffman, Word2Vec learning, serialization.
+
+The learning test uses a synthetic corpus with two disjoint topic clusters:
+words co-occurring within a topic must end up closer than across topics —
+a real semantic check, not just a smoke test.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    CommonPreprocessor, DefaultTokenizerFactory, Huffman, Word2Vec,
+    build_vocab, read_word_vectors, write_word_vectors,
+)
+
+
+def topic_corpus(n_sentences=400, seed=0):
+    """Two topics with disjoint vocab; sentences stay within one topic."""
+    rng = np.random.default_rng(seed)
+    topics = [
+        ["cat", "dog", "pet", "fur", "paw", "tail", "meow", "bark"],
+        ["cpu", "ram", "disk", "code", "byte", "chip", "core", "cache"],
+    ]
+    sentences = []
+    for _ in range(n_sentences):
+        words = rng.choice(topics[int(rng.integers(0, 2))], size=8)
+        sentences.append(" ".join(words))
+    return sentences
+
+
+class TestTokenization:
+    def test_default_tokenizer(self):
+        toks = DefaultTokenizerFactory().tokenize("Hello, World! Foo-bar.")
+        assert toks == ["hello", "world", "foobar"]
+
+    def test_preprocessor(self):
+        assert CommonPreprocessor().pre_process("Don't!") == "dont"
+
+
+class TestVocab:
+    def test_build_and_filter(self):
+        corpus = [["a", "a", "a", "b", "b", "c"]] * 2
+        vocab = build_vocab(corpus, min_word_frequency=3)
+        assert "a" in vocab and "b" in vocab and "c" not in vocab
+        assert vocab.count_of("a") == 6
+        assert vocab.index_of("a") == 0  # frequency-sorted
+
+    def test_huffman_codes(self):
+        corpus = [["a"] * 8 + ["b"] * 4 + ["c"] * 2 + ["d"]]
+        vocab = build_vocab(corpus, min_word_frequency=1)
+        h = Huffman(vocab)
+        words = {w.word: w for w in vocab.words}
+        # most frequent word gets the shortest code
+        assert len(words["a"].codes) <= len(words["d"].codes)
+        # prefix-free: no code is a prefix of another
+        codes = ["".join(map(str, w.codes)) for w in vocab.words]
+        for i, c1 in enumerate(codes):
+            for j, c2 in enumerate(codes):
+                if i != j:
+                    assert not c2.startswith(c1)
+
+    def test_unigram_table(self):
+        corpus = [["x"] * 9 + ["y"]]
+        vocab = build_vocab(corpus, min_word_frequency=1)
+        p = vocab.unigram_table()
+        assert p[vocab.index_of("x")] > p[vocab.index_of("y")]
+        np.testing.assert_allclose(p.sum(), 1.0)
+
+
+class TestWord2Vec:
+    @pytest.mark.parametrize("mode", ["sg_neg", "cbow", "sg_hs"])
+    def test_topics_separate(self, mode):
+        # batch 128: with a 16-word test vocab, per-row update averaging
+        # makes huge batches converge slowly — real vocabs are ≫ batch
+        w2v = Word2Vec(layer_size=32, window=3, min_word_frequency=2,
+                       negative=5, epochs=12, batch_size=128, seed=1,
+                       learning_rate=0.05, subsampling=0,
+                       cbow=(mode == "cbow"), hierarchic_softmax=(mode == "sg_hs"))
+        w2v.fit(topic_corpus())
+        assert len(w2v.vocab) == 16
+        within = w2v.similarity("cat", "dog")
+        across = w2v.similarity("cat", "cpu")
+        assert within > across + 0.2, f"{mode}: within={within:.3f} across={across:.3f}"
+        nearest = w2v.words_nearest("cat", top_n=7)
+        animal = {"dog", "pet", "fur", "paw", "tail", "meow", "bark"}
+        assert len(set(nearest) & animal) >= 5, nearest
+
+    def test_serializer_roundtrip_text(self, tmp_path):
+        w2v = Word2Vec(layer_size=16, min_word_frequency=2, epochs=2, seed=0)
+        w2v.fit(topic_corpus(100))
+        path = str(tmp_path / "vecs.txt")
+        write_word_vectors(w2v, path)
+        loaded = read_word_vectors(path)
+        assert set(loaded) == {w.word for w in w2v.vocab.words}
+        np.testing.assert_allclose(loaded["cat"], w2v.word_vector("cat"),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_serializer_roundtrip_binary(self, tmp_path):
+        w2v = Word2Vec(layer_size=16, min_word_frequency=2, epochs=2, seed=0)
+        w2v.fit(topic_corpus(100))
+        path = str(tmp_path / "vecs.bin")
+        write_word_vectors(w2v, path, binary=True)
+        loaded = read_word_vectors(path, binary=True)
+        np.testing.assert_allclose(loaded["dog"], w2v.word_vector("dog"),
+                                   rtol=1e-6)
+
+    def test_empty_vocab_raises(self):
+        with pytest.raises(ValueError, match="vocabulary"):
+            Word2Vec(min_word_frequency=100).fit(["one two three"])
