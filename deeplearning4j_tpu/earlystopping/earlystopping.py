@@ -105,6 +105,34 @@ class MaxTimeIterationTerminationCondition:
         return (time.monotonic() - self._start) > self.max_seconds
 
 
+class InvalidScoreIterationTerminationCondition:
+    """Abort immediately on NaN/Inf training score — the divergence guard
+    (reference termination/InvalidScoreIterationTerminationCondition)."""
+
+    def terminate_iteration(self, score: float) -> bool:
+        import math
+        return not math.isfinite(score)
+
+
+class BestScoreEpochTerminationCondition:
+    """Stop once the validation score is at least as good as a target
+    (reference termination/BestScoreEpochTerminationCondition)."""
+
+    def __init__(self, best_expected_score: float,
+                 minimize: Optional[bool] = None):
+        # minimize=None inherits the direction from the score calculator at
+        # fit time, so a maximizing calculator (accuracy) can't silently be
+        # paired with a minimizing threshold
+        self.best_expected_score = best_expected_score
+        self.minimize = minimize
+
+    def terminate(self, epoch, score, best_score) -> bool:
+        minimize = True if self.minimize is None else self.minimize
+        if minimize:
+            return score <= self.best_expected_score
+        return score >= self.best_expected_score
+
+
 # ---------------------------------------------------------------------------
 # model savers (reference saver/)
 # ---------------------------------------------------------------------------
@@ -193,6 +221,9 @@ class EarlyStoppingTrainer:
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
         minimize = getattr(cfg.score_calculator, "minimize_score", True)
+        for t in cfg.epoch_terminations:
+            if getattr(t, "minimize", False) is None:
+                t.minimize = minimize
         best_score = float("inf") if minimize else float("-inf")
         best_epoch = -1
         scores: List[float] = []

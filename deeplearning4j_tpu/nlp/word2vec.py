@@ -29,21 +29,26 @@ from .vocab import Huffman, VocabCache, build_vocab
 logger = logging.getLogger("deeplearning4j_tpu")
 
 
-def _occurrence_scale(indices: jnp.ndarray, vocab_size: int) -> jnp.ndarray:
-    """1/count(row) per entry: rows hit k times in one batch receive the
-    AVERAGE of their k updates, not the sum.  A batch applies updates
+def _occurrence_scale(indices: jnp.ndarray, vocab_size: int,
+                      weights: jnp.ndarray) -> jnp.ndarray:
+    """weights/count(row) per entry: rows hit k times in one batch receive
+    the AVERAGE of their k updates, not the sum.  A batch applies updates
     against stale table values, so summing k near-identical updates
     multiplies the effective lr by k and diverges on small vocabs; averaging
-    recovers sequential-SGD magnitude (the Hogwild path's implicit behavior)."""
-    counts = jnp.zeros((vocab_size,), jnp.float32).at[indices].add(1.0)
-    return 1.0 / jnp.maximum(counts[indices], 1.0)
+    recovers sequential-SGD magnitude (the Hogwild path's implicit behavior).
+
+    `weights` is 1.0 for genuine entries and 0.0 for padding, so pad slots
+    (which alias index 0 — the most frequent word) neither receive updates
+    nor dilute the occurrence counts of real entries."""
+    counts = jnp.zeros((vocab_size,), jnp.float32).at[indices].add(weights)
+    return weights / jnp.maximum(counts[indices], 1.0)
 
 
 @partial(jax.jit, donate_argnums=(0, 1))
-def _sg_neg_step(syn0, syn1, centers, contexts, negatives, lr):
+def _sg_neg_step(syn0, syn1, centers, contexts, negatives, valid, lr):
     """Skip-gram negative-sampling sparse update.
 
-    centers [B], contexts [B], negatives [B,K].  Returns updated tables.
+    centers [B], contexts [B], negatives [B,K], valid [B] (0 = pad row).
     Classic updates (Mikolov 2013):
         for target t with label l:  g = (l - σ(v·u_t)) * lr
         v      += Σ g * u_t ;  u_t += g * v
@@ -53,39 +58,76 @@ def _sg_neg_step(syn0, syn1, centers, contexts, negatives, lr):
     labels = jnp.zeros(targets.shape, syn0.dtype).at[:, 0].set(1.0)
     u = syn1[targets]                         # [B,1+K,D]
     score = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", v, u))
-    g = (labels - score) * lr                 # [B,1+K]
+    g = (labels - score) * lr * valid[:, None]  # [B,1+K]
     dv = jnp.einsum("bk,bkd->bd", g, u)
     du = g[..., None] * v[:, None, :]         # [B,1+K,D]
     flat_t = targets.reshape(-1)
-    syn0 = syn0.at[centers].add(dv * _occurrence_scale(centers, syn0.shape[0])[:, None])
-    syn1 = syn1.at[flat_t].add(du.reshape(-1, du.shape[-1])
-                               * _occurrence_scale(flat_t, syn1.shape[0])[:, None])
+    flat_tw = jnp.broadcast_to(valid[:, None], targets.shape).reshape(-1)
+    syn0 = syn0.at[centers].add(
+        dv * _occurrence_scale(centers, syn0.shape[0], valid)[:, None])
+    syn1 = syn1.at[flat_t].add(
+        du.reshape(-1, du.shape[-1])
+        * _occurrence_scale(flat_t, syn1.shape[0], flat_tw)[:, None])
     return syn0, syn1
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
-def _cbow_neg_step(syn0, syn1, context_windows, window_mask, targets_pos,
-                   negatives, lr):
-    """CBOW negative sampling: input = mean of context vectors
-    (reference CBOW.java:104-209)."""
+def _cbow_chunk(syn0, syn1, context_windows, window_mask, targets_pos,
+                negatives, lr):
+    """One CBOW negative-sampling micro-chunk: input = mean of context
+    vectors; the full output-side gradient is added to EVERY context word,
+    matching reference CBOW.java:104-209 (neu1e accumulated once, applied
+    undivided per word).  Pad rows have an all-zero window_mask and
+    contribute nothing."""
     ctx = syn0[context_windows]               # [B,W,D]
     m = window_mask[..., None]
+    valid = (jnp.sum(window_mask, axis=1) > 0).astype(syn0.dtype)  # [B]
     denom = jnp.maximum(jnp.sum(window_mask, axis=1, keepdims=True), 1.0)
     h = jnp.sum(ctx * m, axis=1) / denom      # [B,D]
     targets = jnp.concatenate([targets_pos[:, None], negatives], axis=1)
     labels = jnp.zeros(targets.shape, syn0.dtype).at[:, 0].set(1.0)
     u = syn1[targets]
     score = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, u))
-    g = (labels - score) * lr
-    dh = jnp.einsum("bk,bkd->bd", g, u) / denom        # spread over window
+    g = (labels - score) * lr * valid[:, None]
+    dh = jnp.einsum("bk,bkd->bd", g, u)       # full neu1e per context word
     du = g[..., None] * h[:, None, :]
     flat_t = targets.reshape(-1)
-    syn1 = syn1.at[flat_t].add(du.reshape(-1, du.shape[-1])
-                               * _occurrence_scale(flat_t, syn1.shape[0])[:, None])
+    flat_tw = jnp.broadcast_to(valid[:, None], targets.shape).reshape(-1)
+    syn1 = syn1.at[flat_t].add(
+        du.reshape(-1, du.shape[-1])
+        * _occurrence_scale(flat_t, syn1.shape[0], flat_tw)[:, None])
     dctx = jnp.broadcast_to(dh[:, None, :], ctx.shape) * m
     flat_c = context_windows.reshape(-1)
-    syn0 = syn0.at[flat_c].add(dctx.reshape(-1, dctx.shape[-1])
-                               * _occurrence_scale(flat_c, syn0.shape[0])[:, None])
+    flat_cw = window_mask.reshape(-1)
+    syn0 = syn0.at[flat_c].add(
+        dctx.reshape(-1, dctx.shape[-1])
+        * _occurrence_scale(flat_c, syn0.shape[0], flat_cw)[:, None])
+    return syn0, syn1
+
+
+@partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
+def _cbow_neg_step(syn0, syn1, context_windows, window_mask, targets_pos,
+                   negatives, lr, chunks=1):
+    """CBOW step: lax.scan over `chunks` micro-chunks, each re-reading the
+    freshly updated tables.  CBOW emits one row per center word (~2·window
+    fewer rows than skip-gram), so whole-batch averaging starves it of
+    effective sequential steps on small vocabs; chunked application restores
+    the reference's sequential-SGD semantics while keeping batched matmuls."""
+    if chunks <= 1:
+        return _cbow_chunk(syn0, syn1, context_windows, window_mask,
+                           targets_pos, negatives, lr)
+
+    def body(tables, args):
+        s0, s1 = tables
+        c, m, t, n = args
+        return _cbow_chunk(s0, s1, c, m, t, n, lr), None
+
+    def split(a):
+        return a.reshape(chunks, a.shape[0] // chunks, *a.shape[1:])
+
+    (syn0, syn1), _ = jax.lax.scan(
+        body, (syn0, syn1),
+        (split(context_windows), split(window_mask), split(targets_pos),
+         split(negatives)))
     return syn0, syn1
 
 
@@ -93,18 +135,22 @@ def _cbow_neg_step(syn0, syn1, context_windows, window_mask, targets_pos,
 def _sg_hs_step(syn0, syn1hs, centers, points, codes, code_mask, lr):
     """Skip-gram hierarchical softmax: walk the Huffman path
     (reference SkipGram iterateSample hierarchic-softmax branch).
-    points/codes [B,L] padded, code_mask [B,L]."""
+    points/codes [B,L] padded, code_mask [B,L] (all-zero row = pad)."""
     v = syn0[centers]                          # [B,D]
     u = syn1hs[points]                         # [B,L,D]
+    valid = (jnp.sum(code_mask, axis=1) > 0).astype(syn0.dtype)  # [B]
     score = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", v, u))
     # label = 1 - code (word2vec convention)
     g = ((1.0 - codes) - score) * lr * code_mask
     dv = jnp.einsum("bl,bld->bd", g, u)
     du = g[..., None] * v[:, None, :]
     flat_p = points.reshape(-1)
-    syn0 = syn0.at[centers].add(dv * _occurrence_scale(centers, syn0.shape[0])[:, None])
-    syn1hs = syn1hs.at[flat_p].add(du.reshape(-1, du.shape[-1])
-                                   * _occurrence_scale(flat_p, syn1hs.shape[0])[:, None])
+    flat_pw = code_mask.reshape(-1)
+    syn0 = syn0.at[centers].add(
+        dv * _occurrence_scale(centers, syn0.shape[0], valid)[:, None])
+    syn1hs = syn1hs.at[flat_p].add(
+        du.reshape(-1, du.shape[-1])
+        * _occurrence_scale(flat_p, syn1hs.shape[0], flat_pw)[:, None])
     return syn0, syn1hs
 
 
@@ -194,13 +240,14 @@ class Word2Vec:
             if not pairs_c:
                 return
             n = len(pairs_c)
-            # pad to the fixed batch shape so XLA compiles once
+            # pad to the fixed batch shape so XLA compiles once; pad rows are
+            # masked out via `valid` (they never alias word 0's updates)
             pad = self.batch_size - n
             centers = np.asarray(pairs_c + [0] * pad, np.int32)
             targets = np.asarray(pairs_t + [0] * pad, np.int32)
-            lr_vec = np.zeros(self.batch_size, np.float32)
-            lr_vec[:n] = current_lr()
-            lr_j = jnp.asarray(lr_vec)[:, None]
+            valid = np.zeros(self.batch_size, np.float32)
+            valid[:n] = 1.0
+            lr_j = jnp.asarray(current_lr(), jnp.float32)
             if self.hs:
                 L = max_code
                 pts = np.zeros((self.batch_size, L), np.int32)
@@ -214,8 +261,7 @@ class Word2Vec:
                     msk[i, :l] = 1.0
                 syn0, syn1 = _sg_hs_step(syn0, syn1, jnp.asarray(centers),
                                          jnp.asarray(pts), jnp.asarray(cds),
-                                         jnp.asarray(msk),
-                                         jnp.asarray(current_lr(), jnp.float32))
+                                         jnp.asarray(msk), lr_j)
             elif self.cbow:
                 W = 2 * self.window
                 ctx = np.zeros((self.batch_size, W), np.int32)
@@ -226,16 +272,19 @@ class Word2Vec:
                     msk[i, :l] = 1.0
                 negs = rng.choice(len(unigram), size=(self.batch_size, self.negative),
                                   p=unigram).astype(np.int32)
+                chunks = max(1, self.batch_size // 32)
+                while self.batch_size % chunks:   # nearest divisor ≤ B/32
+                    chunks -= 1
                 syn0, syn1 = _cbow_neg_step(syn0, syn1, jnp.asarray(ctx),
                                             jnp.asarray(msk),
                                             jnp.asarray(targets), jnp.asarray(negs),
-                                            lr_j)
+                                            lr_j, chunks)
             else:
                 negs = rng.choice(len(unigram), size=(self.batch_size, self.negative),
                                   p=unigram).astype(np.int32)
                 syn0, syn1 = _sg_neg_step(syn0, syn1, jnp.asarray(centers),
                                           jnp.asarray(targets), jnp.asarray(negs),
-                                          lr_j)
+                                          jnp.asarray(valid), lr_j)
             pairs_c, pairs_t, cbow_ctx = [], [], []
 
         for _ in range(self.epochs):

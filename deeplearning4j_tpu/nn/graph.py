@@ -124,6 +124,9 @@ class SubsetVertex(GraphVertex):
         t = in_types[0]
         if t.kind == "rnn":
             return InputType.recurrent(n, t.timesteps)
+        if t.kind == "cnn":
+            # forward() slices the channel (last, NHWC) axis
+            return InputType.convolutional(t.height, t.width, n)
         return InputType.feed_forward(n)
 
 
@@ -416,6 +419,7 @@ class ComputationGraph:
         self._jit_step = None
         self._jit_output = None
         self._rng = jax.random.PRNGKey(conf.seed)
+        self._spec_by_name = {v.name: v for v in conf.vertices}
         self.topo_order = self._topological_sort()
         self.vertex_in_types: Dict[str, List[InputType]] = {}
         self.vertex_out_types: Dict[str, InputType] = {}
@@ -425,7 +429,7 @@ class ComputationGraph:
 
     def _topological_sort(self) -> List[str]:
         """Kahn topo sort of vertex names (reference topo sort :394,727-742)."""
-        spec_by_name = {v.name: v for v in self.conf.vertices}
+        spec_by_name = self._spec_by_name
         for s in self.conf.vertices:
             for inp in s.inputs:
                 if inp not in spec_by_name and inp not in self.conf.network_inputs:
@@ -455,10 +459,7 @@ class ComputationGraph:
         return result
 
     def _spec(self, name: str) -> VertexSpec:
-        for v in self.conf.vertices:
-            if v.name == name:
-                return v
-        raise KeyError(name)
+        return self._spec_by_name[name]
 
     def _infer_types(self) -> None:
         types: Dict[str, InputType] = dict(self.conf.input_types)

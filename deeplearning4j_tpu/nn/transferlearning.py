@@ -144,8 +144,16 @@ class TransferLearningHelper:
         tail_conf.preprocessors = {
             i - (frozen_upto + 1): p for i, p in net.conf.preprocessors.items()
             if i > frozen_upto}
-        tail_conf.input_type = net.input_types[frozen_upto + 1] \
-            if frozen_upto + 1 < len(net.input_types) else net.output_type
+        # tail input = OUTPUT type of layer frozen_upto, pre-preprocessor:
+        # the carried-over preprocessor at tail index 0 will re-apply its
+        # transform during _infer_types, and featurize() emits raw layer
+        # activations — using the post-preprocessor type here would apply
+        # the transform twice.
+        if frozen_upto + 1 < len(net.input_types):
+            tail_conf.input_type = net.conf.layers[frozen_upto].output_type(
+                net.input_types[frozen_upto])
+        else:
+            tail_conf.input_type = net.output_type
         self.tail = MultiLayerNetwork(tail_conf)
         self.tail.init()
         self.tail.params = net.params[frozen_upto + 1:]

@@ -42,9 +42,13 @@ class Loss:
     nn/multilayer/MultiLayerNetwork.java score accumulation).
     """
 
-    def __init__(self, name: str, elementwise: Callable[[Array, Array], Array]):
+    def __init__(self, name: str, elementwise: Callable[[Array, Array], Array],
+                 feature_mean: bool = False):
         self.name = name
         self._elementwise = elementwise
+        # reference: LossMSE = LossL2 / nOut, LossMAE = LossL1 / nOut
+        # (per-example score averaged, not summed, over output columns)
+        self._feature_mean = feature_mean
 
     def per_element(self, labels: Array, preout: Array, activation="identity") -> Array:
         if self.name in ("mcxent", "negativeloglikelihood") and _act_name(activation) == "softmax":
@@ -67,7 +71,10 @@ class Loss:
         el = self.per_element(labels, preout, activation)
         if mask is not None:
             el = el * _broadcast_mask(mask, el.shape)
-        return jnp.sum(el, axis=-1)
+        s = jnp.sum(el, axis=-1)
+        if self._feature_mean:
+            s = s / el.shape[-1]
+        return s
 
     def __call__(
         self,
@@ -171,10 +178,10 @@ def _msle(y, out):
 
 
 _REGISTRY = {
-    "mse": Loss("mse", _mse),
+    "mse": Loss("mse", _mse, feature_mean=True),
     "l2": Loss("l2", _l2),
     "l1": Loss("l1", _l1),
-    "mae": Loss("mae", _mae),
+    "mae": Loss("mae", _mae, feature_mean=True),
     "xent": Loss("xent", _xent),
     "mcxent": Loss("mcxent", _mcxent),
     "negativeloglikelihood": Loss("negativeloglikelihood", _mcxent),

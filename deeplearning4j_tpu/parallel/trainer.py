@@ -61,19 +61,33 @@ class ShardedTrainer:
         net.state = jax.device_put(net.state, rep)
 
     def _put_like_params(self, opt_state):
-        """Shard each optimizer-state leaf like its corresponding param when
-        shapes match (Adam m/v etc.); replicate scalars/mismatches."""
-        flat_p = jax.tree_util.tree_leaves(self.net.params)
-        shard_by_shape = {}
-        flat_s = jax.tree_util.tree_leaves(self.param_shardings)
-        for a, s in zip(flat_p, flat_s):
-            shard_by_shape.setdefault(a.shape, s)
+        """Shard optimizer state structurally: per layer, each state subtree
+        whose pytree structure matches the layer's params (Adam m/v,
+        Nesterovs momentum, ...) gets the params' shardings leaf-for-leaf;
+        anything else (scalars, mismatched trees) is replicated.  Structural
+        mapping — never keyed by leaf shape — so per-layer sharding
+        overrides can't silently leak across same-shaped layers."""
         rep = replicated(self.mesh)
 
-        def put(a):
-            return jax.device_put(a, shard_by_shape.get(a.shape, rep))
+        def place_layer(os_layer, p_layer, s_layer):
+            if not os_layer:
+                return os_layer
+            p_struct = jax.tree_util.tree_structure(p_layer)
 
-        return jax.tree_util.tree_map(put, opt_state)
+            def place_sub(sub):
+                if jax.tree_util.tree_structure(sub) == p_struct:
+                    return jax.tree_util.tree_map(jax.device_put, sub, s_layer)
+                return jax.tree_util.tree_map(
+                    lambda a: jax.device_put(a, rep), sub)
+
+            return {k: place_sub(v) for k, v in os_layer.items()}
+
+        params, shardings = self.net.params, self.param_shardings
+        if isinstance(opt_state, list):
+            return [place_layer(os, p, s)
+                    for os, p, s in zip(opt_state, params, shardings)]
+        return {k: place_layer(v, params[k], shardings[k])
+                for k, v in opt_state.items()}
 
     # -- batch placement ---------------------------------------------------
 

@@ -7,9 +7,11 @@ import pytest
 
 from deeplearning4j_tpu.datasets import DataSet, ListDataSetIterator
 from deeplearning4j_tpu.earlystopping import (
-    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
-    InMemoryModelSaver, LocalFileModelSaver, MaxEpochsTerminationCondition,
-    MaxScoreIterationTerminationCondition, ScoreImprovementEpochTerminationCondition,
+    BestScoreEpochTerminationCondition, DataSetLossCalculator,
+    EarlyStoppingConfiguration, EarlyStoppingTrainer, InMemoryModelSaver,
+    InvalidScoreIterationTerminationCondition, LocalFileModelSaver,
+    MaxEpochsTerminationCondition, MaxScoreIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
 )
 from deeplearning4j_tpu.nn.conf.inputs import InputType
 from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
@@ -110,6 +112,40 @@ class TestEarlyStopping:
             iteration_terminations=[MaxScoreIterationTerminationCondition(50.0)])
         result = EarlyStoppingTrainer(conf, net, ListDataSetIterator.from_arrays(xs, ys, 64)).fit()
         assert result.termination_reason == "IterationTermination"
+
+    def test_invalid_score_abort(self):
+        # NaN guard (reference InvalidScoreIterationTerminationCondition):
+        # a diverging run must stop at the first non-finite score, not
+        # train to max epochs
+        xs, ys = blobs(n=64)
+        # identity+mse diverges to inf/NaN under an absurd lr (the stable
+        # fused softmax-xent path saturates finite, so it can't NaN)
+        conf_net = (NeuralNetConfiguration.builder().seed(1).updater(Adam(lr=1e9))
+                    .layer(Dense(n_out=32, activation="relu"))
+                    .layer(OutputLayer(n_out=3, activation="identity", loss="mse"))
+                    .set_input_type(InputType.feed_forward(10)).build())
+        net = MultiLayerNetwork(conf_net)
+        net.init()
+        conf = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(DataSet(xs, ys)),
+            epoch_terminations=[MaxEpochsTerminationCondition(20)],
+            iteration_terminations=[InvalidScoreIterationTerminationCondition()])
+        result = EarlyStoppingTrainer(conf, net, ListDataSetIterator.from_arrays(xs, ys, 64)).fit()
+        assert result.termination_reason == "IterationTermination"
+        assert result.total_epochs < 20
+
+    def test_best_score_termination(self):
+        xs, ys = blobs()
+        net = mlp()
+        conf = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(DataSet(xs, ys)),
+            epoch_terminations=[
+                MaxEpochsTerminationCondition(100),
+                BestScoreEpochTerminationCondition(0.9, minimize=True)])
+        result = EarlyStoppingTrainer(conf, net, ListDataSetIterator.from_arrays(xs, ys, 64)).fit()
+        assert result.termination_reason == "EpochTermination"
+        assert result.total_epochs < 100
+        assert result.score_vs_epoch[-1] <= 0.9
 
     def test_local_file_saver_restores_best(self, tmp_path):
         xs, ys = blobs()

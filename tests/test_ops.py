@@ -59,8 +59,10 @@ class TestLosses:
         loss = get_loss("mse")
         y = jnp.asarray([[1.0, 2.0]])
         out = jnp.asarray([[1.5, 1.0]])
-        # per-example = 0.25 + 1.0 = 1.25
-        np.testing.assert_allclose(float(loss(y, out)), 1.25, rtol=1e-6)
+        # reference LossMSE = LossL2 / nOut: (0.25 + 1.0) / 2 = 0.625
+        np.testing.assert_allclose(float(loss(y, out)), 0.625, rtol=1e-6)
+        # l2 keeps the plain sum
+        np.testing.assert_allclose(float(get_loss("l2")(y, out)), 1.25, rtol=1e-6)
 
     def test_mcxent_softmax_fused_matches_plain(self):
         rng = jax.random.PRNGKey(0)
@@ -92,5 +94,6 @@ class TestLosses:
         y = jnp.ones((2, 3, 4))
         out = jnp.zeros((2, 3, 4))
         mask = jnp.asarray([[1.0, 1.0, 0.0], [1.0, 0.0, 0.0]])
-        # per-element loss 1; per present timestep sum=4; mean over 3 present = 4
-        np.testing.assert_allclose(float(loss(y, out, "identity", mask)), 4.0, rtol=1e-6)
+        # per-element loss 1; per present timestep sum=4, /nOut=1 (mse);
+        # mean over 3 present timesteps = 1
+        np.testing.assert_allclose(float(loss(y, out, "identity", mask)), 1.0, rtol=1e-6)
