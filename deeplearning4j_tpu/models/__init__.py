@@ -15,6 +15,7 @@ from .resnet50 import ResNet50
 from .darknet19 import Darknet19
 from .tinyyolo import TinyYOLO
 from .textgen_lstm import TextGenerationLSTM
+from .transformer import TransformerLM, TransformerBlock, PositionalEmbedding
 
 ZOO = {
     "lenet": LeNet,
@@ -26,4 +27,5 @@ ZOO = {
     "darknet19": Darknet19,
     "tinyyolo": TinyYOLO,
     "textgenerationlstm": TextGenerationLSTM,
+    "transformerlm": TransformerLM,
 }

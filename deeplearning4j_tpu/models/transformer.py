@@ -1,0 +1,172 @@
+"""TransformerLM — the long-context flagship (no reference analog).
+
+DL4J 0.9.2's sequence flagship is TextGenerationLSTM
+(zoo/model/TextGenerationLSTM.java); the TPU framework adds a decoder-only
+transformer LM as the model that exercises every modern axis the SURVEY
+mandates (§2.3/§5): flash attention (pallas), ring attention over ``seq``,
+tensor-parallel FFN/heads over ``model``, and a GPipe pipeline over
+``pipe`` (parallel/transformer.py drives the 4D-parallel train step).
+
+``block_params``/``block_apply`` are the single source of truth for the
+block math — the TransformerBlock layer (single-chip MLN path) and the
+ShardedTransformerLM (multi-chip path) both call them, so parity between
+the two is structural rather than tested-for.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.conf.inputs import InputType
+from ..nn.layers import EmbeddingSequence, RnnOutputLayer
+from ..nn.layers.base import Array, ForwardOut, Layer, register_layer
+from ..nn.layers.normalization import layer_norm
+from ..nn.multilayer import MultiLayerNetwork, NeuralNetConfiguration
+from ..nn.updaters import Adam, GradientNormalization
+from ..ops.attention import flash_mha, merge_heads, mha, split_heads
+from ..ops.initializers import init_weight
+
+
+def block_params(rng: Array, d_model: int, n_heads: int, d_ff: int,
+                 dtype=jnp.float32, weight_init: str = "xavier") -> Dict[str, Array]:
+    """One pre-LN transformer block's parameter tree."""
+    kq, kk, kv, ko, k1, k2 = jax.random.split(rng, 6)
+    d = d_model
+    return {
+        "ln1_g": jnp.ones((d,), dtype), "ln1_b": jnp.zeros((d,), dtype),
+        "Wq": init_weight(kq, (d, d), weight_init, d, d, dtype),
+        "Wk": init_weight(kk, (d, d), weight_init, d, d, dtype),
+        "Wv": init_weight(kv, (d, d), weight_init, d, d, dtype),
+        "Wo": init_weight(ko, (d, d), weight_init, d, d, dtype),
+        "bo": jnp.zeros((d,), dtype),
+        "ln2_g": jnp.ones((d,), dtype), "ln2_b": jnp.zeros((d,), dtype),
+        "W1": init_weight(k1, (d, d_ff), weight_init, d, d_ff, dtype),
+        "b1": jnp.zeros((d_ff,), dtype),
+        "W2": init_weight(k2, (d_ff, d), weight_init, d_ff, d, dtype),
+        "b2": jnp.zeros((d,), dtype),
+    }
+
+
+def block_apply(p: Dict[str, Array], h: Array, n_heads: int, *,
+                causal: bool = True,
+                attention_fn: Optional[Callable] = None,
+                psum_axis: Optional[str] = None) -> Array:
+    """Pre-LN block: h + attn(LN(h)); h + FFN(LN(h)).
+
+    ``attention_fn(q, k, v)`` defaults to the pallas flash kernel; the
+    sharded trainer passes ring attention over the ``seq`` axis instead.
+    ``psum_axis``: when the projections are tensor-parallel (heads/FFN
+    columns sharded), the row-parallel Wo/W2 matmuls are followed by a psum
+    over that axis (set by the shard_map caller; None = single device).
+    """
+    def maybe_psum(x):
+        return jax.lax.psum(x, psum_axis) if psum_axis else x
+
+    u = layer_norm(h, p["ln1_g"], p["ln1_b"])
+    q = split_heads(u @ p["Wq"], n_heads)
+    k = split_heads(u @ p["Wk"], n_heads)
+    v = split_heads(u @ p["Wv"], n_heads)
+    if attention_fn is None:
+        attention_fn = lambda q, k, v: flash_mha(q, k, v, causal)
+    att = maybe_psum(merge_heads(attention_fn(q, k, v)) @ p["Wo"]) + p["bo"]
+    h = h + att
+    u = layer_norm(h, p["ln2_g"], p["ln2_b"])
+    f = jax.nn.gelu(u @ p["W1"] + p["b1"])
+    h = h + maybe_psum(f @ p["W2"]) + p["b2"]
+    return h
+
+
+@register_layer
+@dataclasses.dataclass
+class TransformerBlock(Layer):
+    """Pre-LN decoder block as a single MLN layer [B,T,D] → [B,T,D].
+
+    Homogeneous by construction, so N of these stack into the pipeline's
+    stage axis (parallel/pipeline.py) without any repartitioning.
+    """
+
+    d_model: int = 0
+    n_heads: int = 8
+    d_ff: int = 0              # 0 → 4*d_model
+    causal: bool = True
+    kernel: str = "flash"      # "flash" | "xla"
+
+    wants = "rnn"
+
+    def infer_nin(self, in_type: InputType) -> None:
+        if not self.d_model:
+            self.d_model = in_type.size
+        if not self.d_ff:
+            self.d_ff = 4 * self.d_model
+
+    def output_type(self, in_type: InputType) -> InputType:
+        return InputType.recurrent(self.d_model, in_type.timesteps)
+
+    def init_params(self, rng, in_type, dtype=jnp.float32) -> Dict[str, Array]:
+        return block_params(rng, self.d_model, self.n_heads,
+                            self.d_ff or 4 * self.d_model, dtype, self._winit())
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        x = self._maybe_dropout(x, train, rng)
+        if mask is not None or self.kernel == "xla":
+            att_mask = mask[:, None, None, :] if mask is not None else None
+            attention_fn = lambda q, k, v: mha(q, k, v, causal=self.causal,
+                                               mask=att_mask)
+        else:
+            attention_fn = None
+        y = block_apply(params, x, self.n_heads, causal=self.causal,
+                        attention_fn=attention_fn)
+        if mask is not None:
+            y = y * mask[..., None].astype(y.dtype)
+        return ForwardOut(y, state, mask)
+
+
+@register_layer
+@dataclasses.dataclass
+class PositionalEmbedding(Layer):
+    """Learned absolute positions added to the sequence embedding."""
+
+    max_len: int = 512
+    d_model: int = 0
+
+    wants = "rnn"
+
+    def infer_nin(self, in_type: InputType) -> None:
+        if not self.d_model:
+            self.d_model = in_type.size
+
+    def init_params(self, rng, in_type, dtype=jnp.float32) -> Dict[str, Array]:
+        return {"P": 0.02 * jax.random.normal(rng, (self.max_len, self.d_model),
+                                              dtype)}
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        t = x.shape[1]
+        return ForwardOut(x + params["P"][:t].astype(x.dtype), state, mask)
+
+
+def TransformerLM(vocab_size: int = 256, n_layers: int = 4, d_model: int = 256,
+                  n_heads: int = 8, d_ff: int = 0, max_len: int = 512,
+                  seed: int = 42, updater=None, kernel: str = "flash",
+                  dtype=None) -> MultiLayerNetwork:
+    """Decoder-only LM: EmbeddingSequence + positions + N blocks + head."""
+    b = (NeuralNetConfiguration.builder()
+         .seed(seed)
+         .updater(updater or Adam(lr=3e-4))
+         .gradient_normalization(GradientNormalization.CLIP_L2_PER_LAYER, 1.0)
+         .layer(EmbeddingSequence(n_in=vocab_size, n_out=d_model))
+         .layer(PositionalEmbedding(max_len=max_len, d_model=d_model)))
+    for _ in range(n_layers):
+        b.layer(TransformerBlock(d_model=d_model, n_heads=n_heads, d_ff=d_ff,
+                                 kernel=kernel))
+    b.layer(RnnOutputLayer(n_out=vocab_size, activation="softmax", loss="mcxent"))
+    b.set_input_type(InputType.recurrent(vocab_size, max_len))
+    if dtype is not None:
+        b.dtype(*dtype) if isinstance(dtype, tuple) else b.dtype(dtype)
+    net = MultiLayerNetwork(b.build())
+    net.init()
+    return net

@@ -22,8 +22,9 @@ from .convolution import (
     Upsampling2D,
 )
 from .pooling import Subsampling1D, Subsampling2D, GlobalPooling
-from .normalization import BatchNormalization, LocalResponseNormalization
+from .normalization import BatchNormalization, LocalResponseNormalization, LayerNorm
 from .recurrent import LSTM, GravesLSTM, GravesBidirectionalLSTM, SimpleRnn, RnnOutputLayer, Bidirectional, LastTimeStep
+from .attention import SelfAttention, LearnedSelfAttention
 from .variational import VariationalAutoencoder
 from .objdetect import Yolo2OutputLayer
 from .special import FrozenLayer, CenterLossOutputLayer

@@ -1,0 +1,156 @@
+"""Attention layers — designed fresh, TPU-first (no reference analog).
+
+DL4J 0.9.2 has no attention layer anywhere (SURVEY.md §5 "Long-context":
+its sequence story is TBPTT + masking).  These layers provide the modern
+long-context path mandated by SURVEY §7-M5, built on
+``ops.attention``: XLA einsum attention for masked/odd shapes, the pallas
+flash kernel (``flash_mha``) for tile-aligned shapes, and ring attention
+over the ``seq`` mesh axis (parallel/ring.py) for sequence parallelism.
+
+Layout: layer I/O follows the framework's RNN convention [batch, time,
+features]; heads are split/merged internally to [B, H, T, D].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.attention import flash_mha, merge_heads, mha, split_heads
+from ...ops.initializers import init_weight
+from ..conf.inputs import InputType
+from .base import Array, ForwardOut, Layer, register_layer
+
+
+@register_layer
+@dataclasses.dataclass
+class SelfAttention(Layer):
+    """Multi-head self-attention over a sequence.
+
+    Projects input [B,T,nIn] to per-head q/k/v, attends (optionally
+    causally), and projects back to n_out.  ``kernel="flash"`` uses the
+    pallas blockwise kernel when shapes tile (falls back to XLA otherwise
+    or when a sequence mask is present); ``kernel="xla"`` always uses the
+    einsum path.  With ``project_out=False`` and n_out == n_heads *
+    head_dim, the output projection is skipped (pure attention block).
+    """
+
+    n_in: int = 0
+    n_out: int = 0
+    n_heads: int = 4
+    head_dim: int = 0          # 0 → n_out // n_heads
+    causal: bool = False
+    kernel: str = "flash"      # "flash" | "xla"
+    project_out: bool = True
+
+    wants = "rnn"
+
+    def _head_dim(self) -> int:
+        if self.head_dim:
+            return self.head_dim
+        if self.n_out % self.n_heads:
+            raise ValueError(
+                f"n_out {self.n_out} not divisible by n_heads {self.n_heads}; "
+                "set head_dim explicitly")
+        return self.n_out // self.n_heads
+
+    def output_type(self, in_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, in_type.timesteps)
+
+    def infer_nin(self, in_type: InputType) -> None:
+        if not self.n_in:
+            self.n_in = in_type.size
+        if not self.n_out:
+            self.n_out = in_type.size
+
+    def init_params(self, rng, in_type, dtype=jnp.float32) -> Dict[str, Array]:
+        hd = self._head_dim()
+        proj = self.n_heads * hd
+        kq, kk, kv, ko = jax.random.split(rng, 4)
+        params = {
+            "Wq": init_weight(kq, (self.n_in, proj), self._winit(), self.n_in, proj, dtype),
+            "Wk": init_weight(kk, (self.n_in, proj), self._winit(), self.n_in, proj, dtype),
+            "Wv": init_weight(kv, (self.n_in, proj), self._winit(), self.n_in, proj, dtype),
+        }
+        if self.project_out:
+            params["Wo"] = init_weight(ko, (proj, self.n_out), self._winit(),
+                                       proj, self.n_out, dtype)
+            params["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        elif proj != self.n_out:
+            raise ValueError(
+                f"project_out=False requires n_heads*head_dim == n_out "
+                f"({proj} != {self.n_out})")
+        return params
+
+    def _split_heads(self, x: Array) -> Array:
+        return split_heads(x, self.n_heads)
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        x = self._maybe_dropout(x, train, rng)
+        q = self._split_heads(x @ params["Wq"])     # [B,H,T,D]
+        k = self._split_heads(x @ params["Wk"])
+        v = self._split_heads(x @ params["Wv"])
+        if mask is not None:
+            # [B,T] sequence mask → [B,1,1,T] attend-to mask; masked shapes
+            # route to the XLA path (flash kernel is mask-free by design)
+            att_mask = mask[:, None, None, :]
+            out = mha(q, k, v, causal=self.causal, mask=att_mask)
+        elif self.kernel == "flash":
+            out = flash_mha(q, k, v, self.causal)
+        else:
+            out = mha(q, k, v, causal=self.causal)
+        merged = merge_heads(out)
+        if self.project_out:
+            merged = merged @ params["Wo"] + params["b"]
+        y = self._act(merged)
+        if mask is not None:
+            y = y * mask[..., None].astype(y.dtype)
+        return ForwardOut(y, state, mask)
+
+
+@register_layer
+@dataclasses.dataclass
+class LearnedSelfAttention(SelfAttention):
+    """Self-attention with ``n_queries`` LEARNED query vectors: output is a
+    fixed-length [B, n_queries, n_out] summary of a variable-length
+    sequence (the attention analog of global pooling)."""
+
+    n_queries: int = 1
+
+    def output_type(self, in_type: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, self.n_queries)
+
+    def init_params(self, rng, in_type, dtype=jnp.float32) -> Dict[str, Array]:
+        if self.causal:
+            # learned queries have no temporal position — causal masking
+            # is undefined for them; reject rather than silently ignore
+            raise ValueError("LearnedSelfAttention does not support causal=True")
+        rq, rest = jax.random.split(rng)
+        params = super().init_params(rest, in_type, dtype)
+        del params["Wq"]  # queries are free parameters, not a projection
+        hd = self._head_dim()
+        params["Q"] = init_weight(rq, (self.n_queries, self.n_heads * hd),
+                                  self._winit(), self.n_in, self.n_heads * hd,
+                                  dtype)
+        return params
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        x = self._maybe_dropout(x, train, rng)
+        b = x.shape[0]
+        q = jnp.broadcast_to(params["Q"], (b,) + params["Q"].shape)
+        q = self._split_heads(q)                     # [B,H,nQ,D]
+        k = self._split_heads(x @ params["Wk"])
+        v = self._split_heads(x @ params["Wv"])
+        if mask is not None:
+            out = mha(q, k, v, mask=mask[:, None, None, :])
+        elif self.kernel == "flash":
+            out = flash_mha(q, k, v, False)
+        else:
+            out = mha(q, k, v)
+        merged = merge_heads(out)
+        if self.project_out:
+            merged = merged @ params["Wo"] + params["b"]
+        return ForwardOut(self._act(merged), state, None)

@@ -105,3 +105,36 @@ class LocalResponseNormalization(Layer):
             (1, 1, 1, self.n), (1, 1, 1, 1), "VALID")
         denom = (self.k + (self.alpha / self.n) * window) ** self.beta
         return ForwardOut(x / denom, state, mask)
+
+
+def layer_norm(x: Array, gamma: Array, beta: Array, eps: float = 1e-5) -> Array:
+    """Normalize the last axis; shared by LayerNorm and TransformerBlock."""
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    xf = x.astype(acc)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean((xf - mu) ** 2, axis=-1, keepdims=True)
+    y = (xf - mu) * lax.rsqrt(var + eps)
+    return (y * gamma.astype(acc) + beta.astype(acc)).astype(x.dtype)
+
+
+@register_layer
+@dataclasses.dataclass
+class LayerNorm(Layer):
+    """Per-token normalization over the feature axis (no reference analog —
+    DL4J 0.9.2 predates LayerNorm; required by the transformer path)."""
+
+    n_features: int = 0
+    eps: float = 1e-5
+
+    def infer_nin(self, in_type: InputType) -> None:
+        if not self.n_features:
+            self.n_features = in_type.size
+
+    def init_params(self, rng, in_type, dtype=jnp.float32) -> Dict[str, Array]:
+        return {"gamma": jnp.ones((self.n_features,), dtype),
+                "beta": jnp.zeros((self.n_features,), dtype)}
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        return ForwardOut(
+            self._act(layer_norm(x, params["gamma"], params["beta"], self.eps)),
+            state, mask)

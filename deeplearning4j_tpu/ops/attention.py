@@ -1,0 +1,246 @@
+"""Multi-head attention — XLA reference path + fused flash (pallas) kernel.
+
+The reference (DL4J 0.9.2) has NO attention layer at all (SURVEY.md §5
+"Long-context": closest analogs are TBPTT + mask propagation).  Long-context
+support is therefore designed TPU-first per SURVEY §7-M5:
+
+  - ``mha``: plain XLA einsum-softmax-einsum attention (the semantics
+    oracle; XLA fuses it well at moderate sequence lengths).
+  - ``flash_mha``: blockwise streaming-softmax attention as a pallas TPU
+    kernel — O(T) memory instead of O(T²), tiles sized for the MXU, f32
+    accumulation.  Falls back to ``mha`` when shapes don't tile.
+  - ``ring_attention`` (parallel/ring.py) reuses the same blockwise update
+    rule across devices over the ``seq`` mesh axis.
+
+Layout convention: [batch, heads, seq, head_dim] (BHTD).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_NEG_INF = -1e30  # large-finite: keeps padded/causal-masked rows NaN-free
+
+try:  # pallas ships in all jax wheels; guard anyway so mha still works
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+# ---------------------------------------------------------------------------
+# shared layout/masking helpers
+# ---------------------------------------------------------------------------
+
+
+def causal_bias(tq: int, tk: int, q_off=0, k_off=0) -> Array:
+    """Additive causal bias [tq, tk]: 0 where global q index ≥ global k
+    index, large-negative otherwise.  Offsets may be traced values (ring
+    attention passes per-device block offsets).  The single source of the
+    causal-mask convention for mha / flash kernel / flash bwd / ring."""
+    qi = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 0) + q_off
+    ki = jax.lax.broadcasted_iota(jnp.int32, (tq, tk), 1) + k_off
+    return jnp.where(qi >= ki, 0.0, _NEG_INF).astype(jnp.float32)
+
+
+def split_heads(x: Array, n_heads: int) -> Array:
+    """[B, T, H*D] → [B, H, T, D] (the framework's head-layout convention)."""
+    b, t, dm = x.shape
+    return x.reshape(b, t, n_heads, dm // n_heads).transpose(0, 2, 1, 3)
+
+
+def merge_heads(x: Array) -> Array:
+    """[B, H, T, D] → [B, T, H*D]."""
+    b, h, t, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b, t, h * d)
+
+
+# ---------------------------------------------------------------------------
+# XLA reference path
+# ---------------------------------------------------------------------------
+
+
+def mha(q: Array, k: Array, v: Array, *, causal: bool = False,
+        mask: Optional[Array] = None, scale: Optional[float] = None) -> Array:
+    """Plain attention: softmax(q·kᵀ/√d (+mask)) · v.
+
+    q [B,H,T,D], k/v [B,H,S,D]; mask broadcastable to [B,H,T,S] with 1 =
+    attend, 0 = blocked (DL4J mask convention).  Returns [B,H,T,D].
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        scores = scores + causal_bias(scores.shape[-2], scores.shape[-1])
+    if mask is not None:
+        scores = jnp.where(mask.astype(bool), scores, _NEG_INF)
+    # accumulate the softmax in ≥f32 (bf16 inputs promote; f64 stays f64
+    # so the float64 gradient-check suite is meaningful)
+    acc_dtype = jnp.promote_types(scores.dtype, jnp.float32)
+    p = jax.nn.softmax(scores.astype(acc_dtype), axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p.astype(q.dtype), v)
+
+
+# ---------------------------------------------------------------------------
+# blockwise streaming-softmax update (shared by flash kernel + ring attention)
+# ---------------------------------------------------------------------------
+
+
+def blockwise_update(acc, m, l, q, k, v, scale, bias=None):
+    """One online-softmax accumulation step (Milakov & Gimelshein / Flash).
+
+    acc [T,D] f32 un-normalized output, m [T,1] running max, l [T,1] running
+    denominator.  Processes the (q, k-block) score tile and returns updated
+    (acc, m, l).  Used on-chip by the pallas kernel and across chips by ring
+    attention — one math, two transports.
+    """
+    s = jnp.dot(q.astype(jnp.float32), k.astype(jnp.float32).T,
+                preferred_element_type=jnp.float32) * scale   # [T, S_blk]
+    if bias is not None:
+        s = s + bias
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)                                     # [T, S_blk]
+    correction = jnp.exp(m - m_new)
+    l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+    acc_new = acc * correction + jnp.dot(p, v.astype(jnp.float32),
+                                         preferred_element_type=jnp.float32)
+    return acc_new, m_new, l_new
+
+
+# ---------------------------------------------------------------------------
+# flash attention pallas kernel
+# ---------------------------------------------------------------------------
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+                  *, scale: float, causal: bool, block_q: int, block_k: int):
+    """Grid (BH, nQ, nK), k innermost — TPU grids run sequentially, so the
+    running (acc, m, l) stats live in VMEM scratch across k-steps."""
+    kb = pl.program_id(2)
+    n_k = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    qb = pl.program_id(1)
+    bias = None
+    if causal:
+        bias = causal_bias(block_q, block_k, qb * block_q, kb * block_k)
+
+    def _step():
+        acc, m, l = blockwise_update(
+            acc_ref[:], m_ref[:], l_ref[:],
+            q_ref[0], k_ref[0], v_ref[0], scale, bias)
+        acc_ref[:] = acc
+        m_ref[:] = m
+        l_ref[:] = l
+
+    if causal:
+        # whole tile above the diagonal → skip (saves ~half the FLOPs)
+        @pl.when(qb * block_q + block_q - 1 >= kb * block_k)
+        def _():
+            _step()
+    else:
+        _step()
+
+    @pl.when(kb == n_k - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+
+
+def _pick_block(n: int, preferred: int = 128) -> int:
+    for b in (preferred, 64, 32, 16, 8):
+        if n % b == 0:
+            return b
+    return 0
+
+
+def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
+                   scale: float) -> Array:
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    block_q = _pick_block(T)
+    block_k = _pick_block(S)
+    # the kernel targets the TPU memory spaces; run it compiled on tpu,
+    # interpreted on cpu (tests), and fall back to plain XLA elsewhere (gpu)
+    backend = jax.default_backend()
+    if not (_HAS_PALLAS and block_q and block_k and backend in ("tpu", "cpu")):
+        return mha(q, k, v, causal=causal, scale=scale)
+
+    qf = q.reshape(B * H, T, D)
+    kf = k.reshape(B * H, S, D)
+    vf = v.reshape(B * H, S, D)
+    grid = (B * H, T // block_q, S // block_k)
+    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                               block_q=block_q, block_k=block_k)
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, D), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=(backend == "cpu"),
+    )(qf, kf, vf)
+    return out.reshape(B, H, T, D)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def flash_mha(q: Array, k: Array, v: Array, causal: bool = False,
+              scale: Optional[float] = None) -> Array:
+    """Fused blockwise attention (pallas TPU kernel, O(T) memory forward).
+
+    Backward recomputes scores with XLA einsums (O(T²) bwd memory — the
+    standard recompute tradeoff; a fused pallas backward is a drop-in
+    upgrade behind this same VJP seam).  Padding masks aren't supported
+    here — layers with masks route to ``mha``.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash_forward(q, k, v, causal, scale)
+
+
+def _flash_fwd(q, k, v, causal, scale):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash_forward(q, k, v, causal, scale), (q, k, v)
+
+
+def _flash_bwd(causal, scale, res, g):
+    q, k, v = res
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+    if causal:
+        s = s + causal_bias(s.shape[-2], s.shape[-1])
+    p = jax.nn.softmax(s, axis=-1)
+    gf = g.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
+    ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+flash_mha.defvjp(_flash_fwd, _flash_bwd)

@@ -21,3 +21,6 @@ mechanism: a jax.sharding.Mesh + GSPMD-partitioned jit programs.
 from .mesh import build_mesh, replicated, shard_batch, infer_param_shardings
 from .trainer import ShardedTrainer
 from .inference import ParallelInference
+from .ring import ring_attention, ring_self_attention
+from .pipeline import pipeline_apply, stack_stage_params, stage_sharding
+from .transformer import ShardedTransformerLM

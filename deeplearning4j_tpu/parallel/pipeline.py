@@ -1,0 +1,156 @@
+"""Pipeline parallelism — GPipe-style microbatch schedule over the ``pipe``
+mesh axis.
+
+No reference analog (SURVEY.md §2.3: PP is ABSENT in DL4J; a first-class
+TPU deliverable).  Design: a stack of homogeneous blocks (transformer /
+LSTM layers) has its parameters stacked on a leading stage axis that is
+sharded over ``pipe`` — each device holds ``n_stages // pipe`` block
+params.  The microbatch schedule is a single ``lax.scan`` inside
+``shard_map``: at step s, the device holding stage p processes microbatch
+``s - p`` and hands its activation to stage p+1 via ``lax.ppermute`` —
+compute and ICI transfer overlap, and the whole pipeline (fwd+bwd through
+autodiff) stays inside ONE jitted XLA program.
+
+The bubble is the standard GPipe (P-1)/(M+P-1) fraction; raise
+``n_microbatches`` to amortize.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import vary_over
+
+Array = jax.Array
+
+
+def stack_stage_params(param_list):
+    """Stack per-block param pytrees [p0, p1, ...] into one pytree with a
+    leading stage axis (all blocks must be homogeneous)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *param_list)
+
+
+def stage_sharding(mesh: Mesh, stacked_params, axis: str = "pipe"):
+    """NamedShardings putting the leading stage axis on ``axis``."""
+    def spec(a):
+        return NamedSharding(mesh, P(axis, *([None] * (a.ndim - 1))))
+    return jax.tree_util.tree_map(spec, stacked_params)
+
+
+def pipeline_apply(block_fn: Callable[[Any, Array], Array],
+                   stacked_params, x: Array, mesh: Mesh, *,
+                   axis: str = "pipe", n_microbatches: int = 4,
+                   data_axis: str | None = "data",
+                   param_specs=None, x_spec=None) -> Array:
+    """Run ``x`` through the pipelined block stack; returns same-shape y.
+
+    ``block_fn(params_i, h) -> h`` is one block (activation shapes must be
+    preserved — the homogeneous-pipeline contract).  ``stacked_params`` has
+    leading axis n_stages (divisible by the pipe axis size), sharded via
+    ``stage_sharding``.  ``x`` is [B, ...]; B must divide by
+    n_microbatches.  Composes with other mesh axes: batch stays sharded on
+    ``data_axis``, and block_fn may itself use collectives (e.g. ring
+    attention on ``seq``, TP psums on ``model``).
+
+    ``param_specs``: optional PartitionSpec pytree for the stacked params
+    (leading dim on ``axis``) to tensor-parallel individual weights on top
+    of the stage sharding.  ``x_spec``: optional PartitionSpec for the
+    activations (e.g. ``P('data', 'seq', None)`` for sequence-sharded LM
+    inputs); microbatching always splits dim 0.
+    """
+    n_pipe = mesh.shape[axis]
+    n_stages = jax.tree_util.tree_leaves(stacked_params)[0].shape[0]
+    if n_stages % n_pipe:
+        raise ValueError(f"{n_stages} stages not divisible by pipe={n_pipe}")
+
+    if x_spec is not None:
+        batch_spec = x_spec
+    elif data_axis and mesh.shape.get(data_axis, 1) > 1:
+        batch_spec = P(data_axis)
+    else:
+        batch_spec = P()
+
+    # microbatches split the PER-DEVICE batch; shrink to the largest feasible
+    # count (a perf knob, not a semantics change — parity tests cover this)
+    dim0 = batch_spec[0] if len(batch_spec) else None
+    dim0 = dim0 if isinstance(dim0, tuple) else (dim0,) if dim0 else ()
+    dp = 1
+    for a in dim0:
+        dp *= mesh.shape.get(a, 1)
+    b_local = x.shape[0] // dp
+    if x.shape[0] % dp:
+        raise ValueError(f"batch {x.shape[0]} not divisible by {dim0} ({dp})")
+    while b_local % n_microbatches:
+        n_microbatches -= 1
+    param_spec = param_specs if param_specs is not None else \
+        jax.tree_util.tree_map(
+            lambda a: P(axis, *([None] * (a.ndim - 1))), stacked_params)
+
+    def run(params_local, xs):  # per-device: params [n_stages/n_pipe, ...]
+        my = jax.lax.axis_index(axis)
+        m = n_microbatches
+        mb = xs.shape[0] // m
+        micro = xs.reshape((m, mb) + xs.shape[1:])
+
+        def apply_local(h):
+            def f(h, p):
+                return block_fn(p, h), None
+            h, _ = jax.lax.scan(f, h, params_local)
+            return h
+
+        perm_fwd = [(i, i + 1) for i in range(n_pipe - 1)]
+        n_steps = m + n_pipe - 1
+        # zero-init buffers must carry the same varying-axes type as the
+        # loop body's outputs (shard_map vma typing): they vary over pipe
+        # AND over any axis the batch is sharded on
+        out0 = vary_over(jnp.zeros_like(micro), mesh.axis_names)
+        buf0 = vary_over(jnp.zeros((mb,) + xs.shape[1:], xs.dtype),
+                         mesh.axis_names)
+
+        def step(carry, s):
+            buf, out = carry
+            # stage 0 injects microbatch s (clamped; inactive steps compute
+            # on stale data and their results are never written back)
+            inj = micro[jnp.clip(s, 0, m - 1)]
+            h_in = jnp.where(my == 0, inj, buf)
+            h_out = apply_local(h_in)
+            # last stage banks microbatch s - (n_pipe - 1) when in range
+            widx = s - (n_pipe - 1)
+            write = jnp.logical_and(my == n_pipe - 1,
+                                    jnp.logical_and(widx >= 0, widx < m))
+            out = jax.lax.cond(
+                write,
+                lambda o: jax.lax.dynamic_update_index_in_dim(o, h_out, jnp.clip(widx, 0, m - 1), 0),
+                lambda o: o, out)
+            # hand activation to the next stage
+            buf = jax.lax.ppermute(h_out, axis, perm_fwd)
+            return (buf, out), None
+
+        (_, out), _ = jax.lax.scan(step, (buf0, out0), jnp.arange(n_steps))
+        # result lives on the last stage; broadcast over the pipe axis
+        out = jax.lax.psum(
+            jnp.where(my == n_pipe - 1, out, jnp.zeros_like(out)), axis)
+        # activations may be typed varying over axes block_fn reduced over
+        # (e.g. TP psums on "model" leave replicated-but-varying values);
+        # pmean over axes absent from the output spec clears the variance
+        spec_axes = set()
+        for entry in batch_spec:
+            if isinstance(entry, (tuple, list)):
+                spec_axes.update(entry)
+            elif entry is not None:
+                spec_axes.add(entry)
+        extra = tuple(n for n in jax.typeof(out).vma
+                      if n != axis and n not in spec_axes)
+        if extra:
+            out = jax.lax.pmean(out, extra)
+        return out.reshape(xs.shape)
+
+    fn = jax.shard_map(run, mesh=mesh,
+                       in_specs=(param_spec, batch_spec),
+                       out_specs=batch_spec)
+    return fn(stacked_params, x)

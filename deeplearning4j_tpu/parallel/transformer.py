@@ -1,0 +1,193 @@
+"""ShardedTransformerLM — the 4D-parallel (DP×TP×SP×PP) training step.
+
+The north-star composition mandated by SURVEY.md §7-M5, with no reference
+analog (DL4J's only distributed axis is DP — §2.3): one jitted XLA program
+per step in which
+
+  - ``data``  shards the batch (grad psum inserted by shard_map transpose),
+  - ``model`` tensor-parallels attention heads + FFN columns
+    (Megatron-style column/row split with an explicit psum),
+  - ``seq``   shards the sequence; attention runs as ring attention with
+    K/V blocks rotating over ICI (parallel/ring.py),
+  - ``pipe``  pipelines the homogeneous block stack with a GPipe
+    microbatch schedule (parallel/pipeline.py).
+
+Embedding/head run under GSPMD outside the manual shard_map island; the
+block math is models/transformer.block_apply — the same function the
+single-chip TransformerBlock layer uses.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.transformer import block_apply, block_params
+from ..nn.updaters import Adam
+from .pipeline import pipeline_apply, stack_stage_params
+from .ring import ring_attention
+
+Array = jax.Array
+
+
+def _block_tp_specs(pipe: str = "pipe", model: str = "model"):
+    """Per-leaf PartitionSpecs for stacked block params: column-parallel
+    q/k/v/FFN-up, row-parallel o/FFN-down (psum after), norms replicated."""
+    return {
+        "ln1_g": P(pipe, None), "ln1_b": P(pipe, None),
+        "Wq": P(pipe, None, model), "Wk": P(pipe, None, model),
+        "Wv": P(pipe, None, model),
+        "Wo": P(pipe, model, None), "bo": P(pipe, None),
+        "ln2_g": P(pipe, None), "ln2_b": P(pipe, None),
+        "W1": P(pipe, None, model), "b1": P(pipe, model),
+        "W2": P(pipe, model, None), "b2": P(pipe, None),
+    }
+
+
+class ShardedTransformerLM:
+    """Decoder-only LM trained with DP×TP×SP×PP over a named mesh.
+
+    >>> mesh = build_mesh({"data": 2, "model": 2, "seq": 2, "pipe": 1})
+    >>> lm = ShardedTransformerLM(vocab_size=256, n_layers=4, d_model=128,
+    ...                           n_heads=8, mesh=mesh)
+    >>> loss = lm.fit_batch(tokens, targets)   # [B,T] int32 each
+    """
+
+    def __init__(self, vocab_size: int, n_layers: int, d_model: int,
+                 n_heads: int, mesh: Mesh, d_ff: int = 0, max_len: int = 512,
+                 n_microbatches: int = 2, seed: int = 0, updater=None,
+                 compute_dtype=None):
+        d_ff = d_ff or 4 * d_model
+        # normalize to the canonical 4-axis mesh (absent axes = size 1) so
+        # specs/collectives can reference every axis unconditionally
+        canonical = ("data", "model", "seq", "pipe")
+        unknown = [n for n in mesh.axis_names if n not in canonical]
+        if unknown:
+            raise ValueError(f"unexpected mesh axes {unknown}; use {canonical}")
+        if tuple(mesh.axis_names) != canonical:
+            from .mesh import build_mesh
+            mesh = build_mesh({n: mesh.shape.get(n, 1) for n in canonical},
+                              devices=mesh.devices.flatten())
+        tp = mesh.shape.get("model", 1)
+        if n_heads % tp:
+            raise ValueError(f"n_heads {n_heads} not divisible by model={tp}")
+        if n_layers % mesh.shape.get("pipe", 1):
+            raise ValueError(
+                f"n_layers {n_layers} not divisible by pipe={mesh.shape['pipe']}")
+        self.mesh = mesh
+        self.vocab_size = vocab_size
+        self.n_heads = n_heads
+        self.n_heads_local = n_heads // tp
+        self.n_microbatches = n_microbatches
+        self.compute_dtype = compute_dtype
+        self.updater = updater or Adam(lr=3e-4)
+        self.iteration = 0
+
+        rng = jax.random.PRNGKey(seed)
+        ke, kp, kh, *kb = jax.random.split(rng, 3 + n_layers)
+        blocks = stack_stage_params(
+            [block_params(k, d_model, n_heads, d_ff) for k in kb])
+        params = {
+            "embed": 0.02 * jax.random.normal(ke, (vocab_size, d_model)),
+            "pos": 0.02 * jax.random.normal(kp, (max_len, d_model)),
+            "blocks": blocks,
+            "lnf_g": jnp.ones((d_model,)), "lnf_b": jnp.zeros((d_model,)),
+            "head": 0.02 * jax.random.normal(kh, (d_model, vocab_size)),
+        }
+        self.block_specs = _block_tp_specs()
+        shardings = {
+            "embed": NamedSharding(mesh, P(None, None)),
+            "pos": NamedSharding(mesh, P(None, None)),
+            "blocks": {k: NamedSharding(mesh, s)
+                       for k, s in self.block_specs.items()},
+            "lnf_g": NamedSharding(mesh, P()), "lnf_b": NamedSharding(mesh, P()),
+            "head": NamedSharding(mesh, P(None, "model")),
+        }
+        self.params = jax.device_put(params, shardings)
+        # optimizer state mirrors params structurally → same shardings
+        opt = self.updater.init_state(params)
+        self.opt_state = jax.device_put(opt, self._opt_shardings(opt, shardings))
+        self.token_sharding = NamedSharding(mesh, P("data", "seq"))
+        self._jit_step = None
+        self._jit_logits = None
+
+    def _opt_shardings(self, opt, param_shardings):
+        """Each opt-state subtree ('m'/'v'/...) mirrors the params tree."""
+        def place(sub):
+            if jax.tree_util.tree_structure(sub) == \
+                    jax.tree_util.tree_structure(param_shardings):
+                return param_shardings
+            return jax.tree_util.tree_map(
+                lambda _: NamedSharding(self.mesh, P()), sub)
+        return {k: place(v) for k, v in opt.items()}
+
+    # -- forward -----------------------------------------------------------
+
+    def _forward(self, params, tokens):
+        cd = self.compute_dtype
+        embed = params["embed"] if cd is None else params["embed"].astype(cd)
+        pos = params["pos"] if cd is None else params["pos"].astype(cd)
+        h = embed[tokens] + pos[: tokens.shape[1]]
+        blocks = params["blocks"] if cd is None else jax.tree_util.tree_map(
+            lambda a: a.astype(cd), params["blocks"])
+
+        block_fn = functools.partial(
+            block_apply, n_heads=self.n_heads_local, causal=True,
+            attention_fn=functools.partial(
+                ring_attention, axis_name="seq", causal=True),
+            psum_axis="model" if self.mesh.shape.get("model", 1) > 1 else None)
+
+        h = pipeline_apply(
+            lambda p, h: block_fn(p, h), blocks, h, self.mesh,
+            n_microbatches=self.n_microbatches,
+            param_specs=self.block_specs,
+            x_spec=P("data", "seq", None))
+        from ..nn.layers.normalization import layer_norm
+        h = layer_norm(h, params["lnf_g"].astype(h.dtype),
+                       params["lnf_b"].astype(h.dtype))
+        head = params["head"] if cd is None else params["head"].astype(cd)
+        return h @ head  # [B, T, V] logits
+
+    def _loss(self, params, tokens, targets):
+        logits = self._forward(params, tokens)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        return jnp.mean(nll)
+
+    # -- training ----------------------------------------------------------
+
+    def _build_step(self):
+        updater = self.updater
+
+        def step(params, opt_state, it, tokens, targets):
+            loss, grads = jax.value_and_grad(self._loss)(params, tokens, targets)
+            updates, new_opt = updater.update(grads, opt_state, it)
+            new_params = jax.tree_util.tree_map(
+                lambda p, u: (p - u.astype(p.dtype)), params, updates)
+            return new_params, new_opt, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def fit_batch(self, tokens: np.ndarray, targets: np.ndarray) -> float:
+        if self._jit_step is None:
+            self._jit_step = self._build_step()
+        tokens = jax.device_put(jnp.asarray(tokens, jnp.int32), self.token_sharding)
+        targets = jax.device_put(jnp.asarray(targets, jnp.int32), self.token_sharding)
+        with jax.sharding.set_mesh(self.mesh):
+            self.params, self.opt_state, loss = self._jit_step(
+                self.params, self.opt_state,
+                jnp.asarray(self.iteration, jnp.int32), tokens, targets)
+        self.iteration += 1
+        return float(loss)
+
+    def logits(self, tokens: np.ndarray) -> Array:
+        if self._jit_logits is None:
+            self._jit_logits = jax.jit(self._forward)
+        tokens = jax.device_put(jnp.asarray(tokens, jnp.int32), self.token_sharding)
+        with jax.sharding.set_mesh(self.mesh):
+            return self._jit_logits(self.params, tokens)
