@@ -127,11 +127,29 @@ _REGISTRY: Dict[str, Activation] = {
 }
 
 
+#: activations accepting a scalar parameter via "name(value)" syntax —
+#: the string form keeps layer configs JSON round-trippable (the reference
+#: carries the scalar on the impl object, e.g. ActivationLReLU(alpha))
+_PARAMETRIC = {"leakyrelu", "elu"}
+
+
 def get_activation(name: str | Activation) -> Activation:
-    """Resolve an activation by name (case-insensitive, DL4J enum style)."""
+    """Resolve an activation by name (case-insensitive, DL4J enum style).
+    Parametric forms: "leakyrelu(0.3)", "elu(0.5)"."""
     if callable(name):
         return name
     key = name.lower()
+    if key.endswith(")") and "(" in key:
+        base, _, arg = key.partition("(")
+        if base in _PARAMETRIC:
+            try:
+                alpha = float(arg[:-1])
+            except ValueError:
+                raise ValueError(
+                    f"Bad parametric activation '{name}': expected "
+                    f"'{base}(<number>)', e.g. '{base}(0.3)'") from None
+            fn = _REGISTRY[base]
+            return lambda x: fn(x, alpha)
     if key not in _REGISTRY:
         raise ValueError(f"Unknown activation '{name}'. Known: {sorted(_REGISTRY)}")
     return _REGISTRY[key]

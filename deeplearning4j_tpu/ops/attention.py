@@ -173,9 +173,12 @@ def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
     block_q = _pick_block(T)
     block_k = _pick_block(S)
     # the kernel targets the TPU memory spaces; run it compiled on tpu,
-    # interpreted on cpu (tests), and fall back to plain XLA elsewhere (gpu)
+    # interpreted on cpu (tests), and fall back to plain XLA elsewhere (gpu).
+    # f64 also falls back: the kernel accumulates in f32 VMEM scratch, which
+    # would silently degrade float64 gradient checks.
     backend = jax.default_backend()
-    if not (_HAS_PALLAS and block_q and block_k and backend in ("tpu", "cpu")):
+    if not (_HAS_PALLAS and block_q and block_k and backend in ("tpu", "cpu")) \
+            or q.dtype == jnp.float64:
         return mha(q, k, v, causal=causal, scale=scale)
 
     qf = q.reshape(B * H, T, D)
@@ -229,12 +232,15 @@ def _flash_bwd(causal, scale, res, g):
     q, k, v = res
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    # accumulate in f32 for low-precision inputs, but keep f64 at f64 so the
+    # float64 gradient-check suite stays meaningful (matches mha's contract)
+    acc = jnp.float64 if q.dtype == jnp.float64 else jnp.float32
+    qf, kf, vf = (x.astype(acc) for x in (q, k, v))
     s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
     if causal:
         s = s + causal_bias(s.shape[-2], s.shape[-1])
     p = jax.nn.softmax(s, axis=-1)
-    gf = g.astype(jnp.float32)
+    gf = g.astype(acc)
     dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
     dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
     ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))

@@ -1,0 +1,446 @@
+"""Keras HDF5 import tests.
+
+TensorFlow/Keras is not in the image, so fixtures are written directly in
+the Keras 2.x save format (model_config JSON attr + model_weights groups)
+with h5py — which is exactly what the importer must parse — and expected
+outputs are computed with plain numpy. This mirrors the reference's
+resource-fixture strategy (modelimport test resources are pre-saved .h5
+files, not live Keras runs).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+h5py = pytest.importorskip("h5py")
+
+from deeplearning4j_tpu.modelimport import (
+    KerasModelImport,
+    import_keras_model_and_weights,
+    import_keras_sequential_model_and_weights,
+)
+from deeplearning4j_tpu.modelimport.keras import (
+    InvalidKerasConfigurationException,
+    map_activation,
+    map_loss,
+)
+
+
+def _write_keras_file(path, model_config, training_config, layer_weights):
+    """layer_weights: {layer_name: {weight_path: array}} in Keras layout."""
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_config)
+        if training_config is not None:
+            f.attrs["training_config"] = json.dumps(training_config)
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = np.array(
+            [n.encode() for n in layer_weights], dtype="S64")
+        for lname, weights in layer_weights.items():
+            g = mw.create_group(lname)
+            g.attrs["weight_names"] = np.array(
+                [wn.encode() for wn in weights], dtype="S128")
+            for wn, arr in weights.items():
+                g.create_dataset(wn, data=arr)
+
+
+def _seq_config(layers):
+    return {"class_name": "Sequential", "config": {"layers": layers}}
+
+
+def _rng():
+    return np.random.default_rng(42)
+
+
+class TestSequentialImport:
+    def test_mlp_dense_output_parity(self, tmp_path):
+        rng = _rng()
+        W1 = rng.normal(size=(4, 8)).astype(np.float32)
+        b1 = rng.normal(size=(8,)).astype(np.float32)
+        W2 = rng.normal(size=(8, 3)).astype(np.float32)
+        b2 = rng.normal(size=(3,)).astype(np.float32)
+        cfg = _seq_config([
+            {"class_name": "Dense", "config": {
+                "name": "dense_1", "units": 8, "activation": "relu",
+                "use_bias": True, "batch_input_shape": [None, 4]}},
+            {"class_name": "Dense", "config": {
+                "name": "dense_2", "units": 3, "activation": "softmax",
+                "use_bias": True}},
+        ])
+        tcfg = {"loss": "categorical_crossentropy"}
+        path = str(tmp_path / "mlp.h5")
+        _write_keras_file(path, cfg, tcfg, {
+            "dense_1": {"dense_1/kernel:0": W1, "dense_1/bias:0": b1},
+            "dense_2": {"dense_2/kernel:0": W2, "dense_2/bias:0": b2},
+        })
+
+        net = import_keras_sequential_model_and_weights(path)
+        x = rng.normal(size=(5, 4)).astype(np.float32)
+        got = net.output(x)
+
+        h = np.maximum(x @ W1 + b1, 0.0)
+        logits = h @ W2 + b2
+        e = np.exp(logits - logits.max(axis=1, keepdims=True))
+        want = e / e.sum(axis=1, keepdims=True)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_training_config_makes_loss_head(self, tmp_path):
+        cfg = _seq_config([
+            {"class_name": "Dense", "config": {
+                "name": "d", "units": 2, "activation": "softmax",
+                "batch_input_shape": [None, 3]}},
+        ])
+        path = str(tmp_path / "m.h5")
+        _write_keras_file(path, cfg, {"loss": "categorical_crossentropy"}, {
+            "d": {"d/kernel:0": np.eye(3, 2, dtype=np.float32),
+                  "d/bias:0": np.zeros(2, np.float32)}})
+        net = import_keras_sequential_model_and_weights(path)
+        # imported net can train (has a score head)
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        x = np.random.default_rng(0).normal(size=(8, 3)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
+        loss = net.fit_batch(DataSet(x, y))
+        assert np.isfinite(loss)
+
+    def test_cnn_conv_pool_flatten_dense(self, tmp_path):
+        rng = _rng()
+        K = rng.normal(size=(3, 3, 1, 4), scale=0.5).astype(np.float32)  # HWIO
+        bk = rng.normal(size=(4,)).astype(np.float32)
+        # 8x8 input, 3x3 valid conv → 6x6, 2x2 pool → 3x3, flatten → 36 → dense 2
+        W = rng.normal(size=(36, 2), scale=0.5).astype(np.float32)
+        b = np.zeros(2, np.float32)
+        cfg = _seq_config([
+            {"class_name": "Conv2D", "config": {
+                "name": "conv", "filters": 4, "kernel_size": [3, 3],
+                "strides": [1, 1], "padding": "valid", "activation": "relu",
+                "data_format": "channels_last",
+                "batch_input_shape": [None, 8, 8, 1]}},
+            {"class_name": "MaxPooling2D", "config": {
+                "name": "pool", "pool_size": [2, 2], "strides": [2, 2],
+                "padding": "valid"}},
+            {"class_name": "Flatten", "config": {"name": "flat"}},
+            {"class_name": "Dense", "config": {
+                "name": "out", "units": 2, "activation": "linear"}},
+        ])
+        path = str(tmp_path / "cnn.h5")
+        _write_keras_file(path, cfg, None, {
+            "conv": {"conv/kernel:0": K, "conv/bias:0": bk},
+            "out": {"out/kernel:0": W, "out/bias:0": b},
+        })
+        net = import_keras_sequential_model_and_weights(path)
+        x = rng.normal(size=(2, 8, 8, 1)).astype(np.float32)
+        got = net.output(x)
+
+        # numpy reference conv (valid, stride 1) + relu + 2x2 maxpool
+        conv = np.zeros((2, 6, 6, 4), np.float32)
+        for i in range(6):
+            for j in range(6):
+                patch = x[:, i:i + 3, j:j + 3, :]  # [mb,3,3,1]
+                conv[:, i, j, :] = np.tensordot(patch, K, axes=([1, 2, 3], [0, 1, 2])) + bk
+        conv = np.maximum(conv, 0.0)
+        pooled = conv.reshape(2, 3, 2, 3, 2, 4).max(axis=(2, 4))
+        want = pooled.reshape(2, -1) @ W + b
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_batchnorm_import(self, tmp_path):
+        rng = _rng()
+        gamma = rng.normal(size=(5,)).astype(np.float32)
+        beta = rng.normal(size=(5,)).astype(np.float32)
+        mean = rng.normal(size=(5,)).astype(np.float32)
+        var = np.abs(rng.normal(size=(5,))).astype(np.float32) + 0.5
+        cfg = _seq_config([
+            {"class_name": "BatchNormalization", "config": {
+                "name": "bn", "epsilon": 1e-3, "momentum": 0.99, "axis": [1],
+                "batch_input_shape": [None, 5]}},
+        ])
+        path = str(tmp_path / "bn.h5")
+        _write_keras_file(path, cfg, None, {"bn": {
+            "bn/gamma:0": gamma, "bn/beta:0": beta,
+            "bn/moving_mean:0": mean, "bn/moving_variance:0": var}})
+        net = import_keras_sequential_model_and_weights(path)
+        x = rng.normal(size=(4, 5)).astype(np.float32)
+        got = net.output(x)
+        want = (x - mean) / np.sqrt(var + 1e-3) * gamma + beta
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_lstm_gate_reorder_parity(self, tmp_path):
+        """Keras [i|f|c|o] kernels → our [i|f|o|g]; outputs must match a
+        straight numpy LSTM using Keras semantics."""
+        rng = _rng()
+        n_in, units, T, mb = 3, 4, 5, 2
+        K = rng.normal(size=(n_in, 4 * units), scale=0.5).astype(np.float32)
+        R = rng.normal(size=(units, 4 * units), scale=0.5).astype(np.float32)
+        b = rng.normal(size=(4 * units,), scale=0.5).astype(np.float32)
+        cfg = _seq_config([
+            {"class_name": "LSTM", "config": {
+                "name": "lstm", "units": units, "activation": "tanh",
+                "recurrent_activation": "sigmoid", "return_sequences": True,
+                "unit_forget_bias": True,
+                "batch_input_shape": [None, T, n_in]}},
+        ])
+        path = str(tmp_path / "lstm.h5")
+        _write_keras_file(path, cfg, None, {"lstm": {
+            "lstm/kernel:0": K, "lstm/recurrent_kernel:0": R, "lstm/bias:0": b}})
+        net = import_keras_sequential_model_and_weights(path)
+        x = rng.normal(size=(mb, T, n_in)).astype(np.float32)
+        got = net.output(x)
+
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        h = np.zeros((mb, units), np.float32)
+        c = np.zeros((mb, units), np.float32)
+        want = np.zeros((mb, T, units), np.float32)
+        for t in range(T):
+            z = x[:, t] @ K + h @ R + b
+            i = sig(z[:, :units])
+            f = sig(z[:, units:2 * units])
+            g = np.tanh(z[:, 2 * units:3 * units])
+            o = sig(z[:, 3 * units:])
+            c = f * c + i * g
+            h = o * np.tanh(c)
+            want[:, t] = h
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+    def test_lstm_return_sequences_false_emits_last_step(self, tmp_path):
+        """Keras default return_sequences=False → only the last timestep."""
+        rng = _rng()
+        n_in, units, T = 3, 4, 5
+        K = rng.normal(size=(n_in, 4 * units), scale=0.5).astype(np.float32)
+        R = rng.normal(size=(units, 4 * units), scale=0.5).astype(np.float32)
+        b = np.zeros((4 * units,), np.float32)
+        cfg = _seq_config([
+            {"class_name": "LSTM", "config": {
+                "name": "lstm", "units": units, "activation": "tanh",
+                "recurrent_activation": "sigmoid", "return_sequences": False,
+                "batch_input_shape": [None, T, n_in]}},
+            {"class_name": "Dense", "config": {
+                "name": "d", "units": 2, "activation": "linear"}},
+        ])
+        path = str(tmp_path / "lstm_last.h5")
+        W = rng.normal(size=(units, 2)).astype(np.float32)
+        _write_keras_file(path, cfg, None, {
+            "lstm": {"lstm/kernel:0": K, "lstm/recurrent_kernel:0": R,
+                     "lstm/bias:0": b},
+            "d": {"d/kernel:0": W, "d/bias:0": np.zeros(2, np.float32)},
+        })
+        net = import_keras_sequential_model_and_weights(path)
+        x = rng.normal(size=(2, T, n_in)).astype(np.float32)
+        got = net.output(x)
+        assert got.shape == (2, 2)  # (mb, units) last step → dense, not (mb,T,2)
+
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        h = np.zeros((2, units), np.float32)
+        c = np.zeros((2, units), np.float32)
+        for t in range(T):
+            z = x[:, t] @ K + h @ R + b
+            i, f = sig(z[:, :units]), sig(z[:, units:2 * units])
+            g = np.tanh(z[:, 2 * units:3 * units])
+            o = sig(z[:, 3 * units:])
+            c = f * c + i * g
+            h = o * np.tanh(c)
+        np.testing.assert_allclose(got, h @ W, rtol=1e-4, atol=1e-4)
+
+    def test_lstm_loss_head_adds_no_params(self, tmp_path):
+        """training_config on an LSTM-final model must not invent a random
+        projection — a parameter-free LossLayer is appended instead."""
+        cfg = _seq_config([
+            {"class_name": "LSTM", "config": {
+                "name": "lstm", "units": 3, "return_sequences": True,
+                "batch_input_shape": [None, 4, 2]}},
+        ])
+        path = str(tmp_path / "l.h5")
+        rng = _rng()
+        _write_keras_file(path, cfg, {"loss": "mse"}, {"lstm": {
+            "lstm/kernel:0": rng.normal(size=(2, 12)).astype(np.float32),
+            "lstm/recurrent_kernel:0": rng.normal(size=(3, 12)).astype(np.float32),
+            "lstm/bias:0": np.zeros(12, np.float32)}})
+        net = import_keras_sequential_model_and_weights(path)
+        from deeplearning4j_tpu.nn.layers import LossLayer
+        assert isinstance(net.conf.layers[-1], LossLayer)
+        assert net.params[-1] == {}  # no invented weights
+
+    def test_keras1_nb_row_nb_col(self, tmp_path):
+        """Keras 1.x non-square Convolution2D: nb_row x nb_col respected."""
+        rng = _rng()
+        K = rng.normal(size=(3, 5, 1, 2), scale=0.5).astype(np.float32)
+        cfg = _seq_config([
+            {"class_name": "Convolution2D", "config": {
+                "name": "c", "nb_filter": 2, "nb_row": 3, "nb_col": 5,
+                "activation": "linear", "border_mode": "valid",
+                "batch_input_shape": [None, 8, 8, 1]}},
+        ])
+        path = str(tmp_path / "k1conv.h5")
+        _write_keras_file(path, cfg, None, {
+            "c": {"c/kernel:0": K, "c/bias:0": np.zeros(2, np.float32)}})
+        net = import_keras_sequential_model_and_weights(path)
+        assert net.conf.layers[0].kernel == (3, 5)
+        x = rng.normal(size=(1, 8, 8, 1)).astype(np.float32)
+        assert net.output(x).shape == (1, 6, 4, 2)
+
+    def test_bn_bad_axis_rejected(self, tmp_path):
+        cfg = _seq_config([
+            {"class_name": "Conv2D", "config": {
+                "name": "c", "filters": 2, "kernel_size": [3, 3],
+                "batch_input_shape": [None, 8, 8, 1]}},
+            {"class_name": "BatchNormalization", "config": {
+                "name": "bn", "axis": 1}},  # channels_first-style BN on 4D
+        ])
+        path = str(tmp_path / "bnax.h5")
+        _write_keras_file(path, cfg, None, {})
+        with pytest.raises(InvalidKerasConfigurationException):
+            import_keras_sequential_model_and_weights(path)
+
+    def test_embedding_import(self, tmp_path):
+        rng = _rng()
+        E = rng.normal(size=(10, 6)).astype(np.float32)
+        cfg = _seq_config([
+            {"class_name": "Embedding", "config": {
+                "name": "emb", "input_dim": 10, "output_dim": 6,
+                "batch_input_shape": [None, 4]}},
+        ])
+        path = str(tmp_path / "emb.h5")
+        _write_keras_file(path, cfg, None, {"emb": {"emb/embeddings:0": E}})
+        net = import_keras_sequential_model_and_weights(path)
+        idx = np.array([[1, 2, 3, 4], [5, 6, 7, 8]], np.int32)
+        got = net.output(idx)
+        np.testing.assert_allclose(got, E[idx], rtol=1e-6, atol=1e-6)
+
+
+class TestFunctionalImport:
+    def test_two_branch_add(self, tmp_path):
+        rng = _rng()
+        Wa = rng.normal(size=(4, 6)).astype(np.float32)
+        ba = np.zeros(6, np.float32)
+        Wb = rng.normal(size=(4, 6)).astype(np.float32)
+        bb = np.zeros(6, np.float32)
+        Wo = rng.normal(size=(6, 2)).astype(np.float32)
+        bo = np.zeros(2, np.float32)
+        cfg = {"class_name": "Model", "config": {
+            "layers": [
+                {"class_name": "InputLayer", "config": {
+                    "name": "in", "batch_input_shape": [None, 4]},
+                 "inbound_nodes": []},
+                {"class_name": "Dense", "config": {
+                    "name": "a", "units": 6, "activation": "relu"},
+                 "inbound_nodes": [[["in", 0, 0, {}]]]},
+                {"class_name": "Dense", "config": {
+                    "name": "b", "units": 6, "activation": "relu"},
+                 "inbound_nodes": [[["in", 0, 0, {}]]]},
+                {"class_name": "Add", "config": {"name": "add"},
+                 "inbound_nodes": [[["a", 0, 0, {}], ["b", 0, 0, {}]]]},
+                {"class_name": "Dense", "config": {
+                    "name": "out", "units": 2, "activation": "linear"},
+                 "inbound_nodes": [[["add", 0, 0, {}]]]},
+            ],
+            "input_layers": [["in", 0, 0]],
+            "output_layers": [["out", 0, 0]],
+        }}
+        path = str(tmp_path / "func.h5")
+        _write_keras_file(path, cfg, None, {
+            "a": {"a/kernel:0": Wa, "a/bias:0": ba},
+            "b": {"b/kernel:0": Wb, "b/bias:0": bb},
+            "out": {"out/kernel:0": Wo, "out/bias:0": bo},
+        })
+        graph = import_keras_model_and_weights(path)
+        x = rng.normal(size=(3, 4)).astype(np.float32)
+        got = graph.output(x)[0]
+        ha = np.maximum(x @ Wa + ba, 0)
+        hb = np.maximum(x @ Wb + bb, 0)
+        want = (ha + hb) @ Wo + bo
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+    def test_concatenate_merge(self, tmp_path):
+        rng = _rng()
+        Wa = rng.normal(size=(3, 2)).astype(np.float32)
+        Wb = rng.normal(size=(3, 5)).astype(np.float32)
+        cfg = {"class_name": "Model", "config": {
+            "layers": [
+                {"class_name": "InputLayer", "config": {
+                    "name": "in", "batch_input_shape": [None, 3]},
+                 "inbound_nodes": []},
+                {"class_name": "Dense", "config": {
+                    "name": "a", "units": 2, "activation": "linear",
+                    "use_bias": False},
+                 "inbound_nodes": [[["in", 0, 0, {}]]]},
+                {"class_name": "Dense", "config": {
+                    "name": "b", "units": 5, "activation": "linear",
+                    "use_bias": False},
+                 "inbound_nodes": [[["in", 0, 0, {}]]]},
+                {"class_name": "Concatenate", "config": {"name": "cat"},
+                 "inbound_nodes": [[["a", 0, 0, {}], ["b", 0, 0, {}]]]},
+            ],
+            "input_layers": [["in", 0, 0]],
+            "output_layers": [["cat", 0, 0]],
+        }}
+        path = str(tmp_path / "cat.h5")
+        _write_keras_file(path, cfg, None, {
+            "a": {"a/kernel:0": Wa}, "b": {"b/kernel:0": Wb}})
+        graph = import_keras_model_and_weights(path)
+        x = rng.normal(size=(2, 3)).astype(np.float32)
+        got = graph.output(x)[0]
+        want = np.concatenate([x @ Wa, x @ Wb], axis=1)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+class TestImportErrors:
+    def test_channels_first_rejected(self, tmp_path):
+        cfg = _seq_config([
+            {"class_name": "Conv2D", "config": {
+                "name": "c", "filters": 2, "kernel_size": [3, 3],
+                "data_format": "channels_first",
+                "batch_input_shape": [None, 1, 8, 8]}},
+        ])
+        path = str(tmp_path / "cf.h5")
+        _write_keras_file(path, cfg, None, {})
+        with pytest.raises(InvalidKerasConfigurationException):
+            import_keras_sequential_model_and_weights(path)
+
+    def test_unknown_layer_rejected(self, tmp_path):
+        cfg = _seq_config([
+            {"class_name": "Lambda", "config": {
+                "name": "l", "batch_input_shape": [None, 3]}},
+        ])
+        path = str(tmp_path / "lam.h5")
+        _write_keras_file(path, cfg, None, {})
+        with pytest.raises(InvalidKerasConfigurationException):
+            import_keras_sequential_model_and_weights(path)
+
+    def test_name_maps(self):
+        assert map_activation("linear") == "identity"
+        assert map_activation("hard_sigmoid") == "hardsigmoid"
+        assert map_loss("categorical_crossentropy") == "mcxent"
+        assert map_loss("mse") == "mse"
+        with pytest.raises(InvalidKerasConfigurationException):
+            map_activation("made_up")
+
+    def test_entrypoint_class(self):
+        assert KerasModelImport.import_keras_model_and_weights is import_keras_model_and_weights
+
+    def test_shared_layer_rejected(self, tmp_path):
+        cfg = {"class_name": "Model", "config": {
+            "layers": [
+                {"class_name": "InputLayer", "config": {
+                    "name": "in", "batch_input_shape": [None, 3]},
+                 "inbound_nodes": []},
+                {"class_name": "Dense", "config": {
+                    "name": "shared", "units": 3, "activation": "linear"},
+                 "inbound_nodes": [[["in", 0, 0, {}]], [["shared", 0, 0, {}]]]},
+            ],
+            "input_layers": [["in", 0, 0]],
+            "output_layers": [["shared", 1, 0]],
+        }}
+        path = str(tmp_path / "shared.h5")
+        _write_keras_file(path, cfg, None, {})
+        with pytest.raises(InvalidKerasConfigurationException):
+            import_keras_model_and_weights(path)
+
+    def test_weights_only_file_rejected(self, tmp_path):
+        path = str(tmp_path / "w.h5")
+        with h5py.File(path, "w") as f:  # save_weights format: no model_config
+            g = f.create_group("dense_1")
+            g.create_dataset("dense_1/kernel:0", data=np.zeros((2, 2), np.float32))
+        with pytest.raises(InvalidKerasConfigurationException):
+            import_keras_model_and_weights(path)
