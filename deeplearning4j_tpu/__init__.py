@@ -19,7 +19,12 @@ Top-level layout:
     parallel/     mesh builders, DP/TP/SP training, ring attention,
                   parallel inference                    (replaces scaleout, L4)
     models/       model zoo                             (replaces deeplearning4j-zoo, L5)
-    nlp/          embeddings (Word2Vec family)          (replaces deeplearning4j-nlp, L5)
+    nlp/          embeddings: Word2Vec family, SequenceVectors,
+                  ParagraphVectors, GloVe               (replaces deeplearning4j-nlp, L5)
+    graph/        graph + random walks + DeepWalk       (replaces deeplearning4j-graph, L5)
+    clustering/   KMeans + brute-force KNN on the MXU   (replaces nearestneighbors, L5)
+    plot/         exact t-SNE, device-resident          (replaces core plot/, L3)
+    modelimport/  Keras HDF5 import                     (replaces deeplearning4j-modelimport, L5)
     utils/        serialization, gradient checks        (replaces util/, gradientcheck/)
 """
 

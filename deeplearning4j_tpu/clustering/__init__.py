@@ -1,0 +1,15 @@
+"""Clustering + nearest neighbors (replaces
+deeplearning4j-nearestneighbors-parent, SURVEY.md §2.4).
+
+TPU inversion: the reference's pointer-chasing spatial trees (VPTree,
+KDTree, SPTree) are replaced by brute-force tiled distance matmuls — the
+‖a−b‖² = ‖a‖² + ‖b‖² − 2a·b expansion turns neighbor search into one MXU
+matmul + top-k, which beats tree traversal on TPU for any dataset that fits
+in HBM (the reference itself falls back to brute force on GPU for the same
+reason).
+"""
+
+from .kmeans import KMeansClustering
+from .knn import NearestNeighbors, pairwise_distances
+
+__all__ = ["KMeansClustering", "NearestNeighbors", "pairwise_distances"]

@@ -1,0 +1,121 @@
+"""K-Means (Lloyd) as jit-compiled distance matmuls + segment sums.
+
+Parity target: reference clustering/kmeans/KMeansClustering.java +
+algorithm/BaseClusteringAlgorithm.java (iterationCount /
+distanceConvergence strategies, varianceDistance option).
+
+TPU inversion: each Lloyd iteration is ONE XLA program — assignment via
+the ‖x−c‖² matmul expansion, centroid update via one-hot matmul (a dense
+[N,K]ᵀ[N,D] product the MXU handles) — instead of the reference's
+per-point loops over cluster objects.  k-means++ seeding matches the
+reference's ClusterUtils.initClusters probabilistic spread.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _assign(x: Array, centroids: Array) -> Tuple[Array, Array]:
+    """Distance block + argmin: (assignments [N], d2 [N,K]).  Shares the
+    matmul-expansion kernel with knn._dist_block."""
+    from .knn import _dist_block
+
+    d2 = _dist_block(x, centroids, "euclidean")           # [N,K], clamped ≥ 0
+    return jnp.argmin(d2, axis=1), d2
+
+
+@jax.jit
+def _assign_inertia(x: Array, centroids: Array) -> Tuple[Array, Array]:
+    assign, d2 = _assign(x, centroids)
+    return assign, jnp.sum(jnp.min(d2, axis=1))
+
+
+@jax.jit
+def _lloyd_step(x: Array, centroids: Array) -> Tuple[Array, Array, Array]:
+    """One Lloyd iteration: assign + recompute.  x [N,D], centroids [K,D].
+    Returns (new_centroids, assignments, inertia) — assignments/inertia are
+    relative to the INPUT centroids (the caller re-assigns at the end)."""
+    assign, d2 = _assign(x, centroids)
+    inertia = jnp.sum(jnp.min(d2, axis=1))
+    onehot = jax.nn.one_hot(assign, centroids.shape[0], dtype=x.dtype)  # [N,K]
+    sums = onehot.T @ x                                   # [K,D] — MXU matmul
+    counts = jnp.sum(onehot, axis=0)                      # [K]
+    new_c = jnp.where(counts[:, None] > 0, sums / jnp.maximum(counts[:, None], 1.0),
+                      centroids)                          # empty cluster keeps old
+    return new_c, assign, inertia
+
+
+class KMeansClustering:
+    """setup(k, max_iterations | convergence) + apply_to(points)
+    (reference KMeansClustering.setup variants)."""
+
+    def __init__(self, k: int, max_iterations: int = 100, tol: float = 1e-4,
+                 init: str = "kmeans++", seed: int = 12345):
+        if k < 1:
+            raise ValueError("k must be >= 1")
+        self.k = k
+        self.max_iterations = max_iterations
+        self.tol = tol
+        self.init = init
+        self.seed = seed
+        self.centroids: Optional[np.ndarray] = None
+        self.inertia_: Optional[float] = None
+        self.n_iter_: int = 0
+
+    @staticmethod
+    def setup(k: int, max_iterations: int = 100, **kw) -> "KMeansClustering":
+        return KMeansClustering(k, max_iterations, **kw)
+
+    def _init_centroids(self, x: np.ndarray, rng) -> np.ndarray:
+        if self.init == "random":
+            idx = rng.choice(x.shape[0], self.k, replace=False)
+            return x[idx].copy()
+        # k-means++ (Arthur & Vassilvitskii 2007)
+        centroids = [x[rng.integers(0, x.shape[0])]]
+        d2 = np.full(x.shape[0], np.inf)
+        for _ in range(1, self.k):
+            last = centroids[-1]
+            d2 = np.minimum(d2, np.sum((x - last) ** 2, axis=1))
+            p = d2 / d2.sum() if d2.sum() > 0 else None
+            centroids.append(x[rng.choice(x.shape[0], p=p)])
+        return np.stack(centroids)
+
+    def apply_to(self, points) -> np.ndarray:
+        """Cluster; returns assignments [N]."""
+        x = np.asarray(points, np.float32)
+        if x.ndim != 2 or x.shape[0] < self.k:
+            raise ValueError(f"need [N>=k,D] points, got {x.shape} with k={self.k}")
+        rng = np.random.default_rng(self.seed)
+        c = jnp.asarray(self._init_centroids(x, rng))
+        xj = jnp.asarray(x)
+        prev_inertia = np.inf
+        for it in range(self.max_iterations):
+            c, _, inertia = _lloyd_step(xj, c)
+            inertia = float(inertia)
+            self.n_iter_ = it + 1
+            if np.isfinite(prev_inertia) and \
+                    prev_inertia - inertia <= self.tol * max(abs(prev_inertia), 1.0):
+                break
+            prev_inertia = inertia
+        # final assignment/inertia against the FINAL centroids, so
+        # fit_predict(x) == predict(x) and inertia_ matches self.centroids
+        assign, inertia = _assign_inertia(xj, c)
+        self.centroids = np.asarray(c)
+        self.inertia_ = float(inertia)
+        return np.asarray(assign)
+
+    fit_predict = apply_to
+
+    def predict(self, points) -> np.ndarray:
+        if self.centroids is None:
+            raise ValueError("apply_to before predict")
+        x = jnp.asarray(np.asarray(points, np.float32))
+        return np.asarray(_assign_inertia(x, jnp.asarray(self.centroids))[0])

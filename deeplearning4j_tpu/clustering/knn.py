@@ -1,0 +1,87 @@
+"""Brute-force nearest neighbors on the MXU.
+
+Parity target: reference nearestneighbor-core VPTree.java (vantage-point
+tree search) + NearestNeighbor.java server ops.  The tree is replaced by
+tiled distance matmuls + jax.lax.top_k — O(N·Q·D) FLOPs that the MXU eats,
+with query tiling to bound HBM (SURVEY's "brute-force-on-TPU" note).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+@partial(jax.jit, static_argnums=(2,))
+def _dist_block(queries: Array, points: Array, metric: str = "euclidean") -> Array:
+    """[Q,D] × [N,D] → [Q,N] distances via the matmul expansion."""
+    if metric == "cosine":
+        qn = queries / jnp.maximum(jnp.linalg.norm(queries, axis=1, keepdims=True), 1e-12)
+        pn = points / jnp.maximum(jnp.linalg.norm(points, axis=1, keepdims=True), 1e-12)
+        return 1.0 - qn @ pn.T
+    if metric == "manhattan":
+        return jnp.sum(jnp.abs(queries[:, None, :] - points[None, :, :]), axis=-1)
+    # euclidean²: ‖q‖² + ‖p‖² − 2q·p  (one MXU matmul)
+    q2 = jnp.sum(queries * queries, axis=1, keepdims=True)   # [Q,1]
+    p2 = jnp.sum(points * points, axis=1)                    # [N]
+    d2 = q2 + p2[None, :] - 2.0 * (queries @ points.T)
+    return jnp.maximum(d2, 0.0)
+
+
+def pairwise_distances(a, b=None, metric: str = "euclidean") -> np.ndarray:
+    """All-pairs distance matrix (euclidean returns TRUE distances)."""
+    a = jnp.asarray(a, jnp.float32)
+    b = a if b is None else jnp.asarray(b, jnp.float32)
+    d = _dist_block(a, b, metric)
+    if metric == "euclidean":
+        d = jnp.sqrt(d)
+    return np.asarray(d)
+
+
+@partial(jax.jit, static_argnums=(2, 3))
+def _topk_block(queries: Array, points: Array, k: int, metric: str) -> Tuple[Array, Array]:
+    d = _dist_block(queries, points, metric)
+    neg_d, idx = jax.lax.top_k(-d, k)
+    return -neg_d, idx
+
+
+class NearestNeighbors:
+    """KNN index (reference VPTree surface: knn(point, k) → ids+distances).
+
+    ``query_block`` tiles large query sets so the [Q,N] distance block
+    stays within HBM.
+    """
+
+    def __init__(self, points, metric: str = "euclidean",
+                 query_block: int = 4096):
+        self.points = jnp.asarray(np.asarray(points, np.float32))
+        if self.points.ndim != 2:
+            raise ValueError(f"points must be [N,D], got {self.points.shape}")
+        self.metric = metric
+        self.query_block = query_block
+
+    def knn(self, queries, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """→ (distances [Q,k], indices [Q,k]), nearest first.  Euclidean
+        distances are true (sqrt'd) distances."""
+        q = np.asarray(queries, np.float32)
+        squeeze = q.ndim == 1
+        if squeeze:
+            q = q[None, :]
+        k = min(k, self.points.shape[0])
+        outs_d, outs_i = [], []
+        for s in range(0, q.shape[0], self.query_block):
+            d, i = _topk_block(jnp.asarray(q[s:s + self.query_block]),
+                               self.points, k, self.metric)
+            outs_d.append(np.asarray(d))
+            outs_i.append(np.asarray(i))
+        d = np.concatenate(outs_d)
+        i = np.concatenate(outs_i)
+        if self.metric == "euclidean":
+            d = np.sqrt(d)
+        return (d[0], i[0]) if squeeze else (d, i)

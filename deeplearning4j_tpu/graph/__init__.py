@@ -1,0 +1,7 @@
+"""Graph embeddings (replaces deeplearning4j-graph, SURVEY.md §2.4)."""
+
+from .graph import Graph
+from .walks import RandomWalkIterator, WeightedWalkIterator
+from .deepwalk import DeepWalk
+
+__all__ = ["Graph", "RandomWalkIterator", "WeightedWalkIterator", "DeepWalk"]

@@ -11,4 +11,6 @@ the MXU, one XLA program per step, no lock-free mutation needed.
 from .tokenization import DefaultTokenizerFactory, CommonPreprocessor
 from .vocab import VocabCache, VocabWord, build_vocab, Huffman
 from .word2vec import Word2Vec
+from .sequencevectors import SequenceVectors, ParagraphVectors, WordVectorsBase
+from .glove import Glove, CoOccurrences
 from .serializer import write_word_vectors, read_word_vectors

@@ -1,0 +1,175 @@
+"""GloVe — global vectors from a weighted co-occurrence factorization.
+
+Parity targets: reference models/glove/Glove.java (Builder: xMax, alpha,
+learningRate, epochs, symmetric) + models/glove/AbstractCoOccurrences.java
+(windowed 1/distance-weighted counting) + the AdaGrad element math in
+GloveWeightLookupTable.
+
+TPU inversion: the reference streams co-occurrence pairs through per-thread
+AdaGrad updates; here the nonzero co-occurrence entries are shuffled into
+fixed-size batches and each batch is one jit-compiled step — dense batched
+gathers/matmuls for the loss, scatter-adds for the sparse AdaGrad update.
+Loss (Pennington et al. 2014):
+    J = Σ f(X_ij) (wᵢ·w̃ⱼ + bᵢ + b̃ⱼ − log X_ij)²,   f(x) = min(1, (x/xmax)^α)
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sequencevectors import WordVectorsBase
+from .tokenization import DefaultTokenizerFactory
+from .vocab import VocabCache, build_vocab
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def _glove_step(W, Wc, b, bc, hW, hWc, hb, hbc, rows, cols, logx, fx, lr):
+    """One AdaGrad batch over co-occurrence entries.
+
+    W/Wc [V,D] center/context tables, b/bc [V] biases, h* AdaGrad
+    accumulators.  rows/cols [B] word indices, logx [B] = log X_ij,
+    fx [B] = f(X_ij) weights (0 for padding rows).
+    """
+    wi = W[rows]                      # [B,D]
+    wj = Wc[cols]
+    diff = jnp.einsum("bd,bd->b", wi, wj) + b[rows] + bc[cols] - logx  # [B]
+    fdiff = fx * diff                 # [B]
+    loss = 0.5 * jnp.sum(fdiff * diff)
+
+    gwi = fdiff[:, None] * wj         # [B,D]
+    gwj = fdiff[:, None] * wi
+    gbi = fdiff
+    gbj = fdiff
+
+    # AdaGrad: accumulate squared grads, scale update by 1/sqrt(hist)
+    def upd(table, hist, idx, g):
+        hist = hist.at[idx].add(g * g)
+        step = lr * g / jnp.sqrt(jnp.maximum(hist[idx], 1e-12))
+        return table.at[idx].add(-step), hist
+
+    W, hW = upd(W, hW, rows, gwi)
+    Wc, hWc = upd(Wc, hWc, cols, gwj)
+    b, hb = upd(b, hb, rows, gbi)
+    bc, hbc = upd(bc, hbc, cols, gbj)
+    return W, Wc, b, bc, hW, hWc, hb, hbc, loss
+
+
+class CoOccurrences:
+    """Windowed co-occurrence counting with 1/distance weighting
+    (reference AbstractCoOccurrences.java, symmetric window)."""
+
+    def __init__(self, window: int = 15, symmetric: bool = True):
+        self.window = window
+        self.symmetric = symmetric
+
+    def count(self, idx_corpus: Iterable[np.ndarray]) -> Dict[Tuple[int, int], float]:
+        cooc: Dict[Tuple[int, int], float] = {}
+        for sent in idx_corpus:
+            n = len(sent)
+            for pos in range(n):
+                w = int(sent[pos])
+                hi = min(n, pos + self.window + 1)
+                for j in range(pos + 1, hi):
+                    c = int(sent[j])
+                    weight = 1.0 / (j - pos)
+                    cooc[(w, c)] = cooc.get((w, c), 0.0) + weight
+                    if self.symmetric:
+                        cooc[(c, w)] = cooc.get((c, w), 0.0) + weight
+        return cooc
+
+
+class Glove(WordVectorsBase):
+    """GloVe trainer (reference Glove.Builder surface)."""
+
+    def __init__(self,
+                 layer_size: int = 100,
+                 window: int = 15,
+                 min_word_frequency: int = 1,
+                 xmax: float = 100.0,
+                 alpha: float = 0.75,
+                 learning_rate: float = 0.05,
+                 epochs: int = 25,
+                 batch_size: int = 4096,
+                 symmetric: bool = True,
+                 seed: int = 12345,
+                 tokenizer_factory=None):
+        self.layer_size = layer_size
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.xmax = xmax
+        self.alpha = alpha
+        self.lr = learning_rate
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.symmetric = symmetric
+        self.seed = seed
+        self.tokenizer = tokenizer_factory or DefaultTokenizerFactory()
+        self.vocab: Optional[VocabCache] = None
+        self.syn0: Optional[np.ndarray] = None
+        self._norms = None
+
+    def fit(self, sentences: Iterable) -> "Glove":
+        corpus = [self.tokenizer.tokenize(s) if isinstance(s, str) else list(s)
+                  for s in sentences]
+        self.vocab = build_vocab(corpus, self.min_word_frequency)
+        if len(self.vocab) == 0:
+            raise ValueError("empty vocabulary — lower min_word_frequency?")
+        V, D = len(self.vocab), self.layer_size
+        idx_corpus = [np.asarray([self.vocab.index_of(t) for t in s
+                                  if t in self.vocab], np.int32)
+                      for s in corpus]
+
+        cooc = CoOccurrences(self.window, self.symmetric).count(idx_corpus)
+        if not cooc:
+            raise ValueError("no co-occurrences — corpus too small?")
+        entries = np.asarray([(i, j, x) for (i, j), x in cooc.items()], np.float64)
+        rows_all = entries[:, 0].astype(np.int32)
+        cols_all = entries[:, 1].astype(np.int32)
+        xs = entries[:, 2]
+        logx_all = np.log(xs).astype(np.float32)
+        fx_all = np.minimum(1.0, (xs / self.xmax) ** self.alpha).astype(np.float32)
+        N = len(rows_all)
+
+        rng = np.random.default_rng(self.seed)
+        scale = 0.5 / D
+        W = jnp.asarray(((rng.random((V, D)) - 0.5) * 2 * scale).astype(np.float32))
+        Wc = jnp.asarray(((rng.random((V, D)) - 0.5) * 2 * scale).astype(np.float32))
+        b = jnp.zeros((V,), jnp.float32)
+        bc = jnp.zeros((V,), jnp.float32)
+        hW = jnp.ones((V, D), jnp.float32)   # GloVe convention: hist init 1
+        hWc = jnp.ones((V, D), jnp.float32)
+        hb = jnp.ones((V,), jnp.float32)
+        hbc = jnp.ones((V,), jnp.float32)
+
+        B = min(self.batch_size, max(64, N))
+        lr_j = jnp.asarray(self.lr, jnp.float32)
+        self.losses: List[float] = []
+        for ep in range(self.epochs):
+            perm = rng.permutation(N)
+            ep_loss, nb = 0.0, 0
+            for s in range(0, N, B):
+                sel = perm[s:s + B]
+                pad = B - len(sel)
+                r = np.concatenate([rows_all[sel], np.zeros(pad, np.int32)])
+                c = np.concatenate([cols_all[sel], np.zeros(pad, np.int32)])
+                lx = np.concatenate([logx_all[sel], np.zeros(pad, np.float32)])
+                fw = np.concatenate([fx_all[sel], np.zeros(pad, np.float32)])
+                W, Wc, b, bc, hW, hWc, hb, hbc, loss = _glove_step(
+                    W, Wc, b, bc, hW, hWc, hb, hbc,
+                    jnp.asarray(r), jnp.asarray(c), jnp.asarray(lx),
+                    jnp.asarray(fw), lr_j)
+                ep_loss += float(loss)
+                nb += 1
+            self.losses.append(ep_loss / max(nb, 1))
+        # standard GloVe: final embedding = W + context table
+        self.syn0 = np.asarray(W) + np.asarray(Wc)
+        self._norms = None
+        return self

@@ -1,0 +1,640 @@
+"""SequenceVectors — the generic embedding trainer the word2vec family
+shares.
+
+Parity target: reference models/sequencevectors/SequenceVectors.java:49,192
+(the abstract trainer over SequenceElements that Word2Vec, ParagraphVectors
+and DeepWalk all extend) + elements-learning/sequence-learning algorithm
+split (embeddings/learning/impl/elements/*, sequence/*).
+
+TPU inversion (same as nlp/word2vec.py): the reference's Hogwild thread
+pool over sentences becomes host-side window/negative sampling feeding
+jit-compiled batched scatter-add updates.  The *sequence label* concept
+(DL4J's `trainSequencesRepresentation` — doc vectors, node vectors) is
+implemented by extending the input table with one row per label:
+  rows [0, V)      — element (word) vectors
+  rows [V, V+L)    — sequence-label vectors (paragraph/doc ids)
+Labels participate as *inputs* only (syn0 side); prediction targets are
+always elements, so the output tables/negative sampling never see them.
+
+Training modes map to the reference's learning algorithms:
+  - elements + skip-gram  = SkipGram.java
+  - elements + cbow       = CBOW.java
+  - labels   + dbow       = DBOW.java  (label predicts each window word)
+  - labels   + dm         = DM.java    (label joins the averaged context)
+"""
+
+from __future__ import annotations
+
+import logging
+from functools import partial
+from typing import Dict, Hashable, Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .vocab import Huffman, VocabCache, build_vocab
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+# ---------------------------------------------------------------------------
+# jit-compiled sparse update steps (shared by Word2Vec / ParagraphVectors /
+# DeepWalk; see module docstring for the batching-vs-sequential rationale)
+# ---------------------------------------------------------------------------
+
+def _occurrence_scale(indices: jnp.ndarray, vocab_size: int,
+                      weights: jnp.ndarray) -> jnp.ndarray:
+    """weights/count(row) per entry: rows hit k times in one batch receive
+    the AVERAGE of their k updates, not the sum.  A batch applies updates
+    against stale table values, so summing k near-identical updates
+    multiplies the effective lr by k and diverges on small vocabs; averaging
+    recovers sequential-SGD magnitude (the Hogwild path's implicit behavior).
+
+    `weights` is 1.0 for genuine entries and 0.0 for padding, so pad slots
+    (which alias index 0 — the most frequent word) neither receive updates
+    nor dilute the occurrence counts of real entries."""
+    counts = jnp.zeros((vocab_size,), jnp.float32).at[indices].add(weights)
+    return weights / jnp.maximum(counts[indices], 1.0)
+
+
+def _sg_chunk(syn0, syn1, centers, contexts, negatives, valid, lr):
+    """Skip-gram negative-sampling sparse update (one micro-chunk).
+
+    centers [B], contexts [B], negatives [B,K], valid [B] (0 = pad row).
+    Classic updates (Mikolov 2013):
+        for target t with label l:  g = (l - σ(v·u_t)) * lr
+        v      += Σ g * u_t ;  u_t += g * v
+    """
+    v = syn0[centers]                         # [B,D]
+    targets = jnp.concatenate([contexts[:, None], negatives], axis=1)  # [B,1+K]
+    labels = jnp.zeros(targets.shape, syn0.dtype).at[:, 0].set(1.0)
+    u = syn1[targets]                         # [B,1+K,D]
+    score = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", v, u))
+    g = (labels - score) * lr * valid[:, None]  # [B,1+K]
+    dv = jnp.einsum("bk,bkd->bd", g, u)
+    du = g[..., None] * v[:, None, :]         # [B,1+K,D]
+    flat_t = targets.reshape(-1)
+    flat_tw = jnp.broadcast_to(valid[:, None], targets.shape).reshape(-1)
+    syn0 = syn0.at[centers].add(
+        dv * _occurrence_scale(centers, syn0.shape[0], valid)[:, None])
+    syn1 = syn1.at[flat_t].add(
+        du.reshape(-1, du.shape[-1])
+        * _occurrence_scale(flat_t, syn1.shape[0], flat_tw)[:, None])
+    return syn0, syn1
+
+
+@partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
+def _sg_neg_step(syn0, syn1, centers, contexts, negatives, valid, lr, chunks=1):
+    """Skip-gram step; ``chunks`` > 1 scans micro-chunks that each re-read
+    the freshly updated tables.  Word2Vec uses chunks=1 (rows recur across
+    batches anyway); sequence-label training (DBOW) needs chunking because a
+    label's pairs are CONSECUTIVE — one batch would average them into a
+    single effective update (see _occurrence_scale)."""
+    if chunks <= 1:
+        return _sg_chunk(syn0, syn1, centers, contexts, negatives, valid, lr)
+
+    def body(tables, args):
+        s0, s1 = tables
+        c, t, n, v = args
+        return _sg_chunk(s0, s1, c, t, n, v, lr), None
+
+    def split(a):
+        return a.reshape(chunks, a.shape[0] // chunks, *a.shape[1:])
+
+    (syn0, syn1), _ = jax.lax.scan(
+        body, (syn0, syn1),
+        (split(centers), split(contexts), split(negatives), split(valid)))
+    return syn0, syn1
+
+
+def _cbow_chunk(syn0, syn1, context_windows, window_mask, targets_pos,
+                negatives, lr):
+    """One CBOW negative-sampling micro-chunk: input = mean of context
+    vectors; the full output-side gradient is added to EVERY context word,
+    matching reference CBOW.java:104-209 (neu1e accumulated once, applied
+    undivided per word).  Pad rows have an all-zero window_mask and
+    contribute nothing."""
+    ctx = syn0[context_windows]               # [B,W,D]
+    m = window_mask[..., None]
+    valid = (jnp.sum(window_mask, axis=1) > 0).astype(syn0.dtype)  # [B]
+    denom = jnp.maximum(jnp.sum(window_mask, axis=1, keepdims=True), 1.0)
+    h = jnp.sum(ctx * m, axis=1) / denom      # [B,D]
+    targets = jnp.concatenate([targets_pos[:, None], negatives], axis=1)
+    labels = jnp.zeros(targets.shape, syn0.dtype).at[:, 0].set(1.0)
+    u = syn1[targets]
+    score = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, u))
+    g = (labels - score) * lr * valid[:, None]
+    dh = jnp.einsum("bk,bkd->bd", g, u)       # full neu1e per context word
+    du = g[..., None] * h[:, None, :]
+    flat_t = targets.reshape(-1)
+    flat_tw = jnp.broadcast_to(valid[:, None], targets.shape).reshape(-1)
+    syn1 = syn1.at[flat_t].add(
+        du.reshape(-1, du.shape[-1])
+        * _occurrence_scale(flat_t, syn1.shape[0], flat_tw)[:, None])
+    dctx = jnp.broadcast_to(dh[:, None, :], ctx.shape) * m
+    flat_c = context_windows.reshape(-1)
+    flat_cw = window_mask.reshape(-1)
+    syn0 = syn0.at[flat_c].add(
+        dctx.reshape(-1, dctx.shape[-1])
+        * _occurrence_scale(flat_c, syn0.shape[0], flat_cw)[:, None])
+    return syn0, syn1
+
+
+@partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
+def _cbow_neg_step(syn0, syn1, context_windows, window_mask, targets_pos,
+                   negatives, lr, chunks=1):
+    """CBOW step: lax.scan over `chunks` micro-chunks, each re-reading the
+    freshly updated tables.  CBOW emits one row per center word (~2·window
+    fewer rows than skip-gram), so whole-batch averaging starves it of
+    effective sequential steps on small vocabs; chunked application restores
+    the reference's sequential-SGD semantics while keeping batched matmuls."""
+    if chunks <= 1:
+        return _cbow_chunk(syn0, syn1, context_windows, window_mask,
+                           targets_pos, negatives, lr)
+
+    def body(tables, args):
+        s0, s1 = tables
+        c, m, t, n = args
+        return _cbow_chunk(s0, s1, c, m, t, n, lr), None
+
+    def split(a):
+        return a.reshape(chunks, a.shape[0] // chunks, *a.shape[1:])
+
+    (syn0, syn1), _ = jax.lax.scan(
+        body, (syn0, syn1),
+        (split(context_windows), split(window_mask), split(targets_pos),
+         split(negatives)))
+    return syn0, syn1
+
+
+def _sg_hs_chunk(syn0, syn1hs, centers, points, codes, code_mask, lr):
+    """Skip-gram hierarchical softmax (one micro-chunk): walk the Huffman
+    path (reference SkipGram iterateSample hierarchic-softmax branch).
+    points/codes [B,L] padded, code_mask [B,L] (all-zero row = pad)."""
+    v = syn0[centers]                          # [B,D]
+    u = syn1hs[points]                         # [B,L,D]
+    valid = (jnp.sum(code_mask, axis=1) > 0).astype(syn0.dtype)  # [B]
+    score = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", v, u))
+    # label = 1 - code (word2vec convention)
+    g = ((1.0 - codes) - score) * lr * code_mask
+    dv = jnp.einsum("bl,bld->bd", g, u)
+    du = g[..., None] * v[:, None, :]
+    flat_p = points.reshape(-1)
+    flat_pw = code_mask.reshape(-1)
+    syn0 = syn0.at[centers].add(
+        dv * _occurrence_scale(centers, syn0.shape[0], valid)[:, None])
+    syn1hs = syn1hs.at[flat_p].add(
+        du.reshape(-1, du.shape[-1])
+        * _occurrence_scale(flat_p, syn1hs.shape[0], flat_pw)[:, None])
+    return syn0, syn1hs
+
+
+@partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
+def _sg_hs_step(syn0, syn1hs, centers, points, codes, code_mask, lr, chunks=1):
+    """HS step with the same micro-chunk scan as _sg_neg_step — required for
+    DBOW labels, whose consecutive pairs would otherwise average into one
+    effective update per batch."""
+    if chunks <= 1:
+        return _sg_hs_chunk(syn0, syn1hs, centers, points, codes, code_mask, lr)
+
+    def body(tables, args):
+        s0, s1 = tables
+        c, p, cd, m = args
+        return _sg_hs_chunk(s0, s1, c, p, cd, m, lr), None
+
+    def split(a):
+        return a.reshape(chunks, a.shape[0] // chunks, *a.shape[1:])
+
+    (syn0, syn1hs), _ = jax.lax.scan(
+        body, (syn0, syn1hs),
+        (split(centers), split(points), split(codes), split(code_mask)))
+    return syn0, syn1hs
+
+
+class WordVectorsBase:
+    """Lookup API shared by every embedding model (reference
+    models/embeddings/wordvectors/WordVectors.java interface)."""
+
+    vocab: Optional[VocabCache]
+    syn0: Optional[np.ndarray]
+
+    def has_word(self, word) -> bool:
+        return self.vocab is not None and word in self.vocab
+
+    def word_vector(self, word) -> np.ndarray:
+        return self.syn0[self.vocab.index_of(word)]
+
+    def _normed(self) -> np.ndarray:
+        # restrict to element rows [0, V): label-trained models carry extra
+        # label rows in syn0 that must not leak into word-space searches
+        if getattr(self, "_norms", None) is None:
+            table = self.syn0[:len(self.vocab)]
+            n = np.linalg.norm(table, axis=1, keepdims=True)
+            self._norms = table / np.maximum(n, 1e-9)
+        return self._norms
+
+    def similarity(self, a, b) -> float:
+        na = self._normed()[self.vocab.index_of(a)]
+        nb = self._normed()[self.vocab.index_of(b)]
+        return float(na @ nb)
+
+    def words_nearest(self, word, top_n: int = 10) -> List:
+        normed = self._normed()
+        sims = normed @ normed[self.vocab.index_of(word)]
+        sims[self.vocab.index_of(word)] = -np.inf
+        idx = np.argpartition(-sims, min(top_n, len(sims) - 1))[:top_n]
+        idx = idx[np.argsort(-sims[idx])]
+        return [self.vocab.word_for(int(i)) for i in idx]
+
+    def words_nearest_vector(self, vec: np.ndarray, top_n: int = 10) -> List:
+        normed = self._normed()
+        v = np.asarray(vec, np.float32)
+        v = v / max(np.linalg.norm(v), 1e-9)
+        sims = normed @ v
+        idx = np.argpartition(-sims, min(top_n, len(sims) - 1))[:top_n]
+        idx = idx[np.argsort(-sims[idx])]
+        return [self.vocab.word_for(int(i)) for i in idx]
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _infer_sg_step(vec, syn1, targets, negatives, valid, lr):
+    """One inference pass for a single frozen-table vector (reference
+    ParagraphVectors.inferVector:391 — same update, tables locked).
+    vec [D], targets [B], negatives [B,K], valid [B]."""
+    t = jnp.concatenate([targets[:, None], negatives], axis=1)  # [B,1+K]
+    labels = jnp.zeros(t.shape, vec.dtype).at[:, 0].set(1.0)
+    u = syn1[t]                                                 # [B,1+K,D]
+    score = jax.nn.sigmoid(jnp.einsum("d,bkd->bk", vec, u))
+    g = (labels - score) * lr * valid[:, None]
+    return vec + jnp.einsum("bk,bkd->d", g, u) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _infer_dm_step(vec, syn0, syn1, ctx, ctx_mask, targets, negatives, valid, lr):
+    """DM inference: h = mean(frozen context vectors ++ vec); only ``vec``
+    moves.  ctx [B,W] indices into syn0, ctx_mask [B,W]."""
+    c = syn0[ctx] * ctx_mask[..., None]                     # [B,W,D]
+    denom = jnp.sum(ctx_mask, axis=1, keepdims=True) + 1.0  # + the doc vector
+    h = (jnp.sum(c, axis=1) + vec[None, :]) / denom         # [B,D]
+    t = jnp.concatenate([targets[:, None], negatives], axis=1)
+    labels = jnp.zeros(t.shape, vec.dtype).at[:, 0].set(1.0)
+    u = syn1[t]
+    score = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, u))
+    g = (labels - score) * lr * valid[:, None]
+    dh = jnp.einsum("bk,bkd->bd", g, u) / denom             # ∂h/∂vec = 1/denom
+    return vec + jnp.sum(dh, axis=0) / jnp.maximum(jnp.sum(valid), 1.0)
+
+
+class SequenceVectors(WordVectorsBase):
+    """Generic embedding trainer over element sequences (reference
+    SequenceVectors.Builder surface: layerSize, windowSize, negative,
+    useHierarchicSoftmax, learningRate, epochs, trainElementsRepresentation,
+    trainSequencesRepresentation)."""
+
+    def __init__(self,
+                 layer_size: int = 100,
+                 window: int = 5,
+                 min_word_frequency: int = 1,
+                 negative: int = 5,
+                 hierarchic_softmax: bool = False,
+                 cbow: bool = False,
+                 learning_rate: float = 0.025,
+                 min_learning_rate: float = 1e-4,
+                 subsampling: float = 0.0,
+                 epochs: int = 1,
+                 batch_size: int = 2048,
+                 seed: int = 12345,
+                 train_elements: bool = True,
+                 train_sequences: bool = False,
+                 dm: bool = True):
+        self.layer_size = layer_size
+        self.window = window
+        self.min_word_frequency = min_word_frequency
+        self.negative = negative
+        self.hs = hierarchic_softmax
+        self.cbow = cbow
+        self.lr = learning_rate
+        self.min_lr = min_learning_rate
+        self.subsampling = subsampling
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.seed = seed
+        if cbow and hierarchic_softmax:
+            raise NotImplementedError(
+                "CBOW + hierarchical softmax is not implemented — use CBOW "
+                "with negative sampling, or skip-gram with HS")
+        if train_sequences and dm and hierarchic_softmax:
+            raise NotImplementedError(
+                "DM + hierarchical softmax is not implemented — use DM with "
+                "negative sampling, or DBOW with HS")
+        self.train_elements = train_elements
+        self.train_sequences = train_sequences
+        self.dm = dm
+        self.vocab: Optional[VocabCache] = None
+        self.syn0: Optional[np.ndarray] = None
+        self.syn1: Optional[np.ndarray] = None
+        self.label_index: Dict[Hashable, int] = {}
+        self._norms = None
+
+    # ------------------------------------------------------------------
+
+    def fit_sequences(self,
+                      sequences: Sequence[Sequence[Hashable]],
+                      labels: Optional[Sequence[Hashable]] = None) -> "SequenceVectors":
+        """Train on pre-tokenized element sequences.  ``labels``, when given,
+        attaches one trainable label row per sequence (DM/DBOW per ``dm``)."""
+        if labels is not None and len(labels) != len(sequences):
+            raise ValueError(f"{len(labels)} labels for {len(sequences)} sequences")
+        if labels is None and self.train_sequences:
+            raise ValueError("train_sequences=True requires labels")
+        if labels is not None and not self.train_sequences:
+            raise ValueError("labels were given but train_sequences=False — "
+                             "label vectors would never be trained")
+
+        self.vocab = build_vocab(sequences, self.min_word_frequency)
+        if len(self.vocab) == 0:
+            raise ValueError("empty vocabulary — lower min_word_frequency?")
+        V, D = len(self.vocab), self.layer_size
+        self.label_index = {}
+        if labels is not None:
+            for lb in labels:
+                if lb not in self.label_index:
+                    self.label_index[lb] = V + len(self.label_index)
+        L = len(self.label_index)
+
+        rng = np.random.default_rng(self.seed)
+        # word2vec init: inputs ~ U(-0.5/D, 0.5/D), output tables zero
+        syn0 = jnp.asarray(((rng.random((V + L, D)) - 0.5) / D).astype(np.float32))
+        syn1 = jnp.zeros((V + L, D), jnp.float32)
+
+        idx_corpus: List[np.ndarray] = []
+        seq_label_idx: List[Optional[int]] = []
+        for si, s in enumerate(sequences):
+            ids = np.asarray([self.vocab.index_of(t) for t in s if t in self.vocab],
+                             np.int32)
+            if len(ids) < 1:
+                continue
+            idx_corpus.append(ids)
+            seq_label_idx.append(self.label_index[labels[si]] if labels is not None
+                                 else None)
+        if labels is not None:
+            trained = {l for l in seq_label_idx if l is not None}
+            untrained = [lb for lb, li in self.label_index.items()
+                         if li not in trained]
+            if untrained:
+                logger.warning(
+                    "%d label(s) have no in-vocabulary tokens and keep their "
+                    "random init (e.g. %s) — their vectors are meaningless",
+                    len(untrained), untrained[:3])
+
+        unigram = self.vocab.unigram_table()
+        counts = np.asarray([w.count for w in self.vocab.words], np.float64)
+        total = counts.sum()
+        keep_prob = np.ones(V)
+        if self.subsampling > 0:
+            f = counts / total
+            keep_prob = np.minimum(1.0, np.sqrt(self.subsampling / f)
+                                   + self.subsampling / f)
+
+        huffman = None
+        max_code = 0
+        if self.hs:
+            huffman = Huffman(self.vocab)
+            max_code = max(huffman.max_code_length(), 1)
+
+        total_words = sum(len(s) for s in idx_corpus) * self.epochs
+        words_done = 0
+
+        def current_lr():
+            frac = words_done / max(total_words, 1)
+            return max(self.min_lr, self.lr * (1.0 - frac))
+
+        # batched pair buffers (see word2vec.py flush() for the padding rules)
+        pairs_c: List[int] = []
+        pairs_t: List[int] = []
+        cbow_ctx: List[np.ndarray] = []
+        # DM window width: contexts + optionally the label slot
+        W_ctx = 2 * self.window + (1 if (labels is not None and self.dm) else 0)
+
+        def chunk_divisor(target_chunk: int) -> int:
+            """Largest divisor of batch_size giving chunks of ≥ target size."""
+            chunks = max(1, self.batch_size // target_chunk)
+            while self.batch_size % chunks:
+                chunks -= 1
+            return chunks
+
+        # DBOW emits a label's pairs CONSECUTIVELY — scan micro-chunks so
+        # they apply (near-)sequentially instead of being averaged away by
+        # _occurrence_scale (see _sg_neg_step docstring)
+        dbow = self.train_sequences and not self.dm
+
+        def flush():
+            nonlocal syn0, syn1, pairs_c, pairs_t, cbow_ctx
+            if not pairs_c:
+                return
+            n = len(pairs_c)
+            pad = self.batch_size - n
+            centers = np.asarray(pairs_c + [0] * pad, np.int32)
+            targets = np.asarray(pairs_t + [0] * pad, np.int32)
+            valid = np.zeros(self.batch_size, np.float32)
+            valid[:n] = 1.0
+            lr_j = jnp.asarray(current_lr(), jnp.float32)
+            if self.hs:
+                Lc = max_code
+                pts = np.zeros((self.batch_size, Lc), np.int32)
+                cds = np.zeros((self.batch_size, Lc), np.float32)
+                msk = np.zeros((self.batch_size, Lc), np.float32)
+                for i in range(n):
+                    w = self.vocab.words[targets[i]]
+                    l = min(len(w.points), Lc)
+                    pts[i, :l] = w.points[:l]
+                    cds[i, :l] = w.codes[:l]
+                    msk[i, :l] = 1.0
+                syn0, syn1 = _sg_hs_step(syn0, syn1, jnp.asarray(centers),
+                                         jnp.asarray(pts), jnp.asarray(cds),
+                                         jnp.asarray(msk), lr_j,
+                                         chunk_divisor(16) if dbow else 1)
+            elif cbow_ctx:
+                ctx = np.zeros((self.batch_size, W_ctx), np.int32)
+                msk = np.zeros((self.batch_size, W_ctx), np.float32)
+                for i, c in enumerate(cbow_ctx):
+                    l = min(len(c), W_ctx)
+                    ctx[i, :l] = c[:l]
+                    msk[i, :l] = 1.0
+                negs = rng.choice(len(unigram), size=(self.batch_size, self.negative),
+                                  p=unigram).astype(np.int32)
+                syn0, syn1 = _cbow_neg_step(syn0, syn1, jnp.asarray(ctx),
+                                            jnp.asarray(msk),
+                                            jnp.asarray(targets), jnp.asarray(negs),
+                                            lr_j, chunk_divisor(32))
+            else:
+                negs = rng.choice(len(unigram), size=(self.batch_size, self.negative),
+                                  p=unigram).astype(np.int32)
+                syn0, syn1 = _sg_neg_step(syn0, syn1, jnp.asarray(centers),
+                                          jnp.asarray(targets), jnp.asarray(negs),
+                                          jnp.asarray(valid), lr_j,
+                                          chunk_divisor(16) if dbow else 1)
+            pairs_c, pairs_t, cbow_ctx = [], [], []
+
+        use_cbow_path = self.cbow or (labels is not None and self.dm
+                                      and self.train_sequences)
+
+        for _ in range(self.epochs):
+            for sent, lbl in zip(idx_corpus, seq_label_idx):
+                if self.subsampling > 0:
+                    keep = rng.random(len(sent)) < keep_prob[sent]
+                    sent = sent[keep]
+                words_done += len(sent)
+                for pos, center in enumerate(sent):
+                    b = rng.integers(1, self.window + 1)  # dynamic window
+                    lo, hi = max(0, pos - b), min(len(sent), pos + b + 1)
+                    context = [int(sent[j]) for j in range(lo, hi) if j != pos]
+                    if use_cbow_path:
+                        ctx = list(context)
+                        if lbl is not None and self.train_sequences and self.dm:
+                            ctx.append(lbl)  # DM: label joins the window
+                        if not ctx:
+                            continue
+                        pairs_c.append(int(center))
+                        pairs_t.append(int(center))
+                        cbow_ctx.append(np.asarray(ctx, np.int32))
+                        if len(pairs_c) >= self.batch_size:
+                            flush()
+                    else:
+                        if self.train_elements:
+                            for t in context:
+                                pairs_c.append(int(center))
+                                pairs_t.append(t)
+                                if len(pairs_c) >= self.batch_size:
+                                    flush()
+                        if lbl is not None and self.train_sequences and not self.dm:
+                            # DBOW: the label predicts each word of the window
+                            pairs_c.append(lbl)
+                            pairs_t.append(int(center))
+                            if len(pairs_c) >= self.batch_size:
+                                flush()
+        flush()
+        self.syn0 = np.asarray(syn0)
+        self.syn1 = np.asarray(syn1)
+        self._norms = None
+        return self
+
+    # ------------------------------------------------------------------
+    # label (sequence) vectors
+    # ------------------------------------------------------------------
+
+    def sequence_vector(self, label: Hashable) -> np.ndarray:
+        """Trained vector of a sequence label (doc vector)."""
+        return self.syn0[self.label_index[label]]
+
+    def infer_vector(self, tokens: Sequence[Hashable], steps: int = 200,
+                     learning_rate: Optional[float] = None,
+                     seed: int = 0) -> np.ndarray:
+        """Train a fresh vector for an unseen sequence with all tables
+        frozen (reference ParagraphVectors.inferVector:391)."""
+        if self.syn0 is None:
+            raise ValueError("fit before infer")
+        if self.hs:
+            raise NotImplementedError(
+                "infer_vector for hierarchical-softmax models is not "
+                "implemented (syn1 holds Huffman inner-node vectors, not word "
+                "outputs) — train with negative sampling to use inference")
+        ids = np.asarray([self.vocab.index_of(t) for t in tokens
+                          if t in self.vocab], np.int32)
+        if len(ids) == 0:
+            raise ValueError("no known tokens in sequence")
+        rng = np.random.default_rng(seed)
+        D = self.layer_size
+        lr = np.float32(learning_rate if learning_rate is not None else self.lr)
+        vec = jnp.asarray(((rng.random(D) - 0.5) / D).astype(np.float32))
+        syn0 = jnp.asarray(self.syn0)
+        syn1 = jnp.asarray(self.syn1)
+        unigram = self.vocab.unigram_table()
+        # pad to a power-of-two bucket: one XLA compile per bucket, not per
+        # distinct document length
+        B = 1 << max(4, int(np.ceil(np.log2(len(ids)))))
+        pad = B - len(ids)
+        targets = jnp.asarray(np.concatenate([ids, np.zeros(pad, np.int32)]))
+        valid = jnp.asarray(np.concatenate([np.ones(len(ids), np.float32),
+                                            np.zeros(pad, np.float32)]))
+        if self.dm:
+            W = 2 * self.window
+            ctx = np.zeros((B, W), np.int32)
+            msk = np.zeros((B, W), np.float32)
+            for pos in range(len(ids)):
+                lo, hi = max(0, pos - self.window), min(len(ids), pos + self.window + 1)
+                c = [int(ids[j]) for j in range(lo, hi) if j != pos]
+                l = min(len(c), W)
+                ctx[pos, :l] = c[:l]
+                msk[pos, :l] = 1.0
+            ctx_j, msk_j = jnp.asarray(ctx), jnp.asarray(msk)
+        for it in range(steps):
+            cur = jnp.asarray(max(float(lr) * (1.0 - it / steps), self.min_lr),
+                              jnp.float32)
+            negs = jnp.asarray(rng.choice(len(unigram), size=(B, self.negative),
+                                          p=unigram).astype(np.int32))
+            if self.dm:
+                vec = _infer_dm_step(vec, syn0, syn1, ctx_j, msk_j, targets,
+                                     negs, valid, cur)
+            else:
+                vec = _infer_sg_step(vec, syn1, targets, negs, valid, cur)
+        return np.asarray(vec)
+
+
+class ParagraphVectors(SequenceVectors):
+    """Doc2vec (reference models/paragraphvectors/ParagraphVectors.java):
+    PV-DM (``dm=True``, default — DL4J's default DM learner) or PV-DBOW
+    (``dm=False``).  Labels are document ids; ``infer_vector`` embeds unseen
+    documents against the frozen tables."""
+
+    def __init__(self, dm: bool = True, train_elements: bool = True,
+                 **kwargs):
+        # word vectors co-train by default (reference trainElementsVectors
+        # defaults true); pure doc→word DBOW collapses doc vectors to a
+        # near-rank-1 subspace because syn1 gets no word-word structure
+        kwargs.setdefault("min_word_frequency", 1)
+        super().__init__(train_elements=train_elements, train_sequences=True,
+                         dm=dm, **kwargs)
+        self.tokenizer = None
+
+    def fit(self, documents: Iterable, labels: Optional[Sequence[Hashable]] = None
+            ) -> "ParagraphVectors":
+        """Train on documents: strings (tokenized on whitespace via the
+        default tokenizer) or pre-tokenized lists."""
+        from .tokenization import DefaultTokenizerFactory
+        docs = list(documents)
+        if docs and isinstance(docs[0], str):
+            tk = DefaultTokenizerFactory()
+            seqs = [tk.tokenize(d) for d in docs]
+        else:
+            seqs = [list(d) for d in docs]
+        if labels is None:
+            labels = [f"DOC_{i}" for i in range(len(seqs))]
+        return self.fit_sequences(seqs, labels=labels)
+
+    # doc-flavored aliases (reference API names)
+    def doc_vector(self, label: Hashable) -> np.ndarray:
+        return self.sequence_vector(label)
+
+    def infer(self, text) -> np.ndarray:
+        if isinstance(text, str):
+            from .tokenization import DefaultTokenizerFactory
+            text = DefaultTokenizerFactory().tokenize(text)
+        return self.infer_vector(text)
+
+    def nearest_labels(self, vec_or_text, top_n: int = 5) -> List:
+        """Labels whose doc vectors are closest to a vector / inferred text
+        (reference predictSeveral / nearestLabels)."""
+        if isinstance(vec_or_text, (str, list)):
+            v = self.infer(vec_or_text)
+        else:
+            v = np.asarray(vec_or_text, np.float32)
+        v = v / max(np.linalg.norm(v), 1e-9)
+        out = []
+        for lb, idx in self.label_index.items():
+            dv = self.syn0[idx]
+            dv = dv / max(np.linalg.norm(dv), 1e-9)
+            out.append((float(dv @ v), lb))
+        out.sort(reverse=True)
+        return [lb for _, lb in out[:top_n]]

@@ -9,152 +9,30 @@ CBOW.java math.  The reference's Hogwild threads + JNI batched aggregates
 sampling (numpy), device-side jit step applying the classic sparse updates
 via scatter-add — update cost ∝ batch, not vocab.
 
-Gradient math is the standard word2vec closed form (manual, not autodiff —
-autodiff's dense [V,D] cotangents would waste HBM bandwidth on big vocabs).
+Layering matches the reference: ``Word2Vec extends SequenceVectors`` — the
+training engine and the jit-compiled update steps live in
+nlp/sequencevectors.py; this class adds tokenization and word2vec's
+defaults (min frequency 5, subsampling 1e-3).
 """
 
 from __future__ import annotations
 
-import logging
-from functools import partial
-from typing import Iterable, List, Optional, Sequence
+from typing import Iterable, List
 
-import jax
-import jax.numpy as jnp
-import numpy as np
+from .sequencevectors import SequenceVectors
 
-from .tokenization import DefaultTokenizerFactory
-from .vocab import Huffman, VocabCache, build_vocab
-
-logger = logging.getLogger("deeplearning4j_tpu")
-
-
-def _occurrence_scale(indices: jnp.ndarray, vocab_size: int,
-                      weights: jnp.ndarray) -> jnp.ndarray:
-    """weights/count(row) per entry: rows hit k times in one batch receive
-    the AVERAGE of their k updates, not the sum.  A batch applies updates
-    against stale table values, so summing k near-identical updates
-    multiplies the effective lr by k and diverges on small vocabs; averaging
-    recovers sequential-SGD magnitude (the Hogwild path's implicit behavior).
-
-    `weights` is 1.0 for genuine entries and 0.0 for padding, so pad slots
-    (which alias index 0 — the most frequent word) neither receive updates
-    nor dilute the occurrence counts of real entries."""
-    counts = jnp.zeros((vocab_size,), jnp.float32).at[indices].add(weights)
-    return weights / jnp.maximum(counts[indices], 1.0)
+# re-exported for backward compatibility (tests/benchmarks import from here)
+from .sequencevectors import (  # noqa: F401
+    _cbow_chunk,
+    _cbow_neg_step,
+    _occurrence_scale,
+    _sg_chunk,
+    _sg_hs_step,
+    _sg_neg_step,
+)
 
 
-@partial(jax.jit, donate_argnums=(0, 1))
-def _sg_neg_step(syn0, syn1, centers, contexts, negatives, valid, lr):
-    """Skip-gram negative-sampling sparse update.
-
-    centers [B], contexts [B], negatives [B,K], valid [B] (0 = pad row).
-    Classic updates (Mikolov 2013):
-        for target t with label l:  g = (l - σ(v·u_t)) * lr
-        v      += Σ g * u_t ;  u_t += g * v
-    """
-    v = syn0[centers]                         # [B,D]
-    targets = jnp.concatenate([contexts[:, None], negatives], axis=1)  # [B,1+K]
-    labels = jnp.zeros(targets.shape, syn0.dtype).at[:, 0].set(1.0)
-    u = syn1[targets]                         # [B,1+K,D]
-    score = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", v, u))
-    g = (labels - score) * lr * valid[:, None]  # [B,1+K]
-    dv = jnp.einsum("bk,bkd->bd", g, u)
-    du = g[..., None] * v[:, None, :]         # [B,1+K,D]
-    flat_t = targets.reshape(-1)
-    flat_tw = jnp.broadcast_to(valid[:, None], targets.shape).reshape(-1)
-    syn0 = syn0.at[centers].add(
-        dv * _occurrence_scale(centers, syn0.shape[0], valid)[:, None])
-    syn1 = syn1.at[flat_t].add(
-        du.reshape(-1, du.shape[-1])
-        * _occurrence_scale(flat_t, syn1.shape[0], flat_tw)[:, None])
-    return syn0, syn1
-
-
-def _cbow_chunk(syn0, syn1, context_windows, window_mask, targets_pos,
-                negatives, lr):
-    """One CBOW negative-sampling micro-chunk: input = mean of context
-    vectors; the full output-side gradient is added to EVERY context word,
-    matching reference CBOW.java:104-209 (neu1e accumulated once, applied
-    undivided per word).  Pad rows have an all-zero window_mask and
-    contribute nothing."""
-    ctx = syn0[context_windows]               # [B,W,D]
-    m = window_mask[..., None]
-    valid = (jnp.sum(window_mask, axis=1) > 0).astype(syn0.dtype)  # [B]
-    denom = jnp.maximum(jnp.sum(window_mask, axis=1, keepdims=True), 1.0)
-    h = jnp.sum(ctx * m, axis=1) / denom      # [B,D]
-    targets = jnp.concatenate([targets_pos[:, None], negatives], axis=1)
-    labels = jnp.zeros(targets.shape, syn0.dtype).at[:, 0].set(1.0)
-    u = syn1[targets]
-    score = jax.nn.sigmoid(jnp.einsum("bd,bkd->bk", h, u))
-    g = (labels - score) * lr * valid[:, None]
-    dh = jnp.einsum("bk,bkd->bd", g, u)       # full neu1e per context word
-    du = g[..., None] * h[:, None, :]
-    flat_t = targets.reshape(-1)
-    flat_tw = jnp.broadcast_to(valid[:, None], targets.shape).reshape(-1)
-    syn1 = syn1.at[flat_t].add(
-        du.reshape(-1, du.shape[-1])
-        * _occurrence_scale(flat_t, syn1.shape[0], flat_tw)[:, None])
-    dctx = jnp.broadcast_to(dh[:, None, :], ctx.shape) * m
-    flat_c = context_windows.reshape(-1)
-    flat_cw = window_mask.reshape(-1)
-    syn0 = syn0.at[flat_c].add(
-        dctx.reshape(-1, dctx.shape[-1])
-        * _occurrence_scale(flat_c, syn0.shape[0], flat_cw)[:, None])
-    return syn0, syn1
-
-
-@partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
-def _cbow_neg_step(syn0, syn1, context_windows, window_mask, targets_pos,
-                   negatives, lr, chunks=1):
-    """CBOW step: lax.scan over `chunks` micro-chunks, each re-reading the
-    freshly updated tables.  CBOW emits one row per center word (~2·window
-    fewer rows than skip-gram), so whole-batch averaging starves it of
-    effective sequential steps on small vocabs; chunked application restores
-    the reference's sequential-SGD semantics while keeping batched matmuls."""
-    if chunks <= 1:
-        return _cbow_chunk(syn0, syn1, context_windows, window_mask,
-                           targets_pos, negatives, lr)
-
-    def body(tables, args):
-        s0, s1 = tables
-        c, m, t, n = args
-        return _cbow_chunk(s0, s1, c, m, t, n, lr), None
-
-    def split(a):
-        return a.reshape(chunks, a.shape[0] // chunks, *a.shape[1:])
-
-    (syn0, syn1), _ = jax.lax.scan(
-        body, (syn0, syn1),
-        (split(context_windows), split(window_mask), split(targets_pos),
-         split(negatives)))
-    return syn0, syn1
-
-
-@partial(jax.jit, donate_argnums=(0, 1))
-def _sg_hs_step(syn0, syn1hs, centers, points, codes, code_mask, lr):
-    """Skip-gram hierarchical softmax: walk the Huffman path
-    (reference SkipGram iterateSample hierarchic-softmax branch).
-    points/codes [B,L] padded, code_mask [B,L] (all-zero row = pad)."""
-    v = syn0[centers]                          # [B,D]
-    u = syn1hs[points]                         # [B,L,D]
-    valid = (jnp.sum(code_mask, axis=1) > 0).astype(syn0.dtype)  # [B]
-    score = jax.nn.sigmoid(jnp.einsum("bd,bld->bl", v, u))
-    # label = 1 - code (word2vec convention)
-    g = ((1.0 - codes) - score) * lr * code_mask
-    dv = jnp.einsum("bl,bld->bd", g, u)
-    du = g[..., None] * v[:, None, :]
-    flat_p = points.reshape(-1)
-    flat_pw = code_mask.reshape(-1)
-    syn0 = syn0.at[centers].add(
-        dv * _occurrence_scale(centers, syn0.shape[0], valid)[:, None])
-    syn1hs = syn1hs.at[flat_p].add(
-        du.reshape(-1, du.shape[-1])
-        * _occurrence_scale(flat_p, syn1hs.shape[0], flat_pw)[:, None])
-    return syn0, syn1hs
-
-
-class Word2Vec:
+class Word2Vec(SequenceVectors):
     """Builder-style Word2Vec (reference Word2Vec.Builder surface)."""
 
     def __init__(self,
@@ -171,175 +49,25 @@ class Word2Vec:
                  batch_size: int = 2048,
                  seed: int = 12345,
                  tokenizer_factory=None):
-        self.layer_size = layer_size
-        self.window = window
-        self.min_word_frequency = min_word_frequency
-        self.negative = negative
-        self.hs = hierarchic_softmax
-        self.cbow = cbow
-        self.lr = learning_rate
-        self.min_lr = min_learning_rate
-        self.subsampling = subsampling
-        self.epochs = epochs
-        self.batch_size = batch_size
-        self.seed = seed
-        self.tokenizer = tokenizer_factory or DefaultTokenizerFactory()
-        self.vocab: Optional[VocabCache] = None
-        self.syn0: Optional[np.ndarray] = None
+        from .tokenization import DefaultTokenizerFactory
 
-    # ------------------------------------------------------------------
-    # training
-    # ------------------------------------------------------------------
+        super().__init__(
+            layer_size=layer_size,
+            window=window,
+            min_word_frequency=min_word_frequency,
+            negative=negative,
+            hierarchic_softmax=hierarchic_softmax,
+            cbow=cbow,
+            learning_rate=learning_rate,
+            min_learning_rate=min_learning_rate,
+            subsampling=subsampling,
+            epochs=epochs,
+            batch_size=batch_size,
+            seed=seed)
+        self.tokenizer = tokenizer_factory or DefaultTokenizerFactory()
 
     def _tokenize_corpus(self, sentences: Iterable[str]) -> List[List[str]]:
         return [self.tokenizer.tokenize(s) for s in sentences]
 
     def fit(self, sentences: Iterable[str]) -> "Word2Vec":
-        corpus = self._tokenize_corpus(sentences)
-        self.vocab = build_vocab(corpus, self.min_word_frequency)
-        if len(self.vocab) == 0:
-            raise ValueError("empty vocabulary — lower min_word_frequency?")
-        rng = np.random.default_rng(self.seed)
-        V, D = len(self.vocab), self.layer_size
-        # word2vec init: syn0 ~ U(-0.5/D, 0.5/D), output tables zero
-        syn0 = jnp.asarray(((rng.random((V, D)) - 0.5) / D).astype(np.float32))
-        syn1 = jnp.zeros((V, D), jnp.float32)
-
-        idx_corpus = [np.asarray([self.vocab.index_of(t) for t in s if t in self.vocab],
-                                 np.int32)
-                      for s in corpus]
-        idx_corpus = [s for s in idx_corpus if len(s) > 1]
-        unigram = self.vocab.unigram_table()
-        counts = np.asarray([w.count for w in self.vocab.words], np.float64)
-        total = counts.sum()
-        keep_prob = np.ones(V)
-        if self.subsampling > 0:
-            f = counts / total
-            keep_prob = np.minimum(1.0, np.sqrt(self.subsampling / f)
-                                   + self.subsampling / f)
-
-        huffman = None
-        max_code = 0
-        if self.hs:
-            huffman = Huffman(self.vocab)
-            max_code = max(huffman.max_code_length(), 1)
-
-        total_words = sum(len(s) for s in idx_corpus) * self.epochs
-        words_done = 0
-
-        def current_lr():
-            frac = words_done / max(total_words, 1)
-            return max(self.min_lr, self.lr * (1.0 - frac))
-
-        pairs_c: List[int] = []
-        pairs_t: List[int] = []
-        cbow_ctx: List[np.ndarray] = []
-
-        def flush():
-            nonlocal syn0, syn1, pairs_c, pairs_t, cbow_ctx
-            if not pairs_c:
-                return
-            n = len(pairs_c)
-            # pad to the fixed batch shape so XLA compiles once; pad rows are
-            # masked out via `valid` (they never alias word 0's updates)
-            pad = self.batch_size - n
-            centers = np.asarray(pairs_c + [0] * pad, np.int32)
-            targets = np.asarray(pairs_t + [0] * pad, np.int32)
-            valid = np.zeros(self.batch_size, np.float32)
-            valid[:n] = 1.0
-            lr_j = jnp.asarray(current_lr(), jnp.float32)
-            if self.hs:
-                L = max_code
-                pts = np.zeros((self.batch_size, L), np.int32)
-                cds = np.zeros((self.batch_size, L), np.float32)
-                msk = np.zeros((self.batch_size, L), np.float32)  # 0 rows for pad
-                for i in range(n):
-                    w = self.vocab.words[targets[i]]
-                    l = min(len(w.points), L)
-                    pts[i, :l] = w.points[:l]
-                    cds[i, :l] = w.codes[:l]
-                    msk[i, :l] = 1.0
-                syn0, syn1 = _sg_hs_step(syn0, syn1, jnp.asarray(centers),
-                                         jnp.asarray(pts), jnp.asarray(cds),
-                                         jnp.asarray(msk), lr_j)
-            elif self.cbow:
-                W = 2 * self.window
-                ctx = np.zeros((self.batch_size, W), np.int32)
-                msk = np.zeros((self.batch_size, W), np.float32)  # 0 rows for pad
-                for i, c in enumerate(cbow_ctx):
-                    l = min(len(c), W)
-                    ctx[i, :l] = c[:l]
-                    msk[i, :l] = 1.0
-                negs = rng.choice(len(unigram), size=(self.batch_size, self.negative),
-                                  p=unigram).astype(np.int32)
-                chunks = max(1, self.batch_size // 32)
-                while self.batch_size % chunks:   # nearest divisor ≤ B/32
-                    chunks -= 1
-                syn0, syn1 = _cbow_neg_step(syn0, syn1, jnp.asarray(ctx),
-                                            jnp.asarray(msk),
-                                            jnp.asarray(targets), jnp.asarray(negs),
-                                            lr_j, chunks)
-            else:
-                negs = rng.choice(len(unigram), size=(self.batch_size, self.negative),
-                                  p=unigram).astype(np.int32)
-                syn0, syn1 = _sg_neg_step(syn0, syn1, jnp.asarray(centers),
-                                          jnp.asarray(targets), jnp.asarray(negs),
-                                          jnp.asarray(valid), lr_j)
-            pairs_c, pairs_t, cbow_ctx = [], [], []
-
-        for _ in range(self.epochs):
-            for sent in idx_corpus:
-                if self.subsampling > 0:
-                    keep = rng.random(len(sent)) < keep_prob[sent]
-                    sent = sent[keep]
-                words_done += len(sent)
-                for pos, center in enumerate(sent):
-                    b = rng.integers(1, self.window + 1)  # dynamic window
-                    lo, hi = max(0, pos - b), min(len(sent), pos + b + 1)
-                    context = [int(sent[j]) for j in range(lo, hi) if j != pos]
-                    if not context:
-                        continue
-                    if self.cbow:
-                        pairs_c.append(int(center))
-                        pairs_t.append(int(center))
-                        cbow_ctx.append(np.asarray(context, np.int32))
-                        if len(pairs_c) >= self.batch_size:
-                            flush()
-                    else:
-                        for t in context:
-                            pairs_c.append(int(center))
-                            pairs_t.append(t)
-                            if len(pairs_c) >= self.batch_size:
-                                flush()
-        flush()
-        self.syn0 = np.asarray(syn0)
-        self._norms = None
-        return self
-
-    # ------------------------------------------------------------------
-    # lookup API (reference WordVectors interface)
-    # ------------------------------------------------------------------
-
-    def has_word(self, word: str) -> bool:
-        return self.vocab is not None and word in self.vocab
-
-    def word_vector(self, word: str) -> np.ndarray:
-        return self.syn0[self.vocab.index_of(word)]
-
-    def _normed(self) -> np.ndarray:
-        if self._norms is None:
-            n = np.linalg.norm(self.syn0, axis=1, keepdims=True)
-            self._norms = self.syn0 / np.maximum(n, 1e-9)
-        return self._norms
-
-    def similarity(self, a: str, b: str) -> float:
-        va, vb = self._normed()[self.vocab.index_of(a)], self._normed()[self.vocab.index_of(b)]
-        return float(va @ vb)
-
-    def words_nearest(self, word: str, top_n: int = 10) -> List[str]:
-        normed = self._normed()
-        sims = normed @ normed[self.vocab.index_of(word)]
-        sims[self.vocab.index_of(word)] = -np.inf
-        idx = np.argpartition(-sims, min(top_n, len(sims) - 1))[:top_n]
-        idx = idx[np.argsort(-sims[idx])]
-        return [self.vocab.word_for(int(i)) for i in idx]
+        return self.fit_sequences(self._tokenize_corpus(sentences))

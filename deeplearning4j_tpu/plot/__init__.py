@@ -1,0 +1,6 @@
+"""Dimensionality reduction for visualization (replaces
+deeplearning4j-core plot/: BarnesHutTsne + Tsne)."""
+
+from .tsne import Tsne
+
+__all__ = ["Tsne"]

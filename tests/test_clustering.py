@@ -1,0 +1,177 @@
+"""KMeans / KNN / t-SNE / DeepWalk — semantic correctness checks:
+kmeans recovers planted blobs, knn matches a numpy oracle exactly, tsne
+separates iris species visibly, DeepWalk embeds a two-community graph with
+higher within-community similarity.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    KMeansClustering, NearestNeighbors, pairwise_distances,
+)
+from deeplearning4j_tpu.graph import DeepWalk, Graph, RandomWalkIterator
+from deeplearning4j_tpu.plot import Tsne
+
+
+def blobs(n_per=100, k=3, d=8, spread=0.5, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(0, 10, (k, d))
+    x = np.concatenate([rng.normal(c, spread, (n_per, d)) for c in centers])
+    y = np.repeat(np.arange(k), n_per)
+    perm = rng.permutation(len(x))
+    return x[perm].astype(np.float32), y[perm], centers
+
+
+class TestKMeans:
+    def test_recovers_blobs(self):
+        x, y, _ = blobs()
+        km = KMeansClustering.setup(3, max_iterations=100)
+        assign = km.apply_to(x)
+        # every predicted cluster maps to exactly one true blob
+        for c in range(3):
+            true = y[assign == c]
+            assert len(true) > 0
+            top = np.bincount(true).max()
+            assert top / len(true) > 0.99, f"cluster {c} impure"
+        assert km.inertia_ is not None and km.n_iter_ < 100
+
+    def test_predict_matches_training_assignment(self):
+        x, _, _ = blobs(50)
+        km = KMeansClustering(3, seed=7)
+        assign = km.apply_to(x)
+        np.testing.assert_array_equal(km.predict(x), assign)
+
+    def test_kpp_finds_near_ideal_solution(self):
+        # ideal inertia for k matching the planted blobs ≈ N·d·σ²; a merged
+        # pair of blobs costs an order of magnitude more.  kmeans++ seeding
+        # is stochastic, so take the best of 3 restarts (standard practice).
+        x, _, _ = blobs(60, k=5, spread=1.0, seed=3)
+        ideal = x.shape[0] * x.shape[1] * 1.0
+        best = np.inf
+        for seed in (1, 2, 3):
+            km = KMeansClustering(5, init="kmeans++", seed=seed)
+            km.apply_to(x)
+            best = min(best, km.inertia_)
+        assert best < 1.5 * ideal, f"best inertia {best:.0f} vs ideal {ideal:.0f}"
+
+    def test_validates_input(self):
+        with pytest.raises(ValueError, match="k"):
+            KMeansClustering(0)
+        with pytest.raises(ValueError, match="points"):
+            KMeansClustering(5).apply_to(np.zeros((3, 2)))
+
+
+class TestKNN:
+    def test_matches_numpy_oracle(self):
+        rng = np.random.default_rng(1)
+        pts = rng.normal(size=(200, 16)).astype(np.float32)
+        q = rng.normal(size=(17, 16)).astype(np.float32)
+        nn = NearestNeighbors(pts)
+        d, i = nn.knn(q, k=5)
+        # oracle
+        od = np.linalg.norm(q[:, None, :] - pts[None, :, :], axis=-1)
+        oi = np.argsort(od, axis=1)[:, :5]
+        np.testing.assert_array_equal(i, oi)
+        np.testing.assert_allclose(d, np.take_along_axis(od, oi, 1),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_single_query_and_k_clamp(self):
+        pts = np.eye(4, dtype=np.float32)
+        nn = NearestNeighbors(pts)
+        d, i = nn.knn(pts[2], k=10)  # k clamps to N
+        assert i.shape == (4,) and i[0] == 2 and d[0] < 1e-6
+
+    def test_query_tiling_consistent(self):
+        rng = np.random.default_rng(2)
+        pts = rng.normal(size=(64, 8)).astype(np.float32)
+        q = rng.normal(size=(40, 8)).astype(np.float32)
+        a = NearestNeighbors(pts, query_block=7).knn(q, 3)
+        b = NearestNeighbors(pts, query_block=4096).knn(q, 3)
+        np.testing.assert_array_equal(a[1], b[1])
+
+    def test_cosine_metric(self):
+        pts = np.asarray([[1, 0], [0, 1], [2, 0]], np.float32)
+        nn = NearestNeighbors(pts, metric="cosine")
+        d, i = nn.knn(np.asarray([3.0, 0.1], np.float32), k=3)
+        assert set(i[:2].tolist()) == {0, 2}  # same-direction vectors first
+
+    def test_pairwise_distances(self):
+        a = np.asarray([[0, 0], [3, 4]], np.float32)
+        d = pairwise_distances(a)
+        np.testing.assert_allclose(d, [[0, 5], [5, 0]], atol=1e-5)
+
+
+class TestTsne:
+    def test_separates_iris(self):
+        from deeplearning4j_tpu.datasets.fetchers import load_iris
+        xs, ys = load_iris()
+        x = np.asarray(xs, np.float64)
+        y = np.argmax(np.asarray(ys), axis=1) if np.asarray(ys).ndim == 2 \
+            else np.asarray(ys)
+        emb = Tsne(perplexity=20.0, max_iter=300, seed=3).fit_transform(x)
+        assert emb.shape == (len(x), 2)
+        # setosa (class 0) is linearly separable from the rest in 4-D; its
+        # embedded cluster must keep clear margin: nearest inter-class
+        # distance exceeds the mean intra-setosa distance
+        setosa = emb[y == 0]
+        rest = emb[y != 0]
+        intra = np.linalg.norm(setosa - setosa.mean(0), axis=1).mean()
+        inter = np.min(np.linalg.norm(setosa[:, None, :] - rest[None, :, :],
+                                      axis=-1))
+        assert inter > intra, f"inter={inter:.2f} intra={intra:.2f}"
+
+    def test_kl_drops_and_finite(self):
+        rng = np.random.default_rng(0)
+        x = np.concatenate([rng.normal(0, 1, (30, 5)),
+                            rng.normal(8, 1, (30, 5))])
+        t = Tsne(perplexity=10.0, max_iter=250, seed=0)
+        emb = t.fit_transform(x)
+        assert np.isfinite(emb).all()
+        assert t.kl_divergence_ is not None and t.kl_divergence_ < 1.0
+
+    def test_perplexity_validation(self):
+        with pytest.raises(ValueError, match="perplexity"):
+            Tsne(perplexity=30.0).fit_transform(np.zeros((10, 3)))
+
+
+def two_community_graph(n_per=16, p_in=0.6, p_out=0.02, seed=0):
+    rng = np.random.default_rng(seed)
+    n = 2 * n_per
+    g = Graph(n, undirected=True)
+    for a in range(n):
+        for b in range(a + 1, n):
+            same = (a < n_per) == (b < n_per)
+            if rng.random() < (p_in if same else p_out):
+                g.add_edge(a, b)
+    # ensure no isolated vertices
+    for v in range(n):
+        if g.degree(v) == 0:
+            g.add_edge(v, (v + 1) % n_per + (0 if v < n_per else n_per))
+    return g
+
+
+class TestDeepWalk:
+    def test_random_walks_respect_edges(self):
+        g = Graph(4)
+        g.add_edges([(0, 1), (1, 2), (2, 3)])
+        walks = list(RandomWalkIterator(g, walk_length=5, seed=0))
+        assert len(walks) == 4
+        for w in walks:
+            assert len(w) == 5
+            for a, b in zip(w, w[1:]):
+                assert b in g.neighbors(a) or a == b
+
+    @pytest.mark.parametrize("hs", [True, False], ids=["hs", "neg"])
+    def test_communities_embed_together(self, hs):
+        g = two_community_graph()
+        dw = DeepWalk(vector_size=16, window_size=4, walk_length=20,
+                      walks_per_vertex=8, epochs=15, hierarchic_softmax=hs,
+                      batch_size=128, seed=2, learning_rate=0.05)
+        dw.fit(g)
+        n_per = 16
+        within = np.mean([dw.similarity(a, b)
+                          for a in range(0, 8) for b in range(8, n_per)])
+        across = np.mean([dw.similarity(a, b)
+                          for a in range(0, 8) for b in range(n_per, n_per + 8)])
+        assert within > across + 0.2, f"within={within:.3f} across={across:.3f}"

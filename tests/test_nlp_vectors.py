@@ -1,0 +1,155 @@
+"""SequenceVectors / ParagraphVectors / GloVe learning tests.
+
+Same two-topic synthetic corpus strategy as test_nlp.py: semantic checks
+(within-topic similarity beats across-topic; doc inference lands near the
+right topic's documents), not just smoke tests.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    Glove, ParagraphVectors, SequenceVectors,
+)
+
+ANIMALS = ["cat", "dog", "pet", "fur", "paw", "tail", "meow", "bark"]
+TECH = ["cpu", "ram", "disk", "code", "byte", "chip", "core", "cache"]
+
+
+def topic_docs(n_docs=120, words_per_doc=20, seed=0):
+    rng = np.random.default_rng(seed)
+    docs, labels, topics = [], [], []
+    for i in range(n_docs):
+        t = int(rng.integers(0, 2))
+        vocab = ANIMALS if t == 0 else TECH
+        docs.append(" ".join(rng.choice(vocab, size=words_per_doc)))
+        labels.append(f"DOC_{i}")
+        topics.append(t)
+    return docs, labels, topics
+
+
+class TestSequenceVectors:
+    def test_generic_elements(self):
+        """SequenceVectors learns embeddings for arbitrary hashable
+        elements — here integer ids, the DeepWalk use case."""
+        rng = np.random.default_rng(3)
+        # elements 0-7 and 10-17 co-occur within their own group only
+        seqs = []
+        for _ in range(400):
+            base = 0 if rng.integers(0, 2) == 0 else 10
+            seqs.append([int(base + x) for x in rng.integers(0, 8, size=8)])
+        sv = SequenceVectors(layer_size=32, window=3, min_word_frequency=2,
+                             epochs=12, batch_size=128, seed=1,
+                             learning_rate=0.05)
+        sv.fit_sequences(seqs)
+        within = sv.similarity(0, 1)
+        across = sv.similarity(0, 10)
+        assert within > across + 0.2, f"within={within:.3f} across={across:.3f}"
+
+
+class TestParagraphVectors:
+    @pytest.mark.parametrize("dm", [True, False], ids=["dm", "dbow"])
+    def test_doc_vectors_cluster_by_topic(self, dm):
+        docs, labels, topics = topic_docs()
+        pv = ParagraphVectors(dm=dm, layer_size=24, window=3, epochs=20,
+                              batch_size=128, seed=1, learning_rate=0.05)
+        pv.fit(docs, labels)
+        vecs = np.stack([pv.doc_vector(lb) for lb in labels])
+        vecs = vecs / np.maximum(np.linalg.norm(vecs, axis=1, keepdims=True), 1e-9)
+        t = np.asarray(topics)
+        same = (t[:, None] == t[None, :])
+        sims = vecs @ vecs.T
+        off = ~np.eye(len(t), dtype=bool)
+        within = sims[same & off].mean()
+        across = sims[~same].mean()
+        assert within > across + 0.15, \
+            f"dm={dm}: within={within:.3f} across={across:.3f}"
+
+    @pytest.mark.parametrize("dm", [True, False], ids=["dm", "dbow"])
+    def test_infer_unseen_doc(self, dm):
+        docs, labels, topics = topic_docs()
+        pv = ParagraphVectors(dm=dm, layer_size=24, window=3, epochs=20,
+                              batch_size=128, seed=1, learning_rate=0.05)
+        pv.fit(docs, labels)
+        inferred = pv.infer("cat dog pet fur meow bark tail paw cat dog")
+        assert inferred.shape == (24,)
+        # nearest trained docs must be overwhelmingly animal-topic
+        near = pv.nearest_labels(inferred, top_n=10)
+        t_by_label = dict(zip(labels, topics))
+        animal_hits = sum(1 for lb in near if t_by_label[lb] == 0)
+        assert animal_hits >= 8, f"only {animal_hits}/10 animal docs: {near}"
+
+    def test_infer_is_deterministic_given_seed(self):
+        docs, labels, _ = topic_docs(40)
+        pv = ParagraphVectors(dm=False, layer_size=16, epochs=3,
+                              batch_size=128, seed=1)
+        pv.fit(docs, labels)
+        a = pv.infer_vector(["cat", "dog", "pet"], seed=5)
+        b = pv.infer_vector(["cat", "dog", "pet"], seed=5)
+        np.testing.assert_allclose(a, b)
+
+    def test_requires_labels_match(self):
+        pv = ParagraphVectors(layer_size=8)
+        with pytest.raises(ValueError, match="labels"):
+            pv.fit_sequences([["a", "b"]], labels=["x", "y"])
+
+    def test_unsupported_combos_rejected(self):
+        with pytest.raises(NotImplementedError, match="DM"):
+            ParagraphVectors(dm=True, hierarchic_softmax=True)
+        with pytest.raises(NotImplementedError, match="CBOW"):
+            SequenceVectors(cbow=True, hierarchic_softmax=True)
+
+    def test_dbow_with_hierarchical_softmax(self):
+        docs, labels, topics = topic_docs()
+        pv = ParagraphVectors(dm=False, hierarchic_softmax=True, layer_size=24,
+                              window=3, epochs=20, batch_size=128, seed=1,
+                              learning_rate=0.05)
+        pv.fit(docs, labels)
+        vecs = np.stack([pv.doc_vector(lb) for lb in labels])
+        vecs = vecs / np.maximum(np.linalg.norm(vecs, axis=1, keepdims=True), 1e-9)
+        t = np.asarray(topics)
+        sims = vecs @ vecs.T
+        off = ~np.eye(len(t), dtype=bool)
+        within = sims[(t[:, None] == t[None, :]) & off].mean()
+        across = sims[t[:, None] != t[None, :]].mean()
+        assert within > across + 0.15, f"within={within:.3f} across={across:.3f}"
+
+    def test_words_nearest_excludes_label_rows(self):
+        docs, labels, _ = topic_docs(40)
+        pv = ParagraphVectors(dm=False, layer_size=16, epochs=3,
+                              batch_size=128, seed=1)
+        pv.fit(docs, labels)
+        near = pv.words_nearest("cat", top_n=10)  # must not crash on label rows
+        assert all(isinstance(w, str) and not w.startswith("DOC_") for w in near)
+
+
+class TestGlove:
+    def test_cooccurrence_weighting(self):
+        from deeplearning4j_tpu.nlp import CoOccurrences
+        cooc = CoOccurrences(window=2, symmetric=True).count(
+            [np.asarray([0, 1, 2], np.int32)])
+        # adjacent pair weight 1.0, distance-2 pair weight 0.5, symmetric
+        assert cooc[(0, 1)] == 1.0 and cooc[(1, 0)] == 1.0
+        assert cooc[(0, 2)] == 0.5 and cooc[(2, 0)] == 0.5
+
+    def test_topics_separate(self):
+        rng = np.random.default_rng(0)
+        sentences = []
+        for _ in range(300):
+            vocab = ANIMALS if rng.integers(0, 2) == 0 else TECH
+            sentences.append(" ".join(rng.choice(vocab, size=10)))
+        glove = Glove(layer_size=24, window=5, min_word_frequency=2,
+                      epochs=30, learning_rate=0.05, seed=1)
+        glove.fit(sentences)
+        assert len(glove.vocab) == 16
+        # training loss must drop
+        assert glove.losses[-1] < glove.losses[0] * 0.5, glove.losses[::10]
+        within = glove.similarity("cat", "dog")
+        across = glove.similarity("cat", "cpu")
+        assert within > across + 0.2, f"within={within:.3f} across={across:.3f}"
+        nearest = glove.words_nearest("cat", top_n=7)
+        assert len(set(nearest) & set(ANIMALS[1:])) >= 5, nearest
+
+    def test_empty_corpus_raises(self):
+        with pytest.raises(ValueError, match="vocabulary|co-occurrence"):
+            Glove(min_word_frequency=100).fit(["one two three"])
