@@ -1,0 +1,120 @@
+"""Memory estimation reports.
+
+Parity target: reference nn/conf/memory/ (MemoryReport,
+LayerMemoryReport, NetworkMemoryReport — getMemoryReport(InputType) on
+every layer config).  The TPU inversion is simpler and more honest:
+params, optimizer state, and activations are the dominant HBM terms under
+XLA (no workspaces / iterator scratch as in the reference), and gradient
+memory ≈ param memory for the fused train step.  Estimates assume
+rematerialization is OFF; XLA fusion typically does better.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class LayerMemoryReport:
+    """Per-layer estimate (reference LayerMemoryReport.Builder fields)."""
+
+    name: str
+    layer_type: str
+    param_count: int
+    param_bytes: int
+    updater_state_bytes: int
+    activation_elements_per_example: int
+    activation_bytes_per_example: int
+
+
+@dataclasses.dataclass
+class NetworkMemoryReport:
+    """Whole-model estimate (reference NetworkMemoryReport)."""
+
+    layers: List[LayerMemoryReport]
+    minibatch: int
+    param_dtype: str
+    compute_dtype: str
+
+    @property
+    def total_param_bytes(self) -> int:
+        return sum(l.param_bytes for l in self.layers)
+
+    @property
+    def total_updater_bytes(self) -> int:
+        return sum(l.updater_state_bytes for l in self.layers)
+
+    @property
+    def total_activation_bytes(self) -> int:
+        return self.minibatch * sum(l.activation_bytes_per_example for l in self.layers)
+
+    def total_bytes(self, training: bool = True) -> int:
+        """Fixed + per-minibatch total; training adds one gradient copy of
+        the params (the fused step's peak)."""
+        fixed = self.total_param_bytes + (self.total_updater_bytes if training else 0)
+        grad = self.total_param_bytes if training else 0
+        return fixed + grad + self.total_activation_bytes
+
+    def __str__(self) -> str:
+        lines = [
+            f"NetworkMemoryReport (mb={self.minibatch}, params={self.param_dtype}, "
+            f"compute={self.compute_dtype})",
+            f"{'layer':<24}{'type':<22}{'params':>12}{'param MB':>10}{'act KB/ex':>12}",
+        ]
+        for l in self.layers:
+            lines.append(
+                f"{l.name or '?':<24}{l.layer_type:<22}{l.param_count:>12,}"
+                f"{l.param_bytes / 2**20:>10.2f}"
+                f"{l.activation_bytes_per_example / 2**10:>12.1f}")
+        lines.append(
+            f"TOTAL train ≈ {self.total_bytes(True) / 2**20:.1f} MB "
+            f"(params {self.total_param_bytes / 2**20:.1f} + updater "
+            f"{self.total_updater_bytes / 2**20:.1f} + grads "
+            f"{self.total_param_bytes / 2**20:.1f} + activations "
+            f"{self.total_activation_bytes / 2**20:.1f})")
+        return "\n".join(lines)
+
+
+def _updater_copies(updater) -> int:
+    """Optimizer-state copies of the params (Adam/AdaMax/Nadam/AMSGrad → 2,
+    momentum-family/AdaGrad/RmsProp → 1, Sgd/NoOp → 0)."""
+    name = type(updater).__name__.lower()
+    if name in ("adam", "adamax", "nadam"):
+        return 2
+    if name in ("amsgrad",):
+        return 3
+    if name in ("sgd", "noop"):
+        return 0
+    return 1
+
+
+def memory_report(net, minibatch: int = 32) -> NetworkMemoryReport:
+    """Estimate memory for an initialized MultiLayerNetwork
+    (reference MultiLayerConfiguration.getMemoryReport)."""
+    conf = net.conf
+    pbytes = np.dtype(conf.param_dtype).itemsize
+    abytes = np.dtype(conf.compute_dtype).itemsize
+    reports: List[LayerMemoryReport] = []
+    for i, layer in enumerate(conf.layers):
+        pcount = sum(int(np.prod(a.shape)) for a in net.params[i].values()) \
+            if i < len(net.params) and net.params[i] else 0
+        out_t = layer.output_type(net.input_types[i]) if net.input_types else None
+        try:
+            act_elems = out_t.flat_size() if out_t is not None else 0
+        except ValueError:   # variable-length recurrent
+            act_elems = out_t.size if out_t is not None else 0
+        upd = layer.updater if layer.updater is not None else conf.updater
+        reports.append(LayerMemoryReport(
+            name=layer.name or f"layer_{i}",
+            layer_type=type(layer).__name__,
+            param_count=pcount,
+            param_bytes=pcount * pbytes,
+            updater_state_bytes=pcount * pbytes * _updater_copies(upd),
+            activation_elements_per_example=act_elems,
+            activation_bytes_per_example=act_elems * abytes,
+        ))
+    return NetworkMemoryReport(reports, minibatch, conf.param_dtype,
+                               conf.compute_dtype)

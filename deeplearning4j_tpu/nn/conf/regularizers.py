@@ -1,0 +1,263 @@
+"""Dropout variants, weight noise, and parameter constraints.
+
+Parity targets in the reference:
+  nn/conf/dropout/     Dropout, AlphaDropout, GaussianDropout, GaussianNoise
+  nn/conf/weightnoise/ DropConnect, WeightNoise
+  nn/conf/constraint/  MaxNormConstraint, MinMaxNormConstraint,
+                       UnitNormConstraint, NonNegativeConstraint
+
+Design: a layer's ``dropout`` field accepts a float (classic inverted
+dropout, the common case) or one of the IDropout configs below; the
+``weight_noise`` field holds an IWeightNoise applied to weight params each
+training forward; ``constraints`` lists IConstraints applied after each
+parameter update (reference BaseConstraint.applyConstraint on param tables
+whose names match).  All are registered dataclasses, so layer JSON
+round-trips carry them.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..layers.base import register_config
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# IDropout family (input dropout)
+# ---------------------------------------------------------------------------
+
+
+@register_config
+@dataclasses.dataclass
+class Dropout:
+    """Classic inverted dropout (reference nn/conf/dropout/Dropout.java).
+    ``p`` is the DROP probability."""
+
+    p: float = 0.5
+
+    def apply(self, rng: Array, x: Array, train: bool) -> Array:
+        if not train or self.p <= 0.0:
+            return x
+        keep = 1.0 - self.p
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+@register_config
+@dataclasses.dataclass
+class AlphaDropout:
+    """SELU-compatible dropout (reference AlphaDropout.java, Klambauer et
+    al. 2017): dropped units take α' = −λα, then an affine correction
+    (a, b) restores zero mean / unit variance."""
+
+    p: float = 0.5
+
+    _LAMBDA = 1.0507009873554805
+    _ALPHA = 1.6732632423543772
+
+    def apply(self, rng: Array, x: Array, train: bool) -> Array:
+        if not train or self.p <= 0.0:
+            return x
+        keep = 1.0 - self.p
+        alpha_p = -self._LAMBDA * self._ALPHA
+        a = (keep + alpha_p ** 2 * keep * (1 - keep)) ** -0.5
+        b = -a * alpha_p * (1 - keep)
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return (a * jnp.where(mask, x, alpha_p) + b).astype(x.dtype)
+
+
+@register_config
+@dataclasses.dataclass
+class GaussianDropout:
+    """Multiplicative N(1, rate/(1−rate)) noise (reference
+    GaussianDropout.java, Srivastava et al. 2014 §10)."""
+
+    rate: float = 0.5
+
+    def apply(self, rng: Array, x: Array, train: bool) -> Array:
+        if not train or self.rate <= 0.0:
+            return x
+        std = (self.rate / (1.0 - self.rate)) ** 0.5
+        noise = 1.0 + std * jax.random.normal(rng, x.shape, dtype=jnp.float32)
+        return (x * noise.astype(x.dtype))
+
+
+@register_config
+@dataclasses.dataclass
+class GaussianNoise:
+    """Additive N(0, stddev²) noise (reference GaussianNoise.java)."""
+
+    stddev: float = 0.1
+
+    def apply(self, rng: Array, x: Array, train: bool) -> Array:
+        if not train or self.stddev <= 0.0:
+            return x
+        return x + self.stddev * jax.random.normal(rng, x.shape).astype(x.dtype)
+
+
+def apply_dropout(dropout, rng: Array, x: Array, train: bool) -> Array:
+    """Dispatch a layer's ``dropout`` field: float → classic, config → its
+    apply()."""
+    if dropout is None:
+        return x
+    if isinstance(dropout, (int, float)):
+        return Dropout(float(dropout)).apply(rng, x, train) if dropout > 0 else x
+    return dropout.apply(rng, x, train)
+
+
+# ---------------------------------------------------------------------------
+# IWeightNoise family (applied to weight params per training forward)
+# ---------------------------------------------------------------------------
+
+#: param keys the noise/constraints treat as "weights" (biases and BN
+#: statistics excluded, reference BaseConstraint.DEFAULT_PARAMS)
+WEIGHT_KEYS_EXCLUDED = ("b", "vb", "hb", "beta", "gamma", "mean", "var")
+
+
+def _is_weight(key: str) -> bool:
+    return key not in WEIGHT_KEYS_EXCLUDED
+
+
+@register_config
+@dataclasses.dataclass
+class DropConnect:
+    """Per-weight Bernoulli masking (reference weightnoise/DropConnect.java,
+    Wan et al. 2013).  ``p`` is the RETAIN probability, matching the
+    reference's 'probability of keeping a weight'."""
+
+    p: float = 0.5
+
+    def apply(self, rng: Array, params: Dict[str, Array], train: bool) -> Dict[str, Array]:
+        if not train:
+            return params
+        out = dict(params)
+        for i, (k, v) in enumerate(sorted(params.items())):
+            if _is_weight(k):
+                mask = jax.random.bernoulli(jax.random.fold_in(rng, i), self.p, v.shape)
+                out[k] = jnp.where(mask, v, 0.0).astype(v.dtype)
+        return out
+
+
+@register_config
+@dataclasses.dataclass
+class WeightNoise:
+    """Additive or multiplicative gaussian weight noise (reference
+    weightnoise/WeightNoise.java with a normal distribution)."""
+
+    stddev: float = 0.01
+    additive: bool = True
+    mean: float = 0.0
+
+    def apply(self, rng: Array, params: Dict[str, Array], train: bool) -> Dict[str, Array]:
+        if not train:
+            return params
+        out = dict(params)
+        for i, (k, v) in enumerate(sorted(params.items())):
+            if _is_weight(k):
+                noise = (self.mean + self.stddev * jax.random.normal(
+                    jax.random.fold_in(rng, i), v.shape)).astype(v.dtype)
+                out[k] = v + noise if self.additive else v * noise
+        return out
+
+
+def apply_weight_noise(noise, rng: Array, params: Dict[str, Array],
+                       train: bool) -> Dict[str, Array]:
+    if noise is None or not params:
+        return params
+    return noise.apply(rng, params, train)
+
+
+def maybe_weight_noise(layer, params: Dict[str, Array], train: bool,
+                       rng: Optional[Array]) -> Dict[str, Array]:
+    """Container-side guard: apply a layer's weight_noise to its params
+    before forward() during training (shared by MultiLayerNetwork and
+    ComputationGraph so their RNG derivation stays identical)."""
+    if not train or layer.weight_noise is None or rng is None or not params:
+        return params
+    return layer.weight_noise.apply(jax.random.fold_in(rng, 7), params, train)
+
+
+# ---------------------------------------------------------------------------
+# IConstraint family (applied after each parameter update)
+# ---------------------------------------------------------------------------
+
+
+def _norm_axes(v: Array) -> Tuple[int, ...]:
+    """Norm over all axes but the last (output) axis — matches the
+    reference's per-output-unit norms (BaseConstraint dimensions)."""
+    return tuple(range(max(v.ndim - 1, 1)))
+
+
+@register_config
+@dataclasses.dataclass
+class MaxNormConstraint:
+    """Clip per-unit L2 norm to max_norm (reference MaxNormConstraint)."""
+
+    max_norm: float = 2.0
+
+    def apply(self, params: Dict[str, Array]) -> Dict[str, Array]:
+        out = dict(params)
+        for k, v in params.items():
+            if _is_weight(k) and v.ndim >= 2:
+                n = jnp.sqrt(jnp.sum(v * v, axis=_norm_axes(v), keepdims=True))
+                out[k] = jnp.where(n > self.max_norm, v * (self.max_norm / jnp.maximum(n, 1e-12)), v)
+        return out
+
+
+@register_config
+@dataclasses.dataclass
+class MinMaxNormConstraint:
+    """Scale per-unit norms into [min_norm, max_norm] with rate blending
+    (reference MinMaxNormConstraint)."""
+
+    min_norm: float = 0.0
+    max_norm: float = 2.0
+    rate: float = 1.0
+
+    def apply(self, params: Dict[str, Array]) -> Dict[str, Array]:
+        out = dict(params)
+        for k, v in params.items():
+            if _is_weight(k) and v.ndim >= 2:
+                n = jnp.sqrt(jnp.sum(v * v, axis=_norm_axes(v), keepdims=True))
+                clipped = jnp.clip(n, self.min_norm, self.max_norm)
+                scale = 1.0 - self.rate + self.rate * clipped / jnp.maximum(n, 1e-12)
+                out[k] = v * scale
+        return out
+
+
+@register_config
+@dataclasses.dataclass
+class UnitNormConstraint:
+    """Force per-unit norm to 1 (reference UnitNormConstraint)."""
+
+    def apply(self, params: Dict[str, Array]) -> Dict[str, Array]:
+        out = dict(params)
+        for k, v in params.items():
+            if _is_weight(k) and v.ndim >= 2:
+                n = jnp.sqrt(jnp.sum(v * v, axis=_norm_axes(v), keepdims=True))
+                out[k] = v / jnp.maximum(n, 1e-12)
+        return out
+
+
+@register_config
+@dataclasses.dataclass
+class NonNegativeConstraint:
+    """Clamp params at ≥ 0 (reference NonNegativeConstraint; applies to all
+    params like the reference's default)."""
+
+    def apply(self, params: Dict[str, Array]) -> Dict[str, Array]:
+        return {k: jnp.maximum(v, 0.0) for k, v in params.items()}
+
+
+def apply_constraints(constraints, params: Dict[str, Array]) -> Dict[str, Array]:
+    if not constraints or not params:
+        return params
+    for c in constraints:
+        params = c.apply(params)
+    return params

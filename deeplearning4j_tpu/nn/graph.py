@@ -318,6 +318,8 @@ class ComputationGraphConfiguration:
     seed: int = 12345
     param_dtype: str = "float32"
     compute_dtype: str = "float32"
+    backprop_type: str = "standard"       # or "tbptt"
+    tbptt_length: int = 20
 
     def to_dict(self) -> dict:
         return {
@@ -335,6 +337,8 @@ class ComputationGraphConfiguration:
             "seed": self.seed,
             "param_dtype": self.param_dtype,
             "compute_dtype": self.compute_dtype,
+            "backprop_type": self.backprop_type,
+            "tbptt_length": self.tbptt_length,
         }
 
     @staticmethod
@@ -351,6 +355,8 @@ class ComputationGraphConfiguration:
             seed=d.get("seed", 12345),
             param_dtype=d.get("param_dtype", "float32"),
             compute_dtype=d.get("compute_dtype", "float32"),
+            backprop_type=d.get("backprop_type", "standard"),
+            tbptt_length=d.get("tbptt_length", 20),
         )
 
 
@@ -393,6 +399,13 @@ class GraphBuilder:
         self._conf.network_outputs.extend(names)
         return self
 
+    def tbptt(self, length: int) -> "GraphBuilder":
+        """Truncated BPTT over the time axis (reference GraphBuilder
+        .backpropType(TruncatedBPTT).tBPTTLength)."""
+        self._conf.backprop_type = "tbptt"
+        self._conf.tbptt_length = length
+        return self
+
     def build(self) -> ComputationGraphConfiguration:
         return self._conf
 
@@ -417,7 +430,10 @@ class ComputationGraph:
         self.epoch = 0
         self.listeners: List[Any] = []
         self._jit_step = None
+        self._jit_step_tbptt = None
         self._jit_output = None
+        self._jit_stream = None
+        self._stream_carries = None
         self._rng = jax.random.PRNGKey(conf.seed)
         self._spec_by_name = {v.name: v for v in conf.vertices}
         self.topo_order = self._topological_sort()
@@ -514,11 +530,16 @@ class ComputationGraph:
 
     def _apply(self, params, state, inputs: Dict[str, Array], *, train: bool, rng,
                masks: Optional[Dict[str, Optional[Array]]] = None,
-               stop_before_output_score: bool = False):
-        """Evaluate the DAG.  Returns (activations dict, new_state, masks dict).
+               stop_before_output_score: bool = False, carries=None):
+        """Evaluate the DAG.  Returns (activations dict, new_state, masks
+        dict, new_carries).
 
         When ``stop_before_output_score`` the output LayerVertices are NOT
-        applied (their score() consumes the pre-layer activations)."""
+        applied (their score() consumes the pre-layer activations).
+        ``carries`` (dict name→carry, None entries for stateless vertices)
+        threads recurrent hidden state through LayerVertices for TBPTT /
+        streaming — the DAG analog of the reference's
+        rnnActivateUsingStoredState (ComputationGraph.java:1602)."""
         compute = jnp.dtype(self.conf.compute_dtype)
         acts: Dict[str, Array] = {}
         mks: Dict[str, Optional[Array]] = {}
@@ -526,6 +547,7 @@ class ComputationGraph:
             acts[k] = v.astype(compute) if jnp.issubdtype(v.dtype, jnp.floating) else v
             mks[k] = (masks or {}).get(k)
         new_state = dict(state)
+        new_carries = dict(carries) if carries is not None else {}
         keys = (jax.random.split(rng, len(self.topo_order))
                 if rng is not None else [None] * len(self.topo_order))
         for key, name in zip(keys, self.topo_order):
@@ -535,19 +557,38 @@ class ComputationGraph:
             xin = [acts[i] for i in spec.inputs]
             min_ = [mks[i] for i in spec.inputs]
             if isinstance(spec.vertex, LayerVertex):
-                out = spec.vertex.layer.forward(
-                    params[name], state[name], xin[0], train=train, rng=key, mask=min_[0])
+                layer = spec.vertex.layer
+                kwargs = {}
+                if layer.recurrent and carries is not None:
+                    kwargs["carry"] = carries.get(name)
+                from .conf.regularizers import maybe_weight_noise
+                p_v = maybe_weight_noise(layer, params[name], train, key)
+                out = layer.forward(
+                    p_v, state[name], xin[0], train=train, rng=key,
+                    mask=min_[0], **kwargs)
                 acts[name], mks[name] = out.y, out.mask
                 new_state[name] = out.state
+                if layer.recurrent and carries is not None:
+                    new_carries[name] = out.carry
             else:
                 acts[name] = spec.vertex.forward(xin, min_)
                 mks[name] = spec.vertex.output_mask(min_)
-        return acts, new_state, mks
+        return acts, new_state, mks, new_carries
+
+    def _init_carries(self, mb: int) -> Dict[str, Any]:
+        """Zero carries for every recurrent LayerVertex (None elsewhere)."""
+        dtype = jnp.dtype(self.conf.compute_dtype)
+        carries: Dict[str, Any] = {}
+        for spec in self.conf.vertices:
+            if isinstance(spec.vertex, LayerVertex) and spec.vertex.layer.recurrent:
+                carries[spec.name] = spec.vertex.layer.init_carry(mb, dtype)
+        return carries
 
     def _loss(self, params, state, inputs: Dict[str, Array], labels: Dict[str, Any],
-              *, train: bool, rng, masks=None, label_masks=None):
-        acts, new_state, mks = self._apply(params, state, inputs, train=train, rng=rng,
-                                           masks=masks, stop_before_output_score=True)
+              *, train: bool, rng, masks=None, label_masks=None, carries=None):
+        acts, new_state, mks, new_carries = self._apply(
+            params, state, inputs, train=train, rng=rng,
+            masks=masks, stop_before_output_score=True, carries=carries)
         acc = jnp.float64 if jnp.dtype(self.conf.compute_dtype) == jnp.float64 else jnp.float32
         total = jnp.zeros((), acc)
         for oi, out_name in enumerate(self.conf.network_outputs):
@@ -570,9 +611,37 @@ class ComputationGraph:
             if isinstance(spec.vertex, LayerVertex) and self.params.get(spec.name):
                 total = total + spec.vertex.layer.regularization_score(
                     params[spec.name]).astype(acc)
+        if carries is not None:
+            return total, (new_state, new_carries)
         return total, new_state
 
     # -- training ----------------------------------------------------------
+
+    def _apply_updates(self, grads, params, opt_state, itf):
+        """Shared per-vertex updater application (grad normalization, updater
+        math, dtype-preserving cast, post-update constraints) — used by both
+        the standard and TBPTT jitted steps."""
+        conf = self.conf
+        new_params, new_opt = dict(params), dict(opt_state)
+        for spec in conf.vertices:
+            name = spec.name
+            if not isinstance(spec.vertex, LayerVertex) or not params[name]:
+                continue
+            g = grads[name]
+            if conf.gradient_normalization != GradientNormalization.NONE:
+                g = normalize_gradients(g, conf.gradient_normalization,
+                                        conf.gradient_normalization_threshold)
+            upd = self._updater_for(spec.vertex.layer)
+            updates, os2 = upd.update(g, opt_state[name], itf)
+            new_params[name] = jax.tree_util.tree_map(
+                lambda pp, uu: (pp.astype(jnp.float32) - uu).astype(pp.dtype),
+                params[name], updates)
+            if spec.vertex.layer.constraints:
+                from .conf.regularizers import apply_constraints
+                new_params[name] = apply_constraints(
+                    spec.vertex.layer.constraints, new_params[name])
+            new_opt[name] = os2
+        return new_params, new_opt
 
     def _make_step(self):
         conf = self.conf
@@ -583,23 +652,29 @@ class ComputationGraph:
                                   masks=masks, label_masks=label_masks)
 
             (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            new_params, new_opt = dict(params), dict(opt_state)
-            itf = it.astype(jnp.float32)
-            for spec in conf.vertices:
-                name = spec.name
-                if not isinstance(spec.vertex, LayerVertex) or not params[name]:
-                    continue
-                g = grads[name]
-                if conf.gradient_normalization != GradientNormalization.NONE:
-                    g = normalize_gradients(g, conf.gradient_normalization,
-                                            conf.gradient_normalization_threshold)
-                upd = self._updater_for(spec.vertex.layer)
-                updates, os2 = upd.update(g, opt_state[name], itf)
-                new_params[name] = jax.tree_util.tree_map(
-                    lambda pp, uu: (pp.astype(jnp.float32) - uu).astype(pp.dtype),
-                    params[name], updates)
-                new_opt[name] = os2
+            new_params, new_opt = self._apply_updates(
+                grads, params, opt_state, it.astype(jnp.float32))
             return new_params, new_state, new_opt, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _make_step_tbptt(self):
+        """TBPTT step: threads recurrent carries across sequence chunks
+        (reference ComputationGraph.doTruncatedBPTT:1553)."""
+        conf = self.conf
+
+        def step(params, state, opt_state, it, inputs, labels, rng, masks,
+                 label_masks, carries):
+            def loss_fn(p):
+                return self._loss(p, state, inputs, labels, train=True, rng=rng,
+                                  masks=masks, label_masks=label_masks,
+                                  carries=carries)
+
+            (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            new_params, new_opt = self._apply_updates(
+                grads, params, opt_state, it.astype(jnp.float32))
+            return new_params, new_state, new_opt, new_carries, loss
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
@@ -613,6 +688,8 @@ class ComputationGraph:
 
     def fit_batch(self, ds) -> float:
         mds = self._to_mds(ds)
+        if self.conf.backprop_type == "tbptt":
+            return self._fit_batch_tbptt(mds)
         if self._jit_step is None:
             self._jit_step = self._make_step()
         self._rng, sub = jax.random.split(self._rng)
@@ -633,6 +710,67 @@ class ComputationGraph:
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, loss_val)
         return loss_val
+
+    def _fit_batch_tbptt(self, mds: MultiDataSet) -> float:
+        """Slice the time axis into tbptt_length chunks, carry recurrent
+        state forward, one optimizer step per chunk (reference
+        doTruncatedBPTT:1553).  All rank-3 inputs/labels must share T."""
+        if self._jit_step_tbptt is None:
+            self._jit_step_tbptt = self._make_step_tbptt()
+        feats = [np.asarray(f) for f in mds.features]
+        labs = [None if l is None else np.asarray(l) for l in mds.labels]
+        T = None
+        for a in feats + [l for l in labs if l is not None]:
+            if a.ndim == 3:
+                if T is not None and a.shape[1] != T:
+                    raise ValueError("TBPTT requires equal time lengths across "
+                                     f"inputs/labels (got {a.shape[1]} vs {T})")
+                T = a.shape[1]
+        if T is None:
+            raise ValueError("TBPTT requires at least one [mb, time, f] array")
+        mb = feats[0].shape[0]
+        L = self.conf.tbptt_length
+        fmasks = mds.features_masks or [None] * len(feats)
+        lmasks_l = mds.labels_masks or [None] * len(labs)
+        carries = self._init_carries(mb)
+        total, chunks = 0.0, 0
+
+        def tslice(a, s):
+            """Features/labels: only rank-3 arrays carry a time axis —
+            rank-2 static inputs pass through whole (their dim-1 may
+            coincidentally equal T)."""
+            if a is None:
+                return None
+            return a[:, s:s + L] if a.ndim == 3 else a
+
+        def mslice(m, s):
+            """Masks are [mb, T] when temporal; other shapes pass through."""
+            if m is None:
+                return None
+            m = np.asarray(m)
+            return m[:, s:s + L] if m.ndim == 2 and m.shape[1] == T else m
+
+        for s in range(0, T, L):
+            inputs = {n: jnp.asarray(tslice(f, s))
+                      for n, f in zip(self.conf.network_inputs, feats)}
+            labels = {n: (None if l is None else jnp.asarray(tslice(l, s)))
+                      for n, l in zip(self.conf.network_outputs, labs)}
+            masks = {n: (None if m is None else jnp.asarray(mslice(m, s)))
+                     for n, m in zip(self.conf.network_inputs, fmasks)}
+            lmasks = {n: (None if m is None else jnp.asarray(mslice(m, s)))
+                      for n, m in zip(self.conf.network_outputs, lmasks_l)}
+            self._rng, sub = jax.random.split(self._rng)
+            (self.params, self.state, self.opt_state, carries, loss
+             ) = self._jit_step_tbptt(
+                self.params, self.state, self.opt_state,
+                jnp.asarray(self.iteration, jnp.int32), inputs, labels, sub,
+                masks, lmasks, carries)
+            self.iteration += 1
+            total += float(loss)
+            chunks += 1
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration, float(loss))
+        return total / max(chunks, 1)
 
     def fit(self, data, epochs: int = 1) -> List[float]:
         losses = []
@@ -660,8 +798,8 @@ class ComputationGraph:
         (reference ComputationGraph.output)."""
         if self._jit_output is None:
             def fwd(params, state, inputs, mks):
-                acts, _, _ = self._apply(params, state, inputs, train=False, rng=None,
-                                         masks=mks)
+                acts, _, _, _ = self._apply(params, state, inputs, train=False,
+                                            rng=None, masks=mks)
                 return [acts[n] for n in self.conf.network_outputs]
             self._jit_output = jax.jit(fwd)
         inputs = {n: jnp.asarray(f) for n, f in zip(self.conf.network_inputs, features)}
@@ -669,6 +807,49 @@ class ComputationGraph:
                for i, n in enumerate(self.conf.network_inputs)} if masks else None
         outs = self._jit_output(self.params, self.state, inputs, mks)
         return [np.asarray(o) for o in outs]
+
+    def rnn_time_step(self, *features) -> List[np.ndarray]:
+        """Stateful streaming inference over the DAG: each rank-2 input
+        [mb, f] is treated as one timestep, rank-3 inputs stream their
+        chunk; recurrent vertex state persists across calls (reference
+        ComputationGraph.rnnTimeStep:1500)."""
+        arrs = []
+        ranks = []
+        for f in features:
+            a = jnp.asarray(f)
+            ranks.append(a.ndim)
+            if a.ndim == 2:
+                a = a[:, None, :]
+            arrs.append(a)
+        # single-step squeeze only when EVERY input was a single timestep;
+        # mixed-rank calls keep full sequence outputs
+        squeeze = all(r == 2 for r in ranks)
+        mb = arrs[0].shape[0]
+        if self._stream_carries is not None:
+            for c in jax.tree_util.tree_leaves(self._stream_carries):
+                if c.shape[0] != mb:  # batch size changed → fresh state
+                    self._stream_carries = None
+                break
+        if self._stream_carries is None:
+            self._stream_carries = self._init_carries(mb)
+        if self._jit_stream is None:
+            def fwd(params, state, inputs, carries):
+                acts, _, _, new_carries = self._apply(
+                    params, state, inputs, train=False, rng=None, carries=carries)
+                return [acts[n] for n in self.conf.network_outputs], new_carries
+            self._jit_stream = jax.jit(fwd)
+        inputs = {n: a for n, a in zip(self.conf.network_inputs, arrs)}
+        outs, self._stream_carries = self._jit_stream(
+            self.params, self.state, inputs, self._stream_carries)
+        result = []
+        for o in outs:
+            o = np.asarray(o)
+            result.append(o[:, 0] if squeeze and o.ndim == 3 else o)
+        return result
+
+    def rnn_clear_previous_state(self) -> None:
+        """Reset streaming state (reference rnnClearPreviousState)."""
+        self._stream_carries = None
 
     def _mask_dicts(self, mds: MultiDataSet):
         masks = {n: (None if m is None else jnp.asarray(m))

@@ -9,6 +9,7 @@ from .feedforward import (
     EmbeddingSequence,
     ElementWiseMultiplication,
     AutoEncoder,
+    RBM,
 )
 from .convolution import (
     Convolution1D,

@@ -136,11 +136,19 @@ class Layer:
     activation: Optional[str] = None
     weight_init: Optional[str] = None
     bias_init: float = 0.0
-    dropout: float = 0.0
+    #: float = classic inverted dropout (drop prob), or an IDropout config
+    #: (AlphaDropout/GaussianDropout/GaussianNoise, nn/conf/regularizers.py)
+    dropout: Any = 0.0
     l1: float = 0.0
     l2: float = 0.0
     updater: Optional[Any] = None  # per-layer IUpdater override (nn/updaters)
     trainable: bool = True
+    #: IWeightNoise (DropConnect/WeightNoise) applied to weight params on
+    #: each training forward (reference nn/conf/weightnoise/)
+    weight_noise: Optional[Any] = None
+    #: IConstraints applied after every parameter update (reference
+    #: nn/conf/constraint/, e.g. MaxNormConstraint)
+    constraints: Optional[Any] = None
 
     #: expected input kind: None = any, else "ff" / "cnn" / "rnn".  Drives
     #: automatic preprocessor insertion (the reference's
@@ -184,13 +192,13 @@ class Layer:
 
     # -- shared helpers ----------------------------------------------------
     def _maybe_dropout(self, x: Array, train: bool, rng: Optional[Array]) -> Array:
-        if not train or self.dropout <= 0.0:
+        d = self.dropout
+        if not train or d is None or (isinstance(d, (int, float)) and d <= 0.0):
             return x
         if rng is None:
             raise ValueError(f"layer {self.name}: dropout requires an rng key in training")
-        keep = 1.0 - self.dropout
-        mask = jax.random.bernoulli(rng, keep, x.shape)
-        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+        from ..conf.regularizers import apply_dropout
+        return apply_dropout(d, rng, x, train)
 
     def _act(self, x: Array) -> Array:
         return get_activation(self.activation or "identity")(x)

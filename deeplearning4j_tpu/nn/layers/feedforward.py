@@ -251,3 +251,79 @@ class AutoEncoder(Layer):
             xin = jnp.where(keep, x, 0.0).astype(x.dtype)
         recon = self.decode(params, self.encode(params, xin))
         return get_loss(self.loss)(x, recon, "identity")
+
+
+@register_layer
+@dataclasses.dataclass
+class RBM(Layer):
+    """Restricted Boltzmann Machine (reference nn/conf/layers/RBM.java +
+    nn/layers/feedforward/rbm/RBM.java): binary-binary by default, CD-k
+    pretraining via ``contrastive_divergence``; ``forward`` is propUp
+    (the hidden probabilities), so an RBM stacks like any dense layer for
+    supervised fine-tuning — the classic DBN recipe.
+
+    Params: "W" [n_in, n_out], hidden bias "b", visible bias "vb"
+    (PretrainParamInitializer: VISIBLE_BIAS_KEY)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    activation: str = "sigmoid"
+    k: int = 1                  # CD-k steps
+
+    def infer_nin(self, in_type: InputType) -> None:
+        if self.n_in == 0:
+            self.n_in = in_type.size if in_type.kind in ("ff", "rnn") else in_type.flat_size()
+
+    def output_type(self, in_type: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, rng, in_type, dtype=jnp.float32) -> Dict[str, Array]:
+        k1, _ = jax.random.split(rng)
+        return {
+            "W": init_weight(k1, (self.n_in, self.n_out), self._winit(),
+                             self.n_in, self.n_out, dtype),
+            "b": jnp.zeros((self.n_out,), dtype),
+            "vb": jnp.zeros((self.n_in,), dtype),
+        }
+
+    def prop_up(self, params, v):
+        """Hidden activation — honors the configured ``activation``
+        (reference HiddenUnit; sigmoid = binary units, the CD default)."""
+        return self._act(v @ params["W"].astype(v.dtype) + params["b"].astype(v.dtype))
+
+    def prop_down(self, params, h):
+        """Visible reconstruction — binary (sigmoid) visible units."""
+        return jax.nn.sigmoid(h @ params["W"].T.astype(h.dtype) + params["vb"].astype(h.dtype))
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        x = self._maybe_dropout(x, train, rng)
+        return ForwardOut(self.prop_up(params, x), state, mask)
+
+    def contrastive_divergence(self, params, v0, rng, lr: float = 0.1):
+        """One CD-k update (reference RBM.computeGradientAndScore Gibbs
+        chain).  Returns (new_params, reconstruction_error).  Requires
+        binary (sigmoid) hidden units — Bernoulli sampling needs
+        probabilities."""
+        if (self.activation or "sigmoid") != "sigmoid":
+            raise ValueError("contrastive_divergence requires activation="
+                             f"'sigmoid' (binary hidden units), got {self.activation!r}")
+        k0, key = jax.random.split(rng)
+        h_prob = self.prop_up(params, v0)
+        h_sample = jax.random.bernoulli(k0, h_prob).astype(v0.dtype)
+        v_neg, h_neg = v0, h_prob
+        for _ in range(self.k):
+            key, k1 = jax.random.split(key)
+            v_neg = self.prop_down(params, h_sample)
+            h_neg = self.prop_up(params, v_neg)
+            h_sample = jax.random.bernoulli(k1, h_neg).astype(v0.dtype)
+        mb = v0.shape[0]
+        dW = (v0.T @ h_prob - v_neg.T @ h_neg) / mb
+        db = jnp.mean(h_prob - h_neg, axis=0)
+        dvb = jnp.mean(v0 - v_neg, axis=0)
+        new = {
+            "W": params["W"] + lr * dW.astype(params["W"].dtype),
+            "b": params["b"] + lr * db.astype(params["b"].dtype),
+            "vb": params["vb"] + lr * dvb.astype(params["vb"].dtype),
+        }
+        err = jnp.mean(jnp.sum((v0 - v_neg) ** 2, axis=1))
+        return new, err

@@ -249,7 +249,9 @@ class MultiLayerNetwork:
             kwargs = {}
             if layer.recurrent and carries is not None:
                 kwargs["carry"] = carries[i]
-            out = layer.forward(params[i], state[i], x, train=train, rng=keys[i],
+            from .conf.regularizers import maybe_weight_noise
+            p_i = maybe_weight_noise(layer, params[i], train, keys[i])
+            out = layer.forward(p_i, state[i], x, train=train, rng=keys[i],
                                 mask=mask, **kwargs)
             x, mask = out.y, out.mask
             new_state[i] = out.state
@@ -311,6 +313,9 @@ class MultiLayerNetwork:
             updates, os2 = self._updater_for(layer).update(g, os, itf)
             p2 = jax.tree_util.tree_map(
                 lambda pp, uu: (pp.astype(jnp.float32) - uu).astype(pp.dtype), p, updates)
+            if layer.constraints:
+                from .conf.regularizers import apply_constraints
+                p2 = apply_constraints(layer.constraints, p2)
             new_params.append(p2)
             new_opt.append(os2)
         return new_params, new_opt

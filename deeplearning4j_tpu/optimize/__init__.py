@@ -8,3 +8,4 @@ from .listeners import (
     CheckpointListener,
     ComposableListener,
 )
+from .solvers import SolverResult, backtrack_line_search, fit_solver, minimize

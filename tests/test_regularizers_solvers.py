@@ -1,0 +1,224 @@
+"""Dropout variants, weight noise, constraints, RBM, memory reports, and
+the line-search solver family."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.conf.memory import memory_report
+from deeplearning4j_tpu.nn.conf.regularizers import (
+    AlphaDropout, Dropout, DropConnect, GaussianDropout, GaussianNoise,
+    MaxNormConstraint, MinMaxNormConstraint, NonNegativeConstraint,
+    UnitNormConstraint, WeightNoise,
+)
+from deeplearning4j_tpu.nn.layers import RBM, Dense, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import (
+    MultiLayerNetwork, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+from deeplearning4j_tpu.optimize import fit_solver, minimize
+
+
+def blobs(n=256, f=8, classes=2, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = rng.normal(size=(classes, f)) * 4
+    ys = rng.integers(0, classes, size=n)
+    xs = (centers[ys] + rng.normal(size=(n, f))).astype(np.float32)
+    return xs, np.eye(classes, dtype=np.float32)[ys]
+
+
+def build_net(**layer_kw):
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7).updater(Adam(lr=0.01))
+            .layer(Dense(n_out=16, activation="relu", **layer_kw))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8))
+            .build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+class TestDropoutVariants:
+    @pytest.mark.parametrize("d", [
+        Dropout(0.3), AlphaDropout(0.3), GaussianDropout(0.3),
+        GaussianNoise(0.2)], ids=lambda d: type(d).__name__)
+    def test_identity_at_inference_noisy_in_training(self, d):
+        rng = jax.random.PRNGKey(0)
+        x = jnp.ones((64, 32))
+        np.testing.assert_allclose(d.apply(rng, x, train=False), x)
+        y = d.apply(rng, x, train=True)
+        assert not np.allclose(np.asarray(y), np.asarray(x))
+
+    def test_alpha_dropout_preserves_moments(self):
+        """AlphaDropout on SELU-distributed input keeps mean/var ≈ intact
+        (the property it exists for)."""
+        rng = jax.random.PRNGKey(1)
+        x = jax.random.normal(jax.random.PRNGKey(2), (200_000,))
+        y = np.asarray(AlphaDropout(0.2).apply(rng, x, train=True))
+        assert abs(y.mean()) < 0.05
+        assert abs(y.std() - 1.0) < 0.05
+
+    def test_gaussian_dropout_mean_preserving(self):
+        rng = jax.random.PRNGKey(3)
+        x = jnp.full((200_000,), 2.0)
+        y = np.asarray(GaussianDropout(0.4).apply(rng, x, train=True))
+        assert abs(y.mean() - 2.0) < 0.02
+
+    def test_net_trains_with_variant_dropout(self):
+        xs, ys = blobs()
+        net = build_net(dropout=AlphaDropout(0.2))
+        losses = [net.fit_batch(DataSet(xs, ys)) for _ in range(40)]
+        assert losses[-1] < losses[0]
+        # dropout config survives JSON round-trip
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerConfiguration
+        d = net.conf.to_dict()
+        restored = MultiLayerConfiguration.from_dict(d)
+        assert isinstance(restored.layers[0].dropout, AlphaDropout)
+        assert restored.layers[0].dropout.p == 0.2
+
+
+class TestWeightNoise:
+    def test_dropconnect_masks_weights_in_training_only(self):
+        params = {"W": jnp.ones((10, 10)), "b": jnp.ones((10,))}
+        rng = jax.random.PRNGKey(0)
+        out = DropConnect(p=0.5).apply(rng, params, train=True)
+        w = np.asarray(out["W"])
+        assert ((w == 0) | (w == 1)).all() and (w == 0).any()
+        np.testing.assert_allclose(np.asarray(out["b"]), 1.0)  # bias untouched
+        same = DropConnect(p=0.5).apply(rng, params, train=False)
+        np.testing.assert_allclose(np.asarray(same["W"]), 1.0)
+
+    def test_weight_noise_additive(self):
+        params = {"W": jnp.zeros((50, 50))}
+        out = WeightNoise(stddev=0.1).apply(jax.random.PRNGKey(1), params, True)
+        w = np.asarray(out["W"])
+        assert 0.05 < w.std() < 0.2 and abs(w.mean()) < 0.01
+
+    def test_net_trains_with_dropconnect(self):
+        xs, ys = blobs()
+        net = build_net(weight_noise=DropConnect(p=0.9))
+        losses = [net.fit_batch(DataSet(xs, ys)) for _ in range(40)]
+        assert losses[-1] < losses[0]
+        acc = net.evaluate((xs, ys)).accuracy()
+        assert acc > 0.9
+
+
+class TestConstraints:
+    def test_maxnorm_clips_only_above(self):
+        w = jnp.concatenate([jnp.ones((4, 1)) * 3, jnp.ones((4, 1)) * 0.1], axis=1)
+        out = MaxNormConstraint(max_norm=2.0).apply({"W": w})["W"]
+        norms = np.linalg.norm(np.asarray(out), axis=0)
+        np.testing.assert_allclose(norms[0], 2.0, rtol=1e-5)
+        np.testing.assert_allclose(norms[1], 0.2, rtol=1e-5)  # untouched
+
+    def test_unitnorm_and_nonneg(self):
+        w = jax.random.normal(jax.random.PRNGKey(0), (6, 3))
+        out = UnitNormConstraint().apply({"W": w})["W"]
+        np.testing.assert_allclose(np.linalg.norm(np.asarray(out), axis=0),
+                                   1.0, rtol=1e-5)
+        nn = NonNegativeConstraint().apply({"W": w})["W"]
+        assert (np.asarray(nn) >= 0).all()
+
+    def test_minmax_norm(self):
+        w = jnp.ones((4, 1)) * 0.01  # norm 0.02, below min
+        out = MinMaxNormConstraint(min_norm=0.5, max_norm=2.0).apply({"W": w})["W"]
+        np.testing.assert_allclose(np.linalg.norm(np.asarray(out)), 0.5, rtol=1e-4)
+
+    def test_constraint_enforced_during_training(self):
+        xs, ys = blobs()
+        net = build_net(constraints=[MaxNormConstraint(max_norm=1.0)])
+        for _ in range(20):
+            net.fit_batch(DataSet(xs, ys))
+        norms = np.linalg.norm(np.asarray(net.params[0]["W"]), axis=0)
+        assert (norms <= 1.0 + 1e-5).all(), norms.max()
+
+
+class TestRBM:
+    def test_cd_reduces_reconstruction_error(self):
+        rng = np.random.default_rng(0)
+        # bars dataset: each row activates one of 8 disjoint 4-bit bars
+        bars = np.kron(np.eye(8), np.ones((1, 4))).astype(np.float32)
+        data = bars[rng.integers(0, 8, 512)]
+        rbm = RBM(n_in=32, n_out=16, k=1)
+        params = rbm.init_params(jax.random.PRNGKey(0), InputType.feed_forward(32))
+        key = jax.random.PRNGKey(1)
+        errs = []
+        for i in range(60):
+            key, sub = jax.random.split(key)
+            params, err = rbm.contrastive_divergence(params, jnp.asarray(data),
+                                                     sub, lr=0.05)
+            errs.append(float(err))
+        assert errs[-1] < 0.5 * errs[0], (errs[0], errs[-1])
+
+    def test_rbm_stacks_in_mln(self):
+        xs, ys = blobs()
+        xs = (xs - xs.min()) / (xs.max() - xs.min())  # [0,1] visible units
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Adam(lr=0.01))
+                .layer(RBM(n_out=12))
+                .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        losses = [net.fit_batch(DataSet(xs, ys)) for _ in range(120)]
+        assert losses[-1] < 0.5 * losses[0]
+
+
+class TestMemoryReport:
+    def test_report_counts_and_renders(self):
+        net = build_net()
+        rep = memory_report(net, minibatch=64)
+        # Dense 8->16 + OutputLayer 16->2
+        assert rep.layers[0].param_count == 8 * 16 + 16
+        assert rep.layers[1].param_count == 16 * 2 + 2
+        assert rep.total_param_bytes == 4 * (8 * 16 + 16 + 16 * 2 + 2)
+        assert rep.layers[0].updater_state_bytes == 2 * rep.layers[0].param_bytes  # Adam
+        s = str(rep)
+        assert "TOTAL" in s and "Dense" in s
+        assert rep.total_bytes(training=True) > rep.total_bytes(training=False)
+
+
+def quadratic(params):
+    # f(x, y) = (x-3)^2 + 10(y+1)^2 — minimum at (3, -1)
+    return (params["x"] - 3.0) ** 2 + 10.0 * (params["y"] + 1.0) ** 2
+
+
+class TestSolvers:
+    @pytest.mark.parametrize("method", ["lbfgs", "cg", "line_gd"])
+    def test_quadratic_minimum(self, method):
+        res = minimize(quadratic, {"x": jnp.asarray(0.0), "y": jnp.asarray(0.0)},
+                       method=method, max_iterations=200)
+        assert res.loss < 1e-6, (method, res.loss, res.iterations)
+        np.testing.assert_allclose(float(res.params["x"]), 3.0, atol=1e-3)
+        np.testing.assert_allclose(float(res.params["y"]), -1.0, atol=1e-3)
+
+    def test_lbfgs_beats_gd_on_ill_conditioned(self):
+        def rosenbrock(p):
+            x, y = p["x"], p["y"]
+            return (1 - x) ** 2 + 100 * (y - x * x) ** 2
+
+        x0 = {"x": jnp.asarray(-1.2), "y": jnp.asarray(1.0)}
+        lb = minimize(rosenbrock, x0, method="lbfgs", max_iterations=150)
+        gd = minimize(rosenbrock, x0, method="line_gd", max_iterations=150)
+        assert lb.loss < gd.loss * 0.1 or lb.loss < 1e-8
+
+    def test_fit_solver_trains_network(self):
+        xs, ys = blobs(128)
+        net = build_net()
+        ds = DataSet(xs, ys)
+        before = net.score(ds)
+        res = fit_solver(net, ds, method="lbfgs", max_iterations=50)
+        after = net.score(ds)
+        assert after < 0.3 * before, (before, after)
+        assert res.losses[0] > res.losses[-1]
+
+    def test_unknown_method_rejected(self):
+        with pytest.raises(ValueError, match="lbfgs"):
+            minimize(quadratic, {"x": jnp.asarray(0.0), "y": jnp.asarray(0.0)},
+                     method="newton")
