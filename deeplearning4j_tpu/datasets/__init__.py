@@ -7,3 +7,11 @@ from .iterators import (
     EarlyTerminationDataSetIterator,
     SamplingDataSetIterator,
 )
+from .records import (
+    CSVRecordReader,
+    CSVSequenceRecordReader,
+    ImageRecordReader,
+    ImageRecordReaderDataSetIterator,
+    RecordReaderDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)

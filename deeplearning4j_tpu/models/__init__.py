@@ -16,6 +16,12 @@ from .darknet19 import Darknet19
 from .tinyyolo import TinyYOLO
 from .textgen_lstm import TextGenerationLSTM
 from .transformer import TransformerLM, TransformerBlock, PositionalEmbedding
+from .googlenet import GoogLeNet
+from .inception_resnet_v1 import InceptionResNetV1
+from .facenet_nn4 import FaceNetNN4Small2
+from .pretrained import (
+    PretrainedType, cached_path, checksum, init_pretrained, install_weights,
+)
 
 ZOO = {
     "lenet": LeNet,
@@ -28,4 +34,7 @@ ZOO = {
     "tinyyolo": TinyYOLO,
     "textgenerationlstm": TextGenerationLSTM,
     "transformerlm": TransformerLM,
+    "googlenet": GoogLeNet,
+    "inceptionresnetv1": InceptionResNetV1,
+    "facenetnn4small2": FaceNetNN4Small2,
 }

@@ -1,0 +1,81 @@
+"""FaceNet NN4-small2 (Schroff et al. 2015, OpenFace variant).
+
+Reference: zoo/model/FaceNetNN4Small2.java (:78-220: conv stem, inception
+3a/3b/3c/4a/4e/5a/5b modules via FaceNetHelper.inception — branches with
+3x3 and 5x5 reductions, L2 (p-norm) pooling projections — then avgpool →
+dense 128 embedding → L2 normalize → center-loss softmax)."""
+
+from ..nn.conf.inputs import InputType
+from ..nn.graph import (
+    ComputationGraph, GraphBuilder, L2NormalizeVertex, MergeVertex,
+)
+from ..nn.layers import (
+    BatchNormalization, CenterLossOutputLayer, Convolution2D, Dense,
+    GlobalPooling, LocalResponseNormalization, Subsampling2D,
+)
+from ..nn.updaters import Adam
+
+
+def _conv(b, name, inp, n_out, kernel, stride=(1, 1), act="relu"):
+    b.add_layer(name, Convolution2D(n_out=n_out, kernel=kernel, stride=stride,
+                convolution_mode="same", activation=act), inp)
+    return name
+
+
+def _inception(b, name, inp, r3, n3, s3, r5, n5, pool_kind, pp):
+    """FaceNetHelper.inception: 1x1→3x3 (+stride s3), optional 1x1→5x5,
+    pooled projection branch (max or L2/pnorm pooling).  pp=0 → bare pool
+    branch without projection is skipped for channel consistency and the
+    3x3/5x5 branches carry the stride."""
+    outs = []
+    x = _conv(b, f"{name}_3x3r", inp, r3, (1, 1))
+    outs.append(_conv(b, f"{name}_3x3", x, n3, (3, 3), (s3, s3)))
+    if n5 > 0:
+        x = _conv(b, f"{name}_5x5r", inp, r5, (1, 1))
+        outs.append(_conv(b, f"{name}_5x5", x, n5, (5, 5), (s3, s3)))
+    if pp > 0:
+        b.add_layer(f"{name}_pool", Subsampling2D(
+            pooling=pool_kind, pnorm=2, kernel=(3, 3), stride=(s3, s3),
+            convolution_mode="same"), inp)
+        outs.append(_conv(b, f"{name}_poolp", f"{name}_pool", pp, (1, 1)))
+    b.add_vertex(name, MergeVertex(), *outs)
+    return name
+
+
+def FaceNetNN4Small2(height: int = 96, width: int = 96, channels: int = 3,
+                     num_classes: int = 1000, embedding_size: int = 128,
+                     updater=None) -> ComputationGraph:
+    b = (GraphBuilder()
+         .seed(12345)
+         .updater(updater if updater is not None else Adam(lr=1e-3))
+         .add_inputs("in")
+         .set_input_types(**{"in": InputType.convolutional(height, width, channels)}))
+    # stem (FaceNetNN4Small2.java:78-110)
+    x = _conv(b, "conv1", "in", 64, (7, 7), (2, 2))
+    b.add_layer("bn1", BatchNormalization(activation="relu"), x)
+    b.add_layer("pool1", Subsampling2D(pooling="max", kernel=(3, 3), stride=(2, 2),
+                convolution_mode="same"), "bn1")
+    b.add_layer("lrn1", LocalResponseNormalization(), "pool1")
+    x = _conv(b, "conv2", "lrn1", 64, (1, 1))
+    x = _conv(b, "conv3", x, 192, (3, 3))
+    b.add_layer("bn3", BatchNormalization(activation="relu"), x)
+    b.add_layer("lrn2", LocalResponseNormalization(), "bn3")
+    b.add_layer("pool2", Subsampling2D(pooling="max", kernel=(3, 3), stride=(2, 2),
+                convolution_mode="same"), "lrn2")
+    # inception stack (:111-200); (r3, n3, stride, r5, n5, pool, proj)
+    x = _inception(b, "3a", "pool2", 96, 128, 1, 16, 32, "max", 32)
+    x = _inception(b, "3b", x, 96, 128, 1, 32, 64, "pnorm", 64)
+    x = _inception(b, "3c", x, 128, 256, 2, 32, 64, "max", 0)
+    x = _inception(b, "4a", x, 96, 192, 1, 32, 64, "pnorm", 128)
+    x = _inception(b, "4e", x, 160, 256, 2, 64, 128, "max", 0)
+    x = _inception(b, "5a", x, 96, 384, 1, 0, 0, "pnorm", 96)
+    x = _inception(b, "5b", x, 96, 384, 1, 0, 0, "max", 96)
+    # embedding head (:200-220)
+    b.add_layer("gap", GlobalPooling(pooling="avg"), x)
+    b.add_layer("bottleneck", Dense(n_out=embedding_size, activation="identity"),
+                "gap")
+    b.add_vertex("embeddings", L2NormalizeVertex(), "bottleneck")
+    b.add_layer("out", CenterLossOutputLayer(n_out=num_classes,
+                                             activation="softmax"), "embeddings")
+    b.set_outputs("out")
+    return ComputationGraph(b.build())

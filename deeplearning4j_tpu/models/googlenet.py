@@ -1,0 +1,79 @@
+"""GoogLeNet (Inception v1, Szegedy et al. 2014).
+
+Reference: zoo/model/GoogLeNet.java (inception module :71-97 — four
+branches 1x1 / 1x1→3x3 / 1x1→5x5 / maxpool→1x1 merged on the channel
+axis; full graph :100-160).  Aux classifier heads are omitted as in the
+reference's zoo build.
+"""
+
+from ..nn.conf.inputs import InputType
+from ..nn.graph import ComputationGraph, GraphBuilder, MergeVertex
+from ..nn.layers import (
+    Convolution2D, DropoutLayer, GlobalPooling, LocalResponseNormalization,
+    OutputLayer, Subsampling2D,
+)
+from ..nn.updaters import Adam
+
+
+def _inception(b: GraphBuilder, name: str, inp: str,
+               n1: int, r3: int, n3: int, r5: int, n5: int, pp: int) -> str:
+    """Inception module (GoogLeNet.java:71-97): branch filter counts follow
+    the paper's table-1 naming (#1x1, #3x3reduce, #3x3, #5x5reduce, #5x5,
+    pool-proj)."""
+    b.add_layer(f"{name}_1x1", Convolution2D(n_out=n1, kernel=(1, 1),
+                convolution_mode="same", activation="relu"), inp)
+    b.add_layer(f"{name}_3x3r", Convolution2D(n_out=r3, kernel=(1, 1),
+                convolution_mode="same", activation="relu"), inp)
+    b.add_layer(f"{name}_3x3", Convolution2D(n_out=n3, kernel=(3, 3),
+                convolution_mode="same", activation="relu"), f"{name}_3x3r")
+    b.add_layer(f"{name}_5x5r", Convolution2D(n_out=r5, kernel=(1, 1),
+                convolution_mode="same", activation="relu"), inp)
+    b.add_layer(f"{name}_5x5", Convolution2D(n_out=n5, kernel=(5, 5),
+                convolution_mode="same", activation="relu"), f"{name}_5x5r")
+    b.add_layer(f"{name}_pool", Subsampling2D(pooling="max", kernel=(3, 3),
+                stride=(1, 1), convolution_mode="same"), inp)
+    b.add_layer(f"{name}_poolp", Convolution2D(n_out=pp, kernel=(1, 1),
+                convolution_mode="same", activation="relu"), f"{name}_pool")
+    b.add_vertex(name, MergeVertex(),
+                 f"{name}_1x1", f"{name}_3x3", f"{name}_5x5", f"{name}_poolp")
+    return name
+
+
+def GoogLeNet(height: int = 224, width: int = 224, channels: int = 3,
+              num_classes: int = 1000, updater=None) -> ComputationGraph:
+    b = (GraphBuilder()
+         .seed(12345)
+         .updater(updater if updater is not None else Adam(lr=1e-3))
+         .add_inputs("in")
+         .set_input_types(**{"in": InputType.convolutional(height, width, channels)}))
+    b.add_layer("conv1", Convolution2D(n_out=64, kernel=(7, 7), stride=(2, 2),
+                convolution_mode="same", activation="relu"), "in")
+    b.add_layer("pool1", Subsampling2D(pooling="max", kernel=(3, 3), stride=(2, 2),
+                convolution_mode="same"), "conv1")
+    b.add_layer("lrn1", LocalResponseNormalization(), "pool1")
+    b.add_layer("conv2r", Convolution2D(n_out=64, kernel=(1, 1),
+                convolution_mode="same", activation="relu"), "lrn1")
+    b.add_layer("conv2", Convolution2D(n_out=192, kernel=(3, 3),
+                convolution_mode="same", activation="relu"), "conv2r")
+    b.add_layer("lrn2", LocalResponseNormalization(), "conv2")
+    b.add_layer("pool2", Subsampling2D(pooling="max", kernel=(3, 3), stride=(2, 2),
+                convolution_mode="same"), "lrn2")
+    x = _inception(b, "3a", "pool2", 64, 96, 128, 16, 32, 32)
+    x = _inception(b, "3b", x, 128, 128, 192, 32, 96, 64)
+    b.add_layer("pool3", Subsampling2D(pooling="max", kernel=(3, 3), stride=(2, 2),
+                convolution_mode="same"), x)
+    x = _inception(b, "4a", "pool3", 192, 96, 208, 16, 48, 64)
+    x = _inception(b, "4b", x, 160, 112, 224, 24, 64, 64)
+    x = _inception(b, "4c", x, 128, 128, 256, 24, 64, 64)
+    x = _inception(b, "4d", x, 112, 144, 288, 32, 64, 64)
+    x = _inception(b, "4e", x, 256, 160, 320, 32, 128, 128)
+    b.add_layer("pool4", Subsampling2D(pooling="max", kernel=(3, 3), stride=(2, 2),
+                convolution_mode="same"), x)
+    x = _inception(b, "5a", "pool4", 256, 160, 320, 32, 128, 128)
+    x = _inception(b, "5b", x, 384, 192, 384, 48, 128, 128)
+    b.add_layer("gap", GlobalPooling(pooling="avg"), x)
+    b.add_layer("drop", DropoutLayer(dropout=0.4), "gap")
+    b.add_layer("out", OutputLayer(n_out=num_classes, activation="softmax",
+                                   loss="mcxent"), "drop")
+    b.set_outputs("out")
+    return ComputationGraph(b.build())

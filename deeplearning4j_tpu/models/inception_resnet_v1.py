@@ -1,0 +1,117 @@
+"""Inception-ResNet-v1 (Szegedy et al. 2016) — the FaceNet backbone.
+
+Reference: zoo/model/InceptionResNetV1.java (stem :88-120,
+inception-resnet A/B/C blocks + reductions via FaceNetHelper, embedding
+head :121-139: avgpool → dropout → dense 128 → L2 normalize → center-loss
+softmax).  Residual branches are scaled before the add (the paper's
+stabilization trick) via ScaleVertex.
+"""
+
+from ..nn.conf.inputs import InputType
+from ..nn.graph import (
+    ComputationGraph, ElementWiseVertex, GraphBuilder, L2NormalizeVertex,
+    MergeVertex, ScaleVertex,
+)
+from ..nn.layers import (
+    ActivationLayer, BatchNormalization, CenterLossOutputLayer, Convolution2D,
+    Dense, DropoutLayer, GlobalPooling, Subsampling2D,
+)
+from ..nn.updaters import Adam
+
+
+def _conv(b, name, inp, n_out, kernel, stride=(1, 1), mode="same", act="relu"):
+    b.add_layer(name, Convolution2D(n_out=n_out, kernel=kernel, stride=stride,
+                convolution_mode=mode, activation=act), inp)
+    return name
+
+
+def _res_block(b, name, inp, branches, n_channels, scale=0.17):
+    """Inception-resnet block: parallel conv branches → 1x1 linear conv →
+    scaled residual add → relu (InceptionResNetV1.java block builders)."""
+    outs = []
+    for bi, branch in enumerate(branches):
+        x = inp
+        for li, (n, k) in enumerate(branch):
+            x = _conv(b, f"{name}_b{bi}_{li}", x, n, k)
+        outs.append(x)
+    if len(outs) > 1:
+        b.add_vertex(f"{name}_cat", MergeVertex(), *outs)
+        cat = f"{name}_cat"
+    else:
+        cat = outs[0]
+    up = _conv(b, f"{name}_up", cat, n_channels, (1, 1), act="identity")
+    b.add_vertex(f"{name}_scale", ScaleVertex(factor=scale), up)
+    b.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), inp, f"{name}_scale")
+    b.add_layer(f"{name}", ActivationLayer(activation="relu"), f"{name}_add")
+    return name
+
+
+def InceptionResNetV1(height: int = 160, width: int = 160, channels: int = 3,
+                      num_classes: int = 1000, embedding_size: int = 128,
+                      a_blocks: int = 5, b_blocks: int = 10, c_blocks: int = 5,
+                      updater=None) -> ComputationGraph:
+    """Block counts default to the paper/reference (5x A, 10x B, 5x C)."""
+    b = (GraphBuilder()
+         .seed(12345)
+         .updater(updater if updater is not None else Adam(lr=1e-3))
+         .add_inputs("in")
+         .set_input_types(**{"in": InputType.convolutional(height, width, channels)}))
+    # stem (InceptionResNetV1.java:88-120)
+    x = _conv(b, "stem1", "in", 32, (3, 3), (2, 2), mode="truncate")
+    x = _conv(b, "stem2", x, 32, (3, 3), mode="truncate")
+    x = _conv(b, "stem3", x, 64, (3, 3))
+    b.add_layer("stem_pool", Subsampling2D(pooling="max", kernel=(3, 3),
+                stride=(2, 2), convolution_mode="same"), x)
+    x = _conv(b, "stem4", "stem_pool", 80, (1, 1))
+    x = _conv(b, "stem5", x, 192, (3, 3), mode="truncate")
+    x = _conv(b, "stem6", x, 256, (3, 3), (2, 2), mode="same")
+    # inception-resnet-A (block35): branches 1x1 / 1x1-3x3 / 1x1-3x3-3x3
+    for i in range(a_blocks):
+        x = _res_block(b, f"a{i}", x,
+                       [[(32, (1, 1))],
+                        [(32, (1, 1)), (32, (3, 3))],
+                        [(32, (1, 1)), (32, (3, 3)), (32, (3, 3))]],
+                       n_channels=256, scale=0.17)
+    # reduction-A: 3x3/2 conv + 1x1-3x3-3x3/2 + maxpool
+    _conv(b, "redA_b0", x, 384, (3, 3), (2, 2), mode="same")
+    _conv(b, "redA_b1a", x, 192, (1, 1))
+    _conv(b, "redA_b1b", "redA_b1a", 192, (3, 3))
+    _conv(b, "redA_b1c", "redA_b1b", 256, (3, 3), (2, 2), mode="same")
+    b.add_layer("redA_pool", Subsampling2D(pooling="max", kernel=(3, 3),
+                stride=(2, 2), convolution_mode="same"), x)
+    b.add_vertex("redA", MergeVertex(), "redA_b0", "redA_b1c", "redA_pool")
+    x = "redA"
+    # inception-resnet-B (block17): 1x1 / 1x1-1x7-7x1
+    for i in range(b_blocks):
+        x = _res_block(b, f"b{i}", x,
+                       [[(128, (1, 1))],
+                        [(128, (1, 1)), (128, (1, 7)), (128, (7, 1))]],
+                       n_channels=896, scale=0.10)
+    # reduction-B
+    _conv(b, "redB_b0a", x, 256, (1, 1))
+    _conv(b, "redB_b0b", "redB_b0a", 384, (3, 3), (2, 2), mode="same")
+    _conv(b, "redB_b1a", x, 256, (1, 1))
+    _conv(b, "redB_b1b", "redB_b1a", 256, (3, 3), (2, 2), mode="same")
+    _conv(b, "redB_b2a", x, 256, (1, 1))
+    _conv(b, "redB_b2b", "redB_b2a", 256, (3, 3))
+    _conv(b, "redB_b2c", "redB_b2b", 256, (3, 3), (2, 2), mode="same")
+    b.add_layer("redB_pool", Subsampling2D(pooling="max", kernel=(3, 3),
+                stride=(2, 2), convolution_mode="same"), x)
+    b.add_vertex("redB", MergeVertex(), "redB_b0b", "redB_b1b", "redB_b2c", "redB_pool")
+    x = "redB"
+    # inception-resnet-C (block8): 1x1 / 1x1-1x3-3x1
+    for i in range(c_blocks):
+        x = _res_block(b, f"c{i}", x,
+                       [[(192, (1, 1))],
+                        [(192, (1, 1)), (192, (1, 3)), (192, (3, 1))]],
+                       n_channels=1792, scale=0.20)
+    # embedding head (:121-139)
+    b.add_layer("gap", GlobalPooling(pooling="avg"), x)
+    b.add_layer("drop", DropoutLayer(dropout=0.2), "gap")
+    b.add_layer("bottleneck", Dense(n_out=embedding_size, activation="identity"),
+                "drop")
+    b.add_vertex("embeddings", L2NormalizeVertex(), "bottleneck")
+    b.add_layer("out", CenterLossOutputLayer(n_out=num_classes,
+                                             activation="softmax"), "embeddings")
+    b.set_outputs("out")
+    return ComputationGraph(b.build())

@@ -1,0 +1,88 @@
+"""Pretrained-weight loading with checksum-verified local cache.
+
+Parity target: reference zoo/ZooModel.java:40-81 (initPretrained:
+pretrainedUrl → download to ~/.deeplearning4j/models/<name> → checksum
+via Adler32 → restore).  This environment is zero-egress, so the transport
+is a local file (or a pre-populated cache directory), but the mechanism —
+cache layout, checksum verification, corrupt-file eviction, restore into
+the matching architecture — is the same.  Checkpoints are the framework's
+zip format (utils/serializer.py), the analog of the reference's saved
+.zip models.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import zlib
+from typing import Optional
+
+DEFAULT_CACHE = os.path.expanduser("~/.deeplearning4j_tpu/models")
+
+
+def checksum(path: str) -> int:
+    """Adler-32 over the file (matches ZooModel's checksum choice)."""
+    value = 1
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            value = zlib.adler32(chunk, value)
+    return value & 0xFFFFFFFF
+
+
+class PretrainedType:
+    """Reference PretrainedType enum (dataset the weights were fit on)."""
+
+    IMAGENET = "imagenet"
+    MNIST = "mnist"
+    CIFAR10 = "cifar10"
+    VGGFACE = "vggface"
+
+
+def cached_path(model_name: str, pretrained_type: str = PretrainedType.IMAGENET,
+                cache_dir: Optional[str] = None) -> str:
+    cache = cache_dir or DEFAULT_CACHE
+    return os.path.join(cache, model_name, f"{model_name}_{pretrained_type}.zip")
+
+
+def install_weights(model_name: str, source_path: str,
+                    pretrained_type: str = PretrainedType.IMAGENET,
+                    cache_dir: Optional[str] = None) -> str:
+    """Copy a weights zip into the cache (the zero-egress stand-in for the
+    reference's download step).  Returns the cached path."""
+    dst = cached_path(model_name, pretrained_type, cache_dir)
+    os.makedirs(os.path.dirname(dst), exist_ok=True)
+    shutil.copyfile(source_path, dst)
+    return dst
+
+
+def init_pretrained(model_name: str,
+                    pretrained_type: str = PretrainedType.IMAGENET,
+                    expected_checksum: Optional[int] = None,
+                    cache_dir: Optional[str] = None,
+                    local_file: Optional[str] = None):
+    """Load a pretrained model (reference ZooModel.initPretrained:40-81).
+
+    Resolution order: explicit ``local_file``, then the cache.  When
+    ``expected_checksum`` is given and the cached file mismatches, it is
+    evicted and a clear error raised (the reference's corrupt-download
+    retry, minus the download)."""
+    from ..utils.serializer import load_model
+
+    path = local_file or cached_path(model_name, pretrained_type, cache_dir)
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f"no pretrained weights for '{model_name}' ({pretrained_type}) at "
+            f"{path} — place the checkpoint zip there or pass local_file=/"
+            "install_weights(). (This build is zero-egress: no download URLs.)")
+    if expected_checksum is not None:
+        got = checksum(path)
+        if got != expected_checksum:
+            if local_file is None:
+                os.remove(path)  # evict corrupt cache entry, like the reference
+            raise IOError(
+                f"checksum mismatch for {path}: expected {expected_checksum}, "
+                f"got {got}" + ("" if local_file else " (cached copy evicted)"))
+    return load_model(path)

@@ -30,6 +30,7 @@ import numpy as np
 from ..datasets.dataset import DataSet, MultiDataSet
 from ..datasets.iterators import DataSetIterator, ListDataSetIterator
 from .conf.inputs import InputType
+from .conf.regularizers import apply_constraints, maybe_weight_noise
 from .layers.base import Layer, config_from_dict, config_to_dict, register_config
 from .updaters import Adam, GradientNormalization, Updater, normalize_gradients
 
@@ -561,7 +562,6 @@ class ComputationGraph:
                 kwargs = {}
                 if layer.recurrent and carries is not None:
                     kwargs["carry"] = carries.get(name)
-                from .conf.regularizers import maybe_weight_noise
                 p_v = maybe_weight_noise(layer, params[name], train, key)
                 out = layer.forward(
                     p_v, state[name], xin[0], train=train, rng=key,
@@ -597,8 +597,9 @@ class ComputationGraph:
             if not hasattr(layer, "score"):
                 raise ValueError(f"output vertex '{out_name}' has no score()")
             h = acts[spec.inputs[0]]
-            if train and layer.dropout > 0.0 and rng is not None:
-                # output layers honor input dropout (parity w/ multilayer._loss)
+            if train and rng is not None:
+                # output layers honor input dropout (parity w/ multilayer._loss);
+                # _maybe_dropout no-ops when the layer has no dropout configured
                 h = layer._maybe_dropout(h, train, jax.random.fold_in(rng, 10_000 + oi))
             lm = (label_masks or {}).get(out_name)
             total = total + layer.score(params[out_name], state[out_name], h,
@@ -637,7 +638,6 @@ class ComputationGraph:
                 lambda pp, uu: (pp.astype(jnp.float32) - uu).astype(pp.dtype),
                 params[name], updates)
             if spec.vertex.layer.constraints:
-                from .conf.regularizers import apply_constraints
                 new_params[name] = apply_constraints(
                     spec.vertex.layer.constraints, new_params[name])
             new_opt[name] = os2

@@ -27,6 +27,7 @@ from ..datasets.dataset import DataSet
 from ..datasets.iterators import DataSetIterator, ListDataSetIterator
 from .conf.inputs import InputType
 from .conf.preprocessors import Preprocessor
+from .conf.regularizers import apply_constraints, maybe_weight_noise
 from .layers.base import Layer, config_from_dict, config_to_dict
 from .updaters import Adam, GradientNormalization, Updater, normalize_gradients
 
@@ -249,7 +250,6 @@ class MultiLayerNetwork:
             kwargs = {}
             if layer.recurrent and carries is not None:
                 kwargs["carry"] = carries[i]
-            from .conf.regularizers import maybe_weight_noise
             p_i = maybe_weight_noise(layer, params[i], train, keys[i])
             out = layer.forward(p_i, state[i], x, train=train, rng=keys[i],
                                 mask=mask, **kwargs)
@@ -268,8 +268,9 @@ class MultiLayerNetwork:
         last = self.conf.layers[n - 1]
         if (n - 1) in self.conf.preprocessors:
             h = self.conf.preprocessors[n - 1].apply(h)
-        if train and last.dropout > 0.0 and rng is not None:
-            # output layers honor input dropout too (reference BaseOutputLayer)
+        if train and rng is not None:
+            # output layers honor input dropout too (reference BaseOutputLayer);
+            # _maybe_dropout no-ops when the layer has no dropout configured
             h = last._maybe_dropout(h, train, jax.random.fold_in(rng, n - 1))
         lm = label_mask if label_mask is not None else (mask_out if labels is not None and getattr(labels, "ndim", 0) == 3 else None)
         if not hasattr(last, "score"):
@@ -314,7 +315,6 @@ class MultiLayerNetwork:
             p2 = jax.tree_util.tree_map(
                 lambda pp, uu: (pp.astype(jnp.float32) - uu).astype(pp.dtype), p, updates)
             if layer.constraints:
-                from .conf.regularizers import apply_constraints
                 p2 = apply_constraints(layer.constraints, p2)
             new_params.append(p2)
             new_opt.append(os2)
