@@ -24,20 +24,23 @@ def _conv(b, name, inp, n_out, kernel, stride=(1, 1), act="relu"):
 
 def _inception(b, name, inp, r3, n3, s3, r5, n5, pool_kind, pp):
     """FaceNetHelper.inception: 1x1→3x3 (+stride s3), optional 1x1→5x5,
-    pooled projection branch (max or L2/pnorm pooling).  pp=0 → bare pool
-    branch without projection is skipped for channel consistency and the
-    3x3/5x5 branches carry the stride."""
+    plus a pool branch — projected through 1x1 when pp>0, merged BARE when
+    pp=0 (reference FaceNetNN4Small2.java:151-184 merges the unprojected
+    max-pool into 3c/4e, so those modules' channel counts include the
+    incoming channels)."""
     outs = []
     x = _conv(b, f"{name}_3x3r", inp, r3, (1, 1))
     outs.append(_conv(b, f"{name}_3x3", x, n3, (3, 3), (s3, s3)))
     if n5 > 0:
         x = _conv(b, f"{name}_5x5r", inp, r5, (1, 1))
         outs.append(_conv(b, f"{name}_5x5", x, n5, (5, 5), (s3, s3)))
+    b.add_layer(f"{name}_pool", Subsampling2D(
+        pooling=pool_kind, pnorm=2, kernel=(3, 3), stride=(s3, s3),
+        convolution_mode="same"), inp)
     if pp > 0:
-        b.add_layer(f"{name}_pool", Subsampling2D(
-            pooling=pool_kind, pnorm=2, kernel=(3, 3), stride=(s3, s3),
-            convolution_mode="same"), inp)
         outs.append(_conv(b, f"{name}_poolp", f"{name}_pool", pp, (1, 1)))
+    else:
+        outs.append(f"{name}_pool")
     b.add_vertex(name, MergeVertex(), *outs)
     return name
 

@@ -1,0 +1,73 @@
+"""Multi-host initialization — the jax.distributed launcher story.
+
+Parity target: the reference's cluster entry points (dl4j-spark
+SharedTrainingMaster / ParameterAveragingTrainingMaster setup,
+VoidConfiguration ports/controller address).  On TPU pods the equivalent
+ceremony is tiny: every host runs the SAME program, calls
+``initialize()`` (auto-detecting the coordinator on Cloud TPU, explicit
+coordinator address elsewhere), and then ``build_mesh`` sees the GLOBAL
+device set — the existing ShardedTrainer/pipeline/ring code is multi-host
+already because GSPMD collectives span hosts transparently (ICI within a
+slice, DCN across slices).
+
+There is no Spark-style driver: data loading is per-host (each host feeds
+its local shard of the global batch via ``process_index``), which is the
+reference's SharedTraining data-locality model without the Aeron plumbing.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+def initialize(coordinator_address: Optional[str] = None,
+               num_processes: Optional[int] = None,
+               process_id: Optional[int] = None) -> None:
+    """Join (or form) the multi-host runtime.
+
+    On Cloud TPU pods all arguments auto-detect (metadata server); on other
+    clusters pass ``coordinator_address='host:port'``, ``num_processes``
+    and this host's ``process_id`` — the direct analog of the reference's
+    VoidConfiguration controller address + shard index."""
+    if num_processes is not None and process_id is not None:
+        if not (0 <= process_id < num_processes):
+            raise ValueError(f"process_id {process_id} out of range "
+                             f"[0, {num_processes})")
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    logger.info("distributed initialized: process %d/%d, %d local / %d "
+                "global devices", jax.process_index(), jax.process_count(),
+                jax.local_device_count(), jax.device_count())
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def local_batch_slice(global_batch: int) -> slice:
+    """This host's slice of a globally-indexed batch: hosts feed disjoint
+    shards of the global batch (per-host data loading, reference
+    SharedTraining locality model)."""
+    n = jax.process_count()
+    if global_batch % n:
+        raise ValueError(f"global batch {global_batch} not divisible by "
+                         f"{n} processes")
+    per = global_batch // n
+    i = jax.process_index()
+    return slice(i * per, (i + 1) * per)
+
+
+def is_coordinator() -> bool:
+    """True on process 0 — gate checkpoint writes / logging / UI servers
+    the way the reference gates them on the Spark driver."""
+    return jax.process_index() == 0

@@ -1,0 +1,184 @@
+"""Elastic / fault-tolerant training: checkpoint-resume + failure recovery.
+
+Parity target: SURVEY §5 "Failure detection / elasticity" — the reference
+covers this operationally via Spark task retry + TrainingMaster state
+(dl4j-spark SharedTrainingMaster) and CheckpointListener.  The TPU-native
+equivalent is checkpoint/restore elasticity: pods fail as units, so the
+recovery loop is (1) detect a failed step, (2) re-provision a mesh over
+the devices that are still healthy, (3) restore the last checkpoint,
+(4) continue.  Orbax-style periodic checkpointing rides the existing zip
+serializer (utils/serializer.py) so restored models are plain framework
+checkpoints.
+
+``ElasticTrainer`` wraps any trainer-like object exposing
+``fit_batch(ds) -> float`` plus a wrapped ``net``; failures are surfaced
+to a pluggable ``FailureDetector`` so tests (and health monitors) can
+inject/observe them.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, Callable, List, Optional
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class CheckpointManager:
+    """Rolling checkpoint store (reference CheckpointListener semantics:
+    keep-last-N, save-every-N-iterations; zip format from utils/serializer)."""
+
+    def __init__(self, directory: str, keep_last: int = 3):
+        self.directory = directory
+        self.keep_last = keep_last
+        os.makedirs(directory, exist_ok=True)
+
+    def _path(self, step: int) -> str:
+        return os.path.join(self.directory, f"checkpoint_{step:010d}.zip")
+
+    def save(self, net, step: int) -> str:
+        path = self._path(step)
+        net.save(path)
+        self._prune()
+        return path
+
+    def _prune(self) -> None:
+        ckpts = self.list_checkpoints()
+        for path, _ in ckpts[:-self.keep_last]:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    def list_checkpoints(self) -> List:
+        out = []
+        for fn in sorted(os.listdir(self.directory)):
+            if fn.startswith("checkpoint_") and fn.endswith(".zip"):
+                step = int(fn[len("checkpoint_"):-len(".zip")])
+                out.append((os.path.join(self.directory, fn), step))
+        return out
+
+    def latest(self) -> Optional[Any]:
+        ckpts = self.list_checkpoints()
+        return ckpts[-1] if ckpts else None
+
+    def restore_latest(self, loader: Callable[[str], Any]):
+        """→ (model, step) from the newest checkpoint, or (None, -1)."""
+        latest = self.latest()
+        if latest is None:
+            return None, -1
+        path, step = latest
+        return loader(path), step
+
+
+class FailureDetector:
+    """Decides whether an exception is a recoverable infrastructure failure
+    (device loss, RPC deadline) vs a programming error that must propagate.
+    Subclass / replace for custom health signals."""
+
+    #: specific infrastructure signatures only — broad words like "device"
+    #: or "internal" would misclassify deterministic bugs as recoverable
+    #: and burn the restart budget re-hitting them
+    RECOVERABLE_MARKERS = ("DEADLINE_EXCEEDED", "UNAVAILABLE", "DATA_LOSS",
+                           "ABORTED", "device halted", "device lost",
+                           "connection reset", "socket closed")
+
+    def is_recoverable(self, exc: Exception) -> bool:
+        if isinstance(exc, (ValueError, TypeError, KeyError)):
+            return False   # programming errors propagate
+        text = f"{type(exc).__name__}: {exc}"
+        return any(m.lower() in text.lower() for m in self.RECOVERABLE_MARKERS)
+
+    def on_failure(self, exc: Exception, attempt: int) -> None:
+        logger.warning("step failure (attempt %d): %s", attempt, exc)
+
+
+class ElasticTrainer:
+    """Checkpoint-resume training loop with failure recovery.
+
+    >>> et = ElasticTrainer(trainer, ckpt_dir, checkpoint_every=100)
+    >>> et.fit(iterator, epochs=3)
+
+    On a recoverable failure: rebuild (via ``rebuild_fn``, e.g. re-creating
+    the mesh over surviving devices), restore the newest checkpoint, and
+    continue from there.  ``max_restarts`` bounds the retry budget.
+    """
+
+    def __init__(self, trainer, checkpoint_dir: str,
+                 checkpoint_every: int = 100,
+                 keep_last: int = 3,
+                 max_restarts: int = 3,
+                 failure_detector: Optional[FailureDetector] = None,
+                 rebuild_fn: Optional[Callable[[], Any]] = None,
+                 loader: Optional[Callable[[str], Any]] = None):
+        self.trainer = trainer
+        self.ckpt = CheckpointManager(checkpoint_dir, keep_last)
+        self.checkpoint_every = max(1, checkpoint_every)
+        self.max_restarts = max_restarts
+        self.detector = failure_detector or FailureDetector()
+        self.rebuild_fn = rebuild_fn
+        self.loader = loader or self._default_loader
+        self.restarts = 0
+        self.global_step = 0
+
+    @staticmethod
+    def _default_loader(path: str):
+        from ..utils.serializer import load_model
+        return load_model(path)
+
+    @property
+    def net(self):
+        return getattr(self.trainer, "net", self.trainer)
+
+    def _restore(self) -> None:
+        model, step = self.ckpt.restore_latest(self.loader)
+        if model is None:
+            logger.warning("no checkpoint to restore — restarting from "
+                           "current params")
+            return
+        net = self.net
+        net.params = model.params
+        net.state = model.state
+        net.opt_state = model.opt_state
+        net.iteration = model.iteration
+        self.global_step = step
+        logger.info("restored checkpoint @ step %d", step)
+
+    def fit_batch(self, ds) -> float:
+        """One step with checkpoint + recovery semantics."""
+        while True:
+            try:
+                loss = self.trainer.fit_batch(ds)
+                self.global_step += 1
+                if self.global_step % self.checkpoint_every == 0:
+                    self.ckpt.save(self.net, self.global_step)
+                return loss
+            except Exception as exc:
+                if not self.detector.is_recoverable(exc):
+                    raise
+                self.restarts += 1
+                self.detector.on_failure(exc, self.restarts)
+                if self.restarts > self.max_restarts:
+                    raise RuntimeError(
+                        f"exceeded max_restarts={self.max_restarts}") from exc
+                if self.rebuild_fn is not None:
+                    self.trainer = self.rebuild_fn()
+                self._restore()
+                # restored params are host arrays — a sharded trainer must
+                # re-place them on its (possibly rebuilt) mesh before the
+                # next step, or the jit step sees uncommitted inputs
+                if hasattr(self.trainer, "_place_model"):
+                    self.trainer._place_model()
+
+    def fit(self, data, epochs: int = 1) -> List[float]:
+        losses: List[float] = []
+        net = self.net
+        it = net._as_iterator(data) if hasattr(net, "_as_iterator") else data
+        for _ in range(epochs):
+            for ds in it:
+                losses.append(self.fit_batch(ds))
+        # final checkpoint so a clean shutdown is always resumable
+        self.ckpt.save(self.net, self.global_step)
+        return losses

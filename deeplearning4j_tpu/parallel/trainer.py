@@ -94,6 +94,13 @@ class ShardedTrainer:
     def _shard_batch_arr(self, a):
         if a is None:
             return None
+        if isinstance(a, jax.Array):
+            # already on device: re-place only if the sharding differs —
+            # never round-trip through host (a 224² imagenet batch is ~77MB;
+            # re-uploading it every step would dominate the step time)
+            if a.sharding.is_equivalent_to(self.batch_sharding, a.ndim):
+                return a
+            return jax.device_put(a, self.batch_sharding)
         arr = np.asarray(a)
         dp = self.mesh.shape.get(self.data_axis, 1)
         if arr.shape[0] % dp != 0:
@@ -102,7 +109,10 @@ class ShardedTrainer:
                 "(pad or drop the remainder — XLA needs static shapes)")
         return jax.device_put(jnp.asarray(arr), self.batch_sharding)
 
-    def _shard_dataset(self, ds: DataSet) -> DataSet:
+    def shard_dataset(self, ds: DataSet) -> DataSet:
+        """Pre-place a batch on the mesh (public so callers that reuse a
+        batch — benchmarks, eval loops — pay the host→device transfer
+        once, not per step)."""
         return DataSet(
             self._shard_batch_arr(ds.features),
             None if ds.labels is None else jax.tree_util.tree_map(self._shard_batch_arr, ds.labels),
@@ -110,12 +120,13 @@ class ShardedTrainer:
             self._shard_batch_arr(ds.labels_mask),
         )
 
+
     # -- training ----------------------------------------------------------
 
     def fit_batch(self, ds: DataSet) -> float:
         """One global step: batch split over data axis, grads psum'd by GSPMD."""
         with jax.sharding.set_mesh(self.mesh):
-            return self.net.fit_batch(self._shard_dataset(ds))
+            return self.net.fit_batch(self.shard_dataset(ds))
 
     def fit(self, data, epochs: int = 1) -> List[float]:
         losses = []

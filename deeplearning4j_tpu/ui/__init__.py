@@ -1,0 +1,24 @@
+"""Observability: stats collection → storage → dashboard (replaces
+deeplearning4j-ui-parent, SURVEY.md §1 L6).
+
+The reference splits this into BaseStatsListener (per-iteration collection)
+→ StatsStorage (routing/persistence) → Play web server (rendering).  The
+same three seams exist here, TPU-shaped: the listener reads the model's
+pytrees (no flat param buffer), storage is in-memory / JSONL / sqlite, and
+rendering emits a self-contained static HTML dashboard (zero-egress: no
+CDN scripts, inline SVG) served optionally by a stdlib http server.
+jax.profiler integration replaces the reference's SystemInfo polling for
+deep performance traces.
+"""
+
+from .stats import StatsListener
+from .storage import FileStatsStorage, InMemoryStatsStorage, SqliteStatsStorage
+from .render import render_dashboard
+from .server import UIServer
+from .profiler import profile_trace
+
+__all__ = [
+    "StatsListener",
+    "InMemoryStatsStorage", "FileStatsStorage", "SqliteStatsStorage",
+    "render_dashboard", "UIServer", "profile_trace",
+]

@@ -1,0 +1,34 @@
+"""jax.profiler integration — the deep-performance seam.
+
+The reference polls SystemInfo/JVM stats (ui/module SystemInfoController);
+on TPU the right tool is the XLA profiler: ``profile_trace(logdir)``
+captures a TensorBoard-compatible trace (HLO timelines, memory viewer,
+op-level MXU utilization) around any training region."""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator
+
+
+@contextlib.contextmanager
+def profile_trace(logdir: str, create_perfetto_link: bool = False) -> Iterator[None]:
+    """Context manager: ``with profile_trace('/tmp/trace'): train()`` —
+    view with TensorBoard's profile plugin (or perfetto).  No-ops cleanly
+    if the profiler backend is unavailable."""
+    import jax
+
+    try:
+        jax.profiler.start_trace(logdir,
+                                 create_perfetto_link=create_perfetto_link)
+        started = True
+    except Exception:   # profiler unavailable on this backend/build
+        started = False
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                jax.profiler.stop_trace()
+            except Exception:
+                pass

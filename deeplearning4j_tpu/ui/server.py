@@ -1,0 +1,81 @@
+"""Minimal training UI server.
+
+Parity target: reference play/PlayUIServer.java (UIServer.getInstance()
+.attach(statsStorage) → browse localhost:9000).  Stdlib http.server
+renders the dashboard from the attached storage on every request — no
+framework, no static assets, works air-gapped."""
+
+from __future__ import annotations
+
+import html
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import List, Optional
+
+from .render import render_session_html
+
+
+class UIServer:
+    """``UIServer(port).attach(storage).start()`` → browse /."""
+
+    def __init__(self, port: int = 9000, host: str = "127.0.0.1"):
+        self.port = port
+        self.host = host
+        self._storages: List = []
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def attach(self, storage) -> "UIServer":
+        self._storages.append(storage)
+        return self
+
+    def _render_index(self) -> str:
+        rows = []
+        for si, storage in enumerate(self._storages):
+            for sid in storage.list_session_ids():
+                href = f"/train/{si}/{urllib.parse.quote(sid, safe='')}"
+                rows.append(f'<li><a href="{href}">'
+                            f"{html.escape(sid)}</a></li>")
+        return ("<html><body><h1>deeplearning4j_tpu UI</h1><ul>"
+                + "".join(rows) + "</ul></body></html>")
+
+    def start(self) -> "UIServer":
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # silence request logging
+                pass
+
+            def do_GET(self):
+                try:
+                    if self.path.startswith("/train/"):
+                        _, _, si, sid = self.path.split("/", 3)
+                        body = render_session_html(
+                            server._storages[int(si)],
+                            urllib.parse.unquote(sid))
+                    else:
+                        body = server._render_index()
+                    data = body.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html; charset=utf-8")
+                    self.send_header("Content-Length", str(len(data)))
+                    self.end_headers()
+                    self.wfile.write(data)
+                except Exception as e:  # pragma: no cover - defensive
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(str(e).encode())
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_address[1]  # resolves port=0
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

@@ -1,0 +1,145 @@
+"""StatsListener — per-iteration training telemetry.
+
+Parity target: reference ui/stats/BaseStatsListener.java:304-420
+(iterationDone: score, timing, minibatch rate, param/update/activation
+histograms + mean-magnitude ratios, JVM/off-heap memory) routed through a
+StatsStorage.
+
+TPU adaptation: params are per-layer pytrees, so per-layer stats come from
+tree leaves; the fused jit step doesn't expose gradients, so the
+update:param mean-magnitude ratio — the quantity DL4J users actually watch
+(rule of thumb ~1e-3) — is computed from param DELTAS between iterations,
+which under any SGD-family updater IS the applied update.  Device memory
+comes from PJRT memory_stats() where the backend provides it (TPU yes,
+CPU no).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..optimize.listeners import TrainingListener
+
+
+def _leaf_stats(arr: np.ndarray, bins: int, with_histogram: bool = True
+                ) -> Dict[str, Any]:
+    a = np.asarray(arr, np.float32).ravel()
+    if a.size == 0:
+        return {}
+    out = {
+        "mean": float(a.mean()), "std": float(a.std()),
+        "min": float(a.min()), "max": float(a.max()),
+        "mean_magnitude": float(np.abs(a).mean()),
+    }
+    if with_histogram:
+        hist, edges = np.histogram(a, bins=bins)
+        out["histogram"] = hist.tolist()
+        out["histogram_edges"] = [float(edges[0]), float(edges[-1])]
+    return out
+
+
+class StatsListener(TrainingListener):
+    """Collects per-iteration stats into a StatsStorage.
+
+    ``update_frequency`` throttles collection (reference updateFrequency);
+    histograms are optional (they dominate record size, as in DL4J).
+    """
+
+    def __init__(self, storage, session_id: Optional[str] = None,
+                 update_frequency: int = 1, collect_histograms: bool = True,
+                 histogram_bins: int = 20, collect_memory: bool = True):
+        self.storage = storage
+        self.session_id = session_id or f"session_{int(time.time())}"
+        self.update_frequency = max(1, update_frequency)
+        self.collect_histograms = collect_histograms
+        self.histogram_bins = histogram_bins
+        self.collect_memory = collect_memory
+        self._last_time: Optional[float] = None
+        self._last_params: Optional[List[Dict[str, np.ndarray]]] = None
+        self._start_time = time.time()
+
+    # -- helpers -----------------------------------------------------------
+
+    def _param_items(self, model):
+        """Normalize MLN (list of dicts) / graph (dict of dicts) params to
+        (layer_name, key, array) triples."""
+        params = model.params
+        if isinstance(params, dict):
+            for name, p in params.items():
+                for k, v in (p or {}).items():
+                    yield name, k, v
+        else:
+            for i, p in enumerate(params):
+                name = getattr(model.conf.layers[i], "name", None) or f"layer_{i}"
+                for k, v in (p or {}).items():
+                    yield name, k, v
+
+    def _memory(self) -> Dict[str, Any]:
+        try:
+            import jax
+            stats = jax.devices()[0].memory_stats()
+            if stats:
+                return {"bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                        "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0))}
+        except Exception:
+            pass
+        return {}
+
+    # -- TrainingListener --------------------------------------------------
+
+    def iteration_done(self, model, iteration: int, loss: float) -> None:
+        if iteration % self.update_frequency != 0:
+            return
+        now = time.time()
+        record: Dict[str, Any] = {
+            "iteration": int(iteration),
+            "timestamp": now,
+            "relative_time": now - self._start_time,
+            "score": float(loss),
+        }
+        if self._last_time is not None:
+            dt = max(now - self._last_time, 1e-9)
+            record["iterations_per_sec"] = self.update_frequency / dt
+        self._last_time = now
+
+        params_np = {}
+        param_stats: Dict[str, Dict[str, Any]] = {}
+        update_stats: Dict[str, Dict[str, Any]] = {}
+        ratios: Dict[str, float] = {}
+        for name, key, v in self._param_items(model):
+            pid = f"{name}/{key}"
+            arr = np.asarray(v)
+            params_np[pid] = arr
+            param_stats[pid] = _leaf_stats(arr, self.histogram_bins,
+                                           self.collect_histograms)
+            if self._last_params is not None and pid in self._last_params:
+                delta = arr - self._last_params[pid]
+                ustats = _leaf_stats(delta, self.histogram_bins,
+                                     self.collect_histograms)
+                update_stats[pid] = ustats
+                pm = param_stats[pid].get("mean_magnitude", 0.0)
+                um = ustats.get("mean_magnitude", 0.0)
+                # the DL4J "mean magnitude ratio" users watch (~1e-3 healthy);
+                # the delta spans update_frequency optimizer steps, so
+                # normalize to a PER-STEP ratio
+                ratios[pid] = (um / pm / self.update_frequency) if pm > 0 else 0.0
+        self._last_params = params_np
+        record["parameters"] = param_stats
+        if update_stats:
+            record["updates"] = update_stats
+            record["update_ratios"] = ratios
+        if self.collect_memory:
+            mem = self._memory()
+            if mem:
+                record["memory"] = mem
+        self.storage.put_update(self.session_id, record)
+
+    def epoch_done(self, model, epoch: int) -> None:
+        self.storage.put_update(self.session_id, {
+            "iteration": int(getattr(model, "iteration", 0)),
+            "timestamp": time.time(),
+            "epoch_done": int(epoch),
+        })

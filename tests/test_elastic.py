@@ -1,0 +1,154 @@
+"""Elastic training: checkpoint rotation, failure detection, restore-and-
+continue recovery; multi-host helper validation."""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import (
+    MultiLayerNetwork, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.parallel import (
+    CheckpointManager, ElasticTrainer, FailureDetector, local_batch_slice,
+)
+
+
+def small_net():
+    conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(lr=0.01))
+            .layer(Dense(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def data():
+    rng = np.random.default_rng(0)
+    xs = np.concatenate([rng.normal(-2, 1, (32, 4)),
+                         rng.normal(2, 1, (32, 4))]).astype(np.float32)
+    ys = np.zeros((64, 2), np.float32)
+    ys[:32, 0] = 1
+    ys[32:, 1] = 1
+    return DataSet(xs, ys)
+
+
+class TestCheckpointManager:
+    def test_rolling_keep_last(self, tmp_path):
+        net = small_net()
+        cm = CheckpointManager(str(tmp_path), keep_last=2)
+        for s in (10, 20, 30, 40):
+            cm.save(net, s)
+        steps = [s for _, s in cm.list_checkpoints()]
+        assert steps == [30, 40]
+        _, latest_step = cm.latest()
+        assert latest_step == 40
+
+    def test_restore_latest(self, tmp_path):
+        net = small_net()
+        cm = CheckpointManager(str(tmp_path))
+        cm.save(net, 7)
+        model, step = cm.restore_latest(MultiLayerNetwork.load)
+        assert step == 7
+        x = data().features[:4]
+        np.testing.assert_allclose(model.output(x), net.output(x), rtol=1e-5)
+
+    def test_empty_restore(self, tmp_path):
+        cm = CheckpointManager(str(tmp_path))
+        model, step = cm.restore_latest(MultiLayerNetwork.load)
+        assert model is None and step == -1
+
+
+class FlakyTrainer:
+    """Fails with an infra-looking error at chosen steps."""
+
+    def __init__(self, net, fail_at):
+        self.net = net
+        self.fail_at = set(fail_at)
+        self.calls = 0
+
+    def fit_batch(self, ds):
+        self.calls += 1
+        if self.calls in self.fail_at:
+            raise RuntimeError("DEADLINE_EXCEEDED: device halted")
+        return self.net.fit_batch(ds)
+
+
+class TestElasticTrainer:
+    def test_recovers_from_failure_and_restores_checkpoint(self, tmp_path):
+        net = small_net()
+        trainer = FlakyTrainer(net, fail_at={7})
+        et = ElasticTrainer(trainer, str(tmp_path), checkpoint_every=2,
+                            max_restarts=2)
+        ds = data()
+        losses = [et.fit_batch(ds) for _ in range(10)]
+        assert len(losses) == 10
+        assert et.restarts == 1
+        assert losses[-1] < losses[0]
+        # checkpoints exist and the loop kept rolling after restore
+        assert et.ckpt.latest() is not None
+
+    def test_programming_errors_propagate(self, tmp_path):
+        net = small_net()
+
+        class Bad:
+            def __init__(self):
+                self.net = net
+
+            def fit_batch(self, ds):
+                raise ValueError("bad shape")
+
+        et = ElasticTrainer(Bad(), str(tmp_path))
+        with pytest.raises(ValueError, match="bad shape"):
+            et.fit_batch(data())
+
+    def test_restart_budget_exhausts(self, tmp_path):
+        net = small_net()
+        trainer = FlakyTrainer(net, fail_at={1, 2, 3, 4, 5, 6, 7, 8, 9})
+        et = ElasticTrainer(trainer, str(tmp_path), max_restarts=2)
+        with pytest.raises(RuntimeError, match="max_restarts"):
+            et.fit_batch(data())
+
+    def test_rebuild_fn_called_on_failure(self, tmp_path):
+        net = small_net()
+        rebuilt = []
+
+        def rebuild():
+            rebuilt.append(True)
+            return FlakyTrainer(net, fail_at=set())
+
+        et = ElasticTrainer(FlakyTrainer(net, fail_at={1}), str(tmp_path),
+                            rebuild_fn=rebuild)
+        et.fit_batch(data())
+        assert rebuilt == [True]
+
+    def test_fit_writes_final_checkpoint(self, tmp_path):
+        net = small_net()
+        et = ElasticTrainer(FlakyTrainer(net, set()), str(tmp_path),
+                            checkpoint_every=1000)
+        et.fit(data(), epochs=2)
+        assert et.ckpt.latest() is not None
+
+
+class TestDistributedHelpers:
+    def test_local_batch_slice_single_process(self, monkeypatch):
+        s = local_batch_slice(64)
+        assert (s.start, s.stop) == (0, 64)  # single-process: whole batch
+        # divisibility validation (any batch divides by 1 process, so
+        # exercise the check against a mocked process count)
+        import deeplearning4j_tpu.parallel.distributed as dist
+        monkeypatch.setattr(dist.jax, "process_count", lambda: 3)
+        with pytest.raises(ValueError, match="divisible"):
+            dist.local_batch_slice(64)
+
+    def test_failure_detector_classification(self):
+        fd = FailureDetector()
+        assert fd.is_recoverable(RuntimeError("UNAVAILABLE: socket closed"))
+        assert fd.is_recoverable(OSError("device lost"))
+        assert not fd.is_recoverable(ValueError("shape mismatch"))
+        assert not fd.is_recoverable(KeyError("W"))
