@@ -612,6 +612,11 @@ class ComputationGraph:
             if isinstance(spec.vertex, LayerVertex) and self.params.get(spec.name):
                 total = total + spec.vertex.layer.regularization_score(
                     params[spec.name]).astype(acc)
+        if train:
+            from .layers.base import AUX_LOSS_KEY
+            for s in new_state.values():
+                if isinstance(s, dict) and AUX_LOSS_KEY in s:
+                    total = total + s[AUX_LOSS_KEY].astype(acc)
         if carries is not None:
             return total, (new_state, new_carries)
         return total, new_state

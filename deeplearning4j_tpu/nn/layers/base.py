@@ -39,6 +39,12 @@ Array = jax.Array
 
 CONFIG_REGISTRY: Dict[str, type] = {}
 
+#: state-dict slot for activation-dependent auxiliary losses (e.g. the MoE
+#: router's Switch load-balance term).  Layers write the CURRENT batch's
+#: aux term here from forward(); the containers add every such slot to the
+#: training objective (train only — eval scores stay pure data loss).
+AUX_LOSS_KEY = "__aux_loss__"
+
 
 def register_config(cls):
     """Class decorator: make a dataclass JSON round-trippable by type name."""

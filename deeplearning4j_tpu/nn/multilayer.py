@@ -287,6 +287,11 @@ class MultiLayerNetwork:
         for layer, p in zip(self.conf.layers, params):
             if p:
                 reg = reg + layer.regularization_score(p).astype(acc)
+        if train:
+            from .layers.base import AUX_LOSS_KEY
+            for s in new_state:
+                if isinstance(s, dict) and AUX_LOSS_KEY in s:
+                    reg = reg + s[AUX_LOSS_KEY].astype(acc)
         total = loss.astype(acc) + reg
         if carries is not None:
             return total, (new_state, new_carries)

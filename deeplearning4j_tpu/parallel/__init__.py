@@ -25,6 +25,7 @@ from .ring import ring_attention, ring_self_attention
 from .pipeline import pipeline_apply, stack_stage_params, stage_sharding
 from .transformer import ShardedTransformerLM
 from .elastic import CheckpointManager, ElasticTrainer, FailureDetector
+from .moe import MoE, init_moe_params, moe_forward_dense, moe_forward_ep
 from .distributed import (
     initialize, is_coordinator, local_batch_slice, process_count, process_index,
 )

@@ -1,0 +1,199 @@
+"""Mixture-of-Experts with expert parallelism (EP).
+
+No reference analog (DL4J predates MoE); SURVEY §2.3 lists EP as the
+remaining first-class TPU parallelism axis.  Design follows the
+Shazeer/Switch lineage the TPU stack was built around:
+
+  - router: tokens → top-k experts (softmax over the selected logits)
+  - experts: per-expert FFN [d_model → d_ff → d_model], params stacked on
+    a leading expert dim so ALL experts compute as one batched einsum
+    (MXU-shaped, no ragged work)
+  - EP sharding: experts split over a mesh axis inside ``shard_map``;
+    tokens stay replicated on that axis, each shard computes only its
+    local experts' capacity slots, and one ``psum`` merges expert
+    contributions — collective traffic = activations once per layer,
+    the standard replicated-token/sharded-expert formulation
+  - capacity: fixed per-expert slots (ceil(k·N/E·capacity_factor));
+    overflow tokens are dropped by the dispatch one-hot exactly as in
+    Switch — keeps every shape static for XLA
+
+``moe_forward_dense`` is the exact (every expert sees every token's
+gate-weighted input) single-device path used for parity tests and the
+``MoE`` layer; ``moe_forward_ep`` is the sharded production path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+Array = jax.Array
+
+
+def init_moe_params(rng: Array, d_model: int, d_ff: int, n_experts: int,
+                    dtype=jnp.float32) -> Dict[str, Array]:
+    k1, k2, k3 = jax.random.split(rng, 3)
+    s_in = (2.0 / d_model) ** 0.5
+    s_ff = (2.0 / d_ff) ** 0.5
+    return {
+        "Wg": jax.random.normal(k1, (d_model, n_experts), dtype) * s_in,
+        "W1": jax.random.normal(k2, (n_experts, d_model, d_ff), dtype) * s_in,
+        "b1": jnp.zeros((n_experts, d_ff), dtype),
+        "W2": jax.random.normal(k3, (n_experts, d_ff, d_model), dtype) * s_ff,
+        "b2": jnp.zeros((n_experts, d_model), dtype),
+    }
+
+
+def _router(params, x, k: int):
+    """→ (gates [N,E] with nonzeros only on the top-k, aux load-balance
+    loss).  Gates renormalize softmax over the selected logits (Shazeer
+    2017); aux loss is the Switch E·Σ f_e·p_e balance term."""
+    logits = x @ params["Wg"].astype(x.dtype)            # [N,E]
+    E = logits.shape[-1]
+    probs = jax.nn.softmax(logits, axis=-1)
+    topv, topi = jax.lax.top_k(logits, k)                # [N,k]
+    gate_v = jax.nn.softmax(topv, axis=-1)               # renormalized
+    gates = jnp.zeros_like(probs).at[
+        jnp.arange(x.shape[0])[:, None], topi].set(gate_v)
+    # load balance: fraction routed vs mean prob per expert
+    frac = jnp.mean((gates > 0).astype(x.dtype), axis=0)  # [E]
+    mean_p = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_p)
+    return gates, aux
+
+
+def moe_forward_dense(params: Dict[str, Array], x: Array, k: int = 2
+                      ) -> Tuple[Array, Array]:
+    """Exact MoE: every expert processes every token, outputs combined by
+    the (sparse) gates.  O(E·N·d·f) — the test/teaching path.
+    x [N, d_model] → (y [N, d_model], aux_loss)."""
+    gates, aux = _router(params, x, k)
+    h = jnp.einsum("nd,edf->nef", x, params["W1"].astype(x.dtype))
+    h = jax.nn.relu(h + params["b1"].astype(x.dtype)[None])
+    y_e = jnp.einsum("nef,efd->ned", h, params["W2"].astype(x.dtype))
+    y_e = y_e + params["b2"].astype(x.dtype)[None]
+    y = jnp.einsum("ne,ned->nd", gates, y_e)
+    return y, aux
+
+
+def capacity(n_tokens: int, n_experts: int, k: int,
+             capacity_factor: float = 1.25) -> int:
+    """Per-expert token slots (Switch capacity), computed statically."""
+    return max(1, int(np.ceil(k * n_tokens / n_experts * capacity_factor)))
+
+
+def moe_forward_ep(params: Dict[str, Array], x: Array, mesh: Mesh,
+                   expert_axis: str = "model", k: int = 2,
+                   capacity_factor: float = 1.25) -> Tuple[Array, Array]:
+    """Expert-parallel MoE over ``expert_axis``.
+
+    Experts are sharded over the axis; tokens are replicated on it (shard
+    them over ``data`` as usual).  Each shard builds dispatch/combine
+    one-hots for its LOCAL experts only, computes its capacity slots, and
+    a single psum merges the gate-weighted expert outputs.  Dropped
+    (over-capacity) tokens contribute zero, exactly like Switch.
+    """
+    E = params["Wg"].shape[-1]
+    M = mesh.shape[expert_axis]
+    if E % M:
+        raise ValueError(f"n_experts {E} not divisible by {expert_axis} "
+                         f"axis size {M}")
+    N = x.shape[0]
+    C = capacity(N, E, k, capacity_factor)
+    e_loc = E // M
+
+    expert_keys = ("W1", "b1", "W2", "b2")
+    in_specs = (
+        {kk: (P(expert_axis) if kk in expert_keys else P())
+         for kk in params},
+        P(),            # x replicated over the expert axis
+    )
+    out_specs = (P(), P())
+
+    def shard_fn(p, xs):
+        idx = jax.lax.axis_index(expert_axis)
+        gates, aux = _router(p, xs, k)          # router replicated → identical
+        aux = aux / M                           # psum'd below → global value
+        local_gates = jax.lax.dynamic_slice_in_dim(
+            gates, idx * e_loc, e_loc, axis=1)  # [N, e_loc]
+        # position of each token within its expert's capacity buffer:
+        # cumulative count of prior routed tokens for that expert
+        routed = (local_gates > 0).astype(jnp.int32)          # [N, e_loc]
+        pos = jnp.cumsum(routed, axis=0) - routed             # [N, e_loc]
+        keep = routed * (pos < C)
+        # dispatch one-hot [N, e_loc, C]
+        disp = keep[..., None] * jax.nn.one_hot(pos, C, dtype=xs.dtype)
+        exp_in = jnp.einsum("nec,nd->ecd", disp, xs)          # [e_loc, C, d]
+        # expert params cast to the token dtype — same mixed-precision
+        # contract as moe_forward_dense
+        W1, b1 = p["W1"].astype(xs.dtype), p["b1"].astype(xs.dtype)
+        W2, b2 = p["W2"].astype(xs.dtype), p["b2"].astype(xs.dtype)
+        h = jax.nn.relu(jnp.einsum("ecd,edf->ecf", exp_in, W1)
+                        + b1[:, None, :])
+        out = jnp.einsum("ecf,efd->ecd", h, W2) + b2[:, None, :]
+        combine = disp * local_gates[..., None]               # gate-weighted
+        y_local = jnp.einsum("nec,ecd->nd", combine, out)
+        y = jax.lax.psum(y_local, expert_axis)
+        return y, jax.lax.psum(aux, expert_axis)
+
+    fn = jax.shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs)
+    return fn(params, x)
+
+
+# ---------------------------------------------------------------------------
+# layer wrapper (single-device / GSPMD path)
+# ---------------------------------------------------------------------------
+
+from ..nn.conf.inputs import InputType          # noqa: E402
+from ..nn.layers.base import (                  # noqa: E402
+    AUX_LOSS_KEY, ForwardOut, Layer, register_layer,
+)
+
+
+@register_layer
+@dataclasses.dataclass
+class MoE(Layer):
+    """Mixture-of-Experts FFN layer (exact dense combine; use
+    ``moe_forward_ep`` / ShardedTransformerLM for the sharded path).
+    Accepts [mb, d] or [mb, t, d] (applied per token).
+
+    The Switch load-balance auxiliary loss rides the ``AUX_LOSS_KEY``
+    state slot, which the containers add to the training objective —
+    without it the router can collapse onto one expert."""
+
+    n_in: int = 0
+    d_ff: int = 0
+    n_experts: int = 4
+    top_k: int = 2
+    aux_weight: float = 0.01
+
+    def infer_nin(self, in_type: InputType) -> None:
+        if self.n_in == 0:
+            self.n_in = in_type.size
+        if self.d_ff == 0:
+            self.d_ff = 4 * self.n_in
+
+    def output_type(self, in_type: InputType) -> InputType:
+        return in_type
+
+    def init_params(self, rng, in_type, dtype=jnp.float32) -> Dict[str, Array]:
+        return init_moe_params(rng, self.n_in, self.d_ff, self.n_experts, dtype)
+
+    def init_state(self, in_type, dtype=jnp.float32) -> Dict[str, Array]:
+        return {AUX_LOSS_KEY: jnp.zeros((), jnp.float32)}
+
+    def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
+        x = self._maybe_dropout(x, train, rng)
+        shape = x.shape
+        flat = x.reshape(-1, shape[-1])
+        y, aux = moe_forward_dense(params, flat, self.top_k)
+        new_state = dict(state)
+        new_state[AUX_LOSS_KEY] = (self.aux_weight * aux).astype(jnp.float32)
+        return ForwardOut(self._act(y.reshape(shape)), new_state, mask)

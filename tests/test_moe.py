@@ -1,0 +1,189 @@
+"""Mixture-of-Experts: routing semantics, dense↔expert-parallel parity
+(forward AND gradients) on the 8-device mesh, gradient check, MLN training."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.parallel import (
+    MoE, build_mesh, init_moe_params, moe_forward_dense, moe_forward_ep,
+)
+from deeplearning4j_tpu.parallel.moe import capacity
+
+
+def params_and_tokens(d=8, f=16, E=4, N=32, seed=0, dtype=jnp.float32):
+    rng = jax.random.PRNGKey(seed)
+    p = init_moe_params(rng, d, f, E, dtype)
+    x = jax.random.normal(jax.random.fold_in(rng, 1), (N, d), dtype)
+    return p, x
+
+
+class TestDenseMoE:
+    def test_output_shape_and_aux(self):
+        p, x = params_and_tokens()
+        y, aux = moe_forward_dense(p, x, k=2)
+        assert y.shape == x.shape
+        assert np.isfinite(float(aux)) and float(aux) > 0
+
+    def test_top1_uses_argmax_expert_only(self):
+        p, x = params_and_tokens(E=3)
+        logits = np.asarray(x @ p["Wg"])
+        y, _ = moe_forward_dense(p, x, k=1)
+        # manually compute the argmax expert's FFN for token 0
+        e = int(np.argmax(logits[0]))
+        h = np.maximum(np.asarray(x)[0] @ np.asarray(p["W1"])[e]
+                       + np.asarray(p["b1"])[e], 0)
+        want = h @ np.asarray(p["W2"])[e] + np.asarray(p["b2"])[e]
+        np.testing.assert_allclose(np.asarray(y)[0], want, rtol=1e-5, atol=1e-5)
+
+    def test_gradient_check_f64(self):
+        jax.config.update("jax_enable_x64", True)
+        try:
+            p, x = params_and_tokens(d=4, f=6, E=3, N=8, dtype=jnp.float64)
+
+            def loss(p_):
+                y, aux = moe_forward_dense(p_, x, k=2)
+                return jnp.sum(y * y) + 0.01 * aux
+
+            grads = jax.grad(loss)(p)
+            eps = 1e-6
+            for key in ("Wg", "W1", "b2"):
+                flat = np.asarray(p[key], np.float64).copy()
+                idx = tuple(0 for _ in flat.shape)
+                pp = dict(p)
+                up = flat.copy(); up[idx] += eps
+                dn = flat.copy(); dn[idx] -= eps
+                pp[key] = jnp.asarray(up)
+                fu = float(loss(pp))
+                pp[key] = jnp.asarray(dn)
+                fd = float(loss(pp))
+                num = (fu - fd) / (2 * eps)
+                ana = float(np.asarray(grads[key])[idx])
+                assert abs(num - ana) < 1e-4 * max(1.0, abs(num)), \
+                    f"{key}: numeric {num} vs autodiff {ana}"
+        finally:
+            jax.config.update("jax_enable_x64", False)
+
+
+class TestExpertParallel:
+    def test_ep_matches_dense_forward(self):
+        mesh = build_mesh({"data": 2, "model": 4})
+        p, x = params_and_tokens(E=8, N=32)
+        y_dense, aux_d = moe_forward_dense(p, x, k=2)
+        # generous capacity → no drops → exact parity
+        y_ep, aux_e = moe_forward_ep(p, x, mesh, expert_axis="model", k=2,
+                                     capacity_factor=8.0)
+        np.testing.assert_allclose(np.asarray(y_ep), np.asarray(y_dense),
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(float(aux_e), float(aux_d), rtol=1e-5)
+
+    def test_ep_matches_dense_gradients(self):
+        mesh = build_mesh({"data": 2, "model": 4})
+        p, x = params_and_tokens(E=4, N=16)
+
+        def loss_dense(p_):
+            y, _ = moe_forward_dense(p_, x, k=2)
+            return jnp.sum(y * y)
+
+        def loss_ep(p_):
+            y, _ = moe_forward_ep(p_, x, mesh, k=2, capacity_factor=8.0)
+            return jnp.sum(y * y)
+
+        gd = jax.grad(loss_dense)(p)
+        ge = jax.grad(loss_ep)(p)
+        for key in gd:
+            np.testing.assert_allclose(np.asarray(ge[key]), np.asarray(gd[key]),
+                                       rtol=1e-4, atol=1e-5,
+                                       err_msg=f"grad mismatch on {key}")
+
+    def test_capacity_drops_overflow_tokens(self):
+        mesh = build_mesh({"data": 4, "model": 2})
+        # force every token to expert 0 by biasing the router
+        p, x = params_and_tokens(E=2, N=16)
+        p = dict(p)
+        p["Wg"] = jnp.zeros_like(p["Wg"]).at[0, 0].set(100.0)
+        x = x.at[:, 0].set(1.0)  # all tokens push expert 0
+        y, _ = moe_forward_ep(p, x, mesh, k=1, capacity_factor=0.25)
+        # capacity = ceil(1*16/2*0.25)=2 slots → only 2 tokens non-zero
+        nonzero = np.sum(np.any(np.abs(np.asarray(y)) > 1e-9, axis=1))
+        assert nonzero <= capacity(16, 2, 1, 0.25), nonzero
+
+    def test_expert_divisibility_validated(self):
+        mesh = build_mesh({"data": 2, "model": 4})
+        p, x = params_and_tokens(E=6)
+        with pytest.raises(ValueError, match="divisible"):
+            moe_forward_ep(p, x, mesh)
+
+
+class TestMoELayer:
+    def test_trains_in_mln(self):
+        from deeplearning4j_tpu.datasets import DataSet
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import (
+            MultiLayerNetwork, NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.updaters import Adam
+
+        rng = np.random.default_rng(0)
+        xs = np.concatenate([rng.normal(-2, 1, (64, 8)),
+                             rng.normal(2, 1, (64, 8))]).astype(np.float32)
+        ys = np.zeros((128, 2), np.float32)
+        ys[:64, 0] = 1
+        ys[64:, 1] = 1
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(lr=0.01))
+                .layer(MoE(n_experts=4, top_k=2, d_ff=32, activation="identity"))
+                .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(8)).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        losses = [net.fit_batch(DataSet(xs, ys)) for _ in range(40)]
+        assert losses[-1] < 0.3 * losses[0]
+        assert net.evaluate((xs, ys)).accuracy() > 0.95
+
+    def test_sequence_input(self):
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        layer = MoE(n_experts=2, top_k=1, activation="identity")
+        layer.infer_nin(InputType.recurrent(6))
+        p = layer.init_params(jax.random.PRNGKey(0), InputType.recurrent(6))
+        x = jnp.ones((2, 5, 6))
+        out = layer.forward(p, {}, x)
+        assert out.y.shape == (2, 5, 6)
+
+    def test_aux_loss_reaches_training_objective(self):
+        """The Switch balance term must flow into the train loss (and only
+        the TRAIN loss) via the AUX_LOSS_KEY state slot."""
+        from deeplearning4j_tpu.datasets import DataSet
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import OutputLayer
+        from deeplearning4j_tpu.nn.layers.base import AUX_LOSS_KEY
+        from deeplearning4j_tpu.nn.multilayer import (
+            MultiLayerNetwork, NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.updaters import Sgd
+
+        rng = np.random.default_rng(0)
+        xs = rng.normal(size=(32, 8)).astype(np.float32)
+        ys = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+
+        def build(aux_w):
+            conf = (NeuralNetConfiguration.builder().seed(0)
+                    .updater(Sgd(lr=0.0))
+                    .layer(MoE(n_experts=4, top_k=1, d_ff=16,
+                               activation="identity", aux_weight=aux_w))
+                    .layer(OutputLayer(n_out=2, activation="softmax",
+                                       loss="mcxent"))
+                    .set_input_type(InputType.feed_forward(8)).build())
+            net = MultiLayerNetwork(conf)
+            net.init()
+            return net
+
+        loss_with = build(1.0).fit_batch(DataSet(xs, ys))
+        loss_without = build(0.0).fit_batch(DataSet(xs, ys))
+        assert loss_with > loss_without + 0.1  # aux term present in train loss
+        net = build(1.0)
+        assert AUX_LOSS_KEY in net.state[0]
+        # eval score excludes the aux term
+        assert abs(net.score(DataSet(xs, ys)) - loss_without) < 0.05
