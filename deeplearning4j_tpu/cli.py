@@ -1,0 +1,210 @@
+"""Command-line interface: train / evaluate / predict / summary.
+
+Parity target: the reference ecosystem's CLI umbrella
+(deeplearning4j-cli-parent — train/eval entry points over serialized
+configs).  Usage:
+
+    python -m deeplearning4j_tpu train --zoo lenet --data mnist \\
+        --epochs 2 --batch-size 128 --output model.zip --dashboard out.html
+    python -m deeplearning4j_tpu train --config conf.json --data data.npz ...
+    python -m deeplearning4j_tpu evaluate --model model.zip --data mnist
+    python -m deeplearning4j_tpu predict --model model.zip --input x.npz \\
+        --output preds.npz
+    python -m deeplearning4j_tpu summary --model model.zip
+
+``--data`` accepts a built-in name (mnist / cifar10 / iris / emnist /
+svhn / uci) or a .npz file with arrays ``x`` and ``y`` (one-hot or class
+indices).  Configs are the framework's JSON (MultiLayerConfiguration
+to_dict format).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+def _num_classes_of(net) -> Optional[int]:
+    """Model's output width, so index labels one-hot to the RIGHT width
+    even when a split doesn't contain the highest class."""
+    layers = getattr(net.conf, "layers", None)
+    if layers:
+        return getattr(layers[-1], "n_out", None) or None
+    specs = getattr(net.conf, "vertices", None)
+    if specs:
+        by_name = {s.name: s for s in specs}
+        out = by_name.get(net.conf.network_outputs[0])
+        layer = getattr(getattr(out, "vertex", None), "layer", None)
+        return getattr(layer, "n_out", None) or None
+    return None
+
+
+def _load_data(spec: str, train: bool = True,
+               num_classes: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+    from .datasets import fetchers
+
+    builtin = {
+        "mnist": lambda: fetchers.load_mnist(train=train),
+        "cifar10": lambda: fetchers.load_cifar10(train=train),
+        "iris": lambda: fetchers.load_iris(),
+        "emnist": lambda: fetchers.load_emnist(train=train),
+        "svhn": lambda: fetchers.load_svhn(train=train),
+        "uci": lambda: fetchers.load_uci_synthetic_control(train=train),
+    }
+    if spec in builtin:
+        xs, ys = builtin[spec]()
+    else:
+        data = np.load(spec)
+        if "x" not in data or "y" not in data:
+            raise SystemExit(f"{spec}: .npz must contain arrays 'x' and 'y'")
+        xs, ys = data["x"], data["y"]
+    if ys.ndim == 1:  # class indices → one-hot
+        width = num_classes or int(ys.max()) + 1
+        if int(ys.max()) >= width:
+            raise SystemExit(f"label {int(ys.max())} out of range for "
+                             f"{width} classes")
+        ys = np.eye(width, dtype=np.float32)[ys.astype(np.int64)]
+    return np.asarray(xs, np.float32), np.asarray(ys, np.float32)
+
+
+def _build_model(args):
+    if args.zoo:
+        from .models import ZOO
+
+        name = args.zoo.lower()
+        if name not in ZOO:
+            raise SystemExit(f"unknown zoo model '{args.zoo}' — one of "
+                             f"{sorted(ZOO)}")
+        kw = json.loads(args.zoo_args) if args.zoo_args else {}
+        net = ZOO[name](**kw)
+        if not getattr(net, "params", None):
+            net.init()
+        return net
+    if args.config:
+        from .nn.multilayer import MultiLayerConfiguration, MultiLayerNetwork
+
+        with open(args.config) as f:
+            conf = MultiLayerConfiguration.from_dict(json.load(f))
+        net = MultiLayerNetwork(conf)
+        net.init()
+        return net
+    raise SystemExit("pass --zoo NAME or --config conf.json")
+
+
+def _load_model(path: str):
+    from .utils.serializer import load_model
+
+    return load_model(path)
+
+
+def cmd_train(args) -> int:
+    from .datasets import DataSet, ListDataSetIterator
+    from .optimize import ScoreIterationListener
+
+    net = _build_model(args)
+    xs, ys = _load_data(args.data, train=True, num_classes=_num_classes_of(net))
+    it = ListDataSetIterator(DataSet(xs, ys).shuffle(args.seed)
+                             .batch_by(args.batch_size))
+    listeners = [ScoreIterationListener(args.print_every)]
+    storage = None
+    if args.dashboard:
+        from .ui import InMemoryStatsStorage, StatsListener
+
+        storage = InMemoryStatsStorage()
+        listeners.append(StatsListener(storage, session_id="cli_train"))
+    net.set_listeners(*listeners)
+    losses = net.fit(it, epochs=args.epochs)
+    print(f"trained {args.epochs} epoch(s), {len(losses)} iterations, "
+          f"final loss {losses[-1]:.5f}")
+    if args.dashboard:
+        from .ui import render_dashboard
+
+        render_dashboard(storage, args.dashboard)
+        print(f"dashboard: {args.dashboard}")
+    if args.output:
+        net.save(args.output)
+        print(f"saved: {args.output}")
+    return 0
+
+
+def cmd_evaluate(args) -> int:
+    net = _load_model(args.model)
+    xs, ys = _load_data(args.data, train=False,
+                        num_classes=_num_classes_of(net))
+    ev = net.evaluate((xs, ys))
+    print(ev.stats() if hasattr(ev, "stats") else
+          f"accuracy: {ev.accuracy():.4f}")
+    return 0
+
+
+def cmd_predict(args) -> int:
+    net = _load_model(args.model)
+    data = np.load(args.input)
+    x = data["x"] if "x" in data else data[data.files[0]]
+    out = net.output(np.asarray(x, np.float32))
+    out = out[0] if isinstance(out, list) else out
+    np.savez(args.output, predictions=out)
+    print(f"wrote {out.shape} predictions to {args.output}")
+    return 0
+
+
+def cmd_summary(args) -> int:
+    net = _load_model(args.model)
+    from .nn.conf.memory import memory_report
+
+    print(f"model: {type(net).__name__}, {net.num_params():,} params")
+    try:
+        print(memory_report(net, minibatch=args.batch_size))
+    except Exception as e:  # graphs have no memory_report yet
+        print(f"(memory report unavailable: {e})")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="deeplearning4j_tpu",
+                                description=__doc__.split("\n")[0])
+    sub = p.add_subparsers(dest="command", required=True)
+
+    t = sub.add_parser("train", help="train a model")
+    t.add_argument("--zoo", help="zoo model name (e.g. lenet)")
+    t.add_argument("--zoo-args", help="JSON kwargs for the zoo constructor")
+    t.add_argument("--config", help="MultiLayerConfiguration JSON file")
+    t.add_argument("--data", required=True,
+                   help="builtin name (mnist/cifar10/iris/emnist/svhn/uci) or .npz")
+    t.add_argument("--epochs", type=int, default=1)
+    t.add_argument("--batch-size", type=int, default=128)
+    t.add_argument("--seed", type=int, default=12345)
+    t.add_argument("--print-every", type=int, default=10)
+    t.add_argument("--output", help="checkpoint zip to write")
+    t.add_argument("--dashboard", help="HTML training report to write")
+    t.set_defaults(fn=cmd_train)
+
+    e = sub.add_parser("evaluate", help="evaluate a saved model")
+    e.add_argument("--model", required=True)
+    e.add_argument("--data", required=True)
+    e.set_defaults(fn=cmd_evaluate)
+
+    r = sub.add_parser("predict", help="run inference")
+    r.add_argument("--model", required=True)
+    r.add_argument("--input", required=True, help=".npz with array 'x'")
+    r.add_argument("--output", required=True, help=".npz to write")
+    r.set_defaults(fn=cmd_predict)
+
+    s = sub.add_parser("summary", help="model + memory summary")
+    s.add_argument("--model", required=True)
+    s.add_argument("--batch-size", type=int, default=32)
+    s.set_defaults(fn=cmd_summary)
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

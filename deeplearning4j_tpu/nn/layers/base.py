@@ -71,8 +71,20 @@ def config_to_dict(obj: Any) -> Any:
     return obj
 
 
+#: config types that live outside the eagerly-imported nn tree — imported
+#: on first deserialization so saved models load in fresh processes
+_LAZY_CONFIG_PROVIDERS = {
+    "MoE": "deeplearning4j_tpu.parallel.moe",
+}
+
+
 def config_from_dict(d: Any) -> Any:
     """Inverse of config_to_dict."""
+    if isinstance(d, dict) and "type" in d and d["type"] not in CONFIG_REGISTRY \
+            and d["type"] in _LAZY_CONFIG_PROVIDERS:
+        import importlib
+
+        importlib.import_module(_LAZY_CONFIG_PROVIDERS[d["type"]])
     if isinstance(d, dict) and "type" in d and d["type"] in CONFIG_REGISTRY:
         cls = CONFIG_REGISTRY[d["type"]]
         fields = {f.name for f in dataclasses.fields(cls)}

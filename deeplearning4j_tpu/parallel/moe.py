@@ -90,36 +90,47 @@ def capacity(n_tokens: int, n_experts: int, k: int,
 
 def moe_forward_ep(params: Dict[str, Array], x: Array, mesh: Mesh,
                    expert_axis: str = "model", k: int = 2,
-                   capacity_factor: float = 1.25) -> Tuple[Array, Array]:
-    """Expert-parallel MoE over ``expert_axis``.
+                   capacity_factor: float = 1.25,
+                   data_axis: Optional[str] = "data") -> Tuple[Array, Array]:
+    """Expert-parallel MoE over ``expert_axis``, DP-composable.
 
-    Experts are sharded over the axis; tokens are replicated on it (shard
-    them over ``data`` as usual).  Each shard builds dispatch/combine
-    one-hots for its LOCAL experts only, computes its capacity slots, and
-    a single psum merges the gate-weighted expert outputs.  Dropped
-    (over-capacity) tokens contribute zero, exactly like Switch.
+    Experts are sharded over ``expert_axis``; tokens are sharded over
+    ``data_axis`` (when the mesh has one) and replicated over the expert
+    axis.  Each shard builds dispatch/combine one-hots for its LOCAL
+    experts on its LOCAL tokens, computes its capacity slots, and a psum
+    over the expert axis merges the gate-weighted expert outputs.
+    Capacity is per data shard (each shard routes its own tokens).
+    Dropped (over-capacity) tokens contribute zero, exactly like Switch.
     """
     E = params["Wg"].shape[-1]
     M = mesh.shape[expert_axis]
     if E % M:
         raise ValueError(f"n_experts {E} not divisible by {expert_axis} "
                          f"axis size {M}")
+    if data_axis is not None and data_axis not in mesh.shape:
+        data_axis = None
+    D = mesh.shape[data_axis] if data_axis else 1
     N = x.shape[0]
-    C = capacity(N, E, k, capacity_factor)
+    if N % D:
+        raise ValueError(f"token count {N} not divisible by {data_axis} "
+                         f"axis size {D}")
+    C = capacity(N // D, E, k, capacity_factor)
     e_loc = E // M
 
     expert_keys = ("W1", "b1", "W2", "b2")
     in_specs = (
         {kk: (P(expert_axis) if kk in expert_keys else P())
          for kk in params},
-        P(),            # x replicated over the expert axis
+        P(data_axis),   # tokens sharded over data, replicated over experts
     )
-    out_specs = (P(), P())
+    out_specs = (P(data_axis), P())
 
     def shard_fn(p, xs):
         idx = jax.lax.axis_index(expert_axis)
-        gates, aux = _router(p, xs, k)          # router replicated → identical
+        gates, aux = _router(p, xs, k)          # identical across expert axis
         aux = aux / M                           # psum'd below → global value
+        if data_axis:
+            aux = jax.lax.pmean(aux, data_axis)  # average over token shards
         local_gates = jax.lax.dynamic_slice_in_dim(
             gates, idx * e_loc, e_loc, axis=1)  # [N, e_loc]
         # position of each token within its expert's capacity buffer:

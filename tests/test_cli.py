@@ -1,0 +1,91 @@
+"""CLI: train → save → evaluate → predict → summary round trip."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.cli import main
+
+
+@pytest.fixture()
+def blob_npz(tmp_path):
+    rng = np.random.default_rng(0)
+    xs = np.concatenate([rng.normal(-2, 1, (96, 6)),
+                         rng.normal(2, 1, (96, 6))]).astype(np.float32)
+    ys = np.concatenate([np.zeros(96, np.int64), np.ones(96, np.int64)])
+    path = str(tmp_path / "blobs.npz")
+    np.savez(path, x=xs, y=ys)
+    return path
+
+
+@pytest.fixture()
+def conf_json(tmp_path):
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    conf = (NeuralNetConfiguration.builder().seed(1).updater(Adam(lr=0.02))
+            .layer(Dense(n_out=12, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    path = str(tmp_path / "conf.json")
+    with open(path, "w") as f:
+        json.dump(conf.to_dict(), f)
+    return path
+
+
+class TestCli:
+    def test_full_round_trip(self, tmp_path, blob_npz, conf_json, capsys):
+        model = str(tmp_path / "model.zip")
+        dash = str(tmp_path / "report.html")
+        rc = main(["train", "--config", conf_json, "--data", blob_npz,
+                   "--epochs", "8", "--batch-size", "64",
+                   "--output", model, "--dashboard", dash])
+        assert rc == 0 and os.path.exists(model) and os.path.exists(dash)
+        out = capsys.readouterr().out
+        assert "final loss" in out
+
+        rc = main(["evaluate", "--model", model, "--data", blob_npz])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "Accuracy" in out or "accuracy" in out
+
+        preds = str(tmp_path / "preds.npz")
+        rc = main(["predict", "--model", model, "--input", blob_npz,
+                   "--output", preds])
+        assert rc == 0
+        p = np.load(preds)["predictions"]
+        assert p.shape == (192, 2)
+
+        rc = main(["summary", "--model", model, "--batch-size", "16"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "params" in out
+
+    def test_zoo_training(self, tmp_path, capsys):
+        rng = np.random.default_rng(1)
+        xs = rng.normal(size=(64, 28, 28, 1)).astype(np.float32)
+        ys = rng.integers(0, 10, 64).astype(np.int64)
+        data = str(tmp_path / "imgs.npz")
+        np.savez(data, x=xs, y=ys)
+        rc = main(["train", "--zoo", "lenet",
+                   "--zoo-args", '{"height": 28, "width": 28, "channels": 1,'
+                   ' "num_classes": 10}',
+                   "--data", data, "--epochs", "1", "--batch-size", "32"])
+        assert rc == 0
+        assert "final loss" in capsys.readouterr().out
+
+    def test_unknown_zoo_rejected(self, blob_npz):
+        with pytest.raises(SystemExit, match="unknown zoo"):
+            main(["train", "--zoo", "nope", "--data", blob_npz])
+
+    def test_module_entrypoint(self):
+        r = subprocess.run([sys.executable, "-m", "deeplearning4j_tpu",
+                            "--help"], capture_output=True, text=True,
+                           cwd="/root/repo", timeout=120)
+        assert r.returncode == 0 and "train" in r.stdout
