@@ -11,6 +11,7 @@ Native layouts: CNN = NHWC [mb,h,w,c]; RNN = [mb,t,f].
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -167,4 +168,25 @@ class Composable(Preprocessor):
     def output_type(self, in_type: InputType) -> InputType:
         for s in self.steps:
             in_type = s.output_type(in_type)
+        return in_type
+
+
+@register_config
+@dataclasses.dataclass
+class BinomialSampling(Preprocessor):
+    """Bernoulli-sample activations in [0,1] (reference
+    BinomialSamplingPreProcessor — DBN-style stochastic binarization).
+
+    During training the container passes its per-step rng (``wants_rng``),
+    so every step draws FRESH noise; outside a training step (inference,
+    standalone apply) the fixed ``seed`` gives a deterministic sample."""
+
+    seed: int = 12345
+    wants_rng = True  # ClassVar: container threads its per-step key in
+
+    def apply(self, x: Array, rng: Optional[Array] = None) -> Array:
+        key = rng if rng is not None else jax.random.PRNGKey(self.seed)
+        return jax.random.bernoulli(key, jnp.clip(x, 0.0, 1.0)).astype(x.dtype)
+
+    def output_type(self, in_type: InputType) -> InputType:
         return in_type

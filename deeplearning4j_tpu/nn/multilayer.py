@@ -246,7 +246,13 @@ class MultiLayerNetwork:
         for i in range(n):
             layer = self.conf.layers[i]
             if i in self.conf.preprocessors:
-                x = self.conf.preprocessors[i].apply(x)
+                pre = self.conf.preprocessors[i]
+                if getattr(pre, "wants_rng", False) and keys[i] is not None:
+                    # stochastic preprocessors (BinomialSampling) draw fresh
+                    # noise from the per-step stream during training
+                    x = pre.apply(x, rng=jax.random.fold_in(keys[i], 13))
+                else:
+                    x = pre.apply(x)
             kwargs = {}
             if layer.recurrent and carries is not None:
                 kwargs["carry"] = carries[i]
@@ -267,7 +273,11 @@ class MultiLayerNetwork:
             params, state, x, train=train, rng=rng, mask=mask, upto=n - 1, carries=carries)
         last = self.conf.layers[n - 1]
         if (n - 1) in self.conf.preprocessors:
-            h = self.conf.preprocessors[n - 1].apply(h)
+            pre = self.conf.preprocessors[n - 1]
+            if getattr(pre, "wants_rng", False) and rng is not None:
+                h = pre.apply(h, rng=jax.random.fold_in(rng, 20_000 + n))
+            else:
+                h = pre.apply(h)
         if train and rng is not None:
             # output layers honor input dropout too (reference BaseOutputLayer);
             # _maybe_dropout no-ops when the layer has no dropout configured
