@@ -37,13 +37,27 @@ Array = jax.Array
 
 
 def _lstm_cell(cfg, params, carry, x_t, mask_t=None, suffix=""):
-    """One LSTM step.  carry = (h, c); x_t [mb, n_in]; mask_t [mb] or None."""
+    """One LSTM step.  carry = (h, c); x_t [mb, n_in]; mask_t [mb] or None.
+
+    The standard sigmoid/tanh non-peephole cell routes its elementwise
+    gate math through the fused pallas kernel (ops/lstm_kernel.py, the
+    SURVEY M0 deliverable); custom activations and peepholes use the
+    general path."""
     h, c = carry
     W = params["W" + suffix].astype(x_t.dtype)
     RW = params["RW" + suffix].astype(x_t.dtype)
     b = params["b" + suffix].astype(x_t.dtype)
     z = x_t @ W + h @ RW + b  # [mb, 4*n_out]
     n = cfg.n_out
+    if (not cfg.peephole and cfg.gate_activation == "sigmoid"
+            and cfg.activation == "tanh"):
+        from ...ops.lstm_kernel import fused_lstm_cell
+        h_new, c_new = fused_lstm_cell(z, c)
+        if mask_t is not None:
+            m = mask_t[:, None].astype(h_new.dtype)
+            h_new = m * h_new + (1 - m) * h
+            c_new = m * c_new + (1 - m) * c
+        return (h_new, c_new)
     zi, zf, zo, zg = z[:, :n], z[:, n:2 * n], z[:, 2 * n:3 * n], z[:, 3 * n:]
     gate = get_activation(cfg.gate_activation)
     act = get_activation(cfg.activation)

@@ -1,0 +1,148 @@
+"""Fused LSTM cell — the SURVEY M0 pallas kernel.
+
+The cell's matmuls (x·W + h·RW) stay in XLA where the MXU already runs
+them optimally; what XLA lowers as ~8 separate elementwise HLOs (three
+sigmoids, two tanhs, three multiplies, one add — each a round trip
+through HBM at [mb, n] granularity inside the scan body) is fused here
+into ONE pallas VMEM pass per direction: forward computes (h', c') from
+the preactivation z=[i|f|o|g] and c, backward recomputes the gates from
+the saved (z, c) residuals and emits (dz, dc) in a single fused kernel.
+
+Seams mirror ops/attention.py's flash kernel: compiled on TPU,
+interpret-mode on CPU (tests), plain jax.numpy fallback for f64 (exact
+gradient checks), other backends, or tile-unfriendly widths.  Gate order
+matches nn/layers/recurrent.py: [i, f, o, g].
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+try:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
+
+
+def _plain_cell(z: jax.Array, c: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    n = c.shape[-1]
+    i = jax.nn.sigmoid(z[:, :n])
+    f = jax.nn.sigmoid(z[:, n:2 * n])
+    o = jax.nn.sigmoid(z[:, 2 * n:3 * n])
+    g = jnp.tanh(z[:, 3 * n:])
+    c_new = f * c + i * g
+    return o * jnp.tanh(c_new), c_new
+
+
+def _bwd_math(z: jax.Array, c: jax.Array, dh: jax.Array,
+              dcn: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Closed-form cell backward (single source of truth — used by the
+    pallas backward kernel AND the plain fallback): recomputes the gates
+    from the (z, c) residuals, returns (dz, dc)."""
+    n = c.shape[-1]
+    i = jax.nn.sigmoid(z[:, :n])
+    f = jax.nn.sigmoid(z[:, n:2 * n])
+    o = jax.nn.sigmoid(z[:, 2 * n:3 * n])
+    g = jnp.tanh(z[:, 3 * n:])
+    c_new = f * c + i * g
+    tc = jnp.tanh(c_new)
+    do = dh * tc
+    dct = dcn + dh * o * (1.0 - tc * tc)
+    dz = jnp.concatenate([
+        dct * g * i * (1.0 - i),
+        dct * c * f * (1.0 - f),
+        do * o * (1.0 - o),
+        dct * i * (1.0 - g * g),
+    ], axis=1)
+    return dz, dct * f
+
+
+def _fwd_kernel(z_ref, c_ref, h_out, c_out, *, n: int):
+    h, c_new = _plain_cell(z_ref[...], c_ref[...])
+    h_out[...] = h
+    c_out[...] = c_new
+
+
+def _bwd_kernel(z_ref, c_ref, dh_ref, dcn_ref, dz_out, dc_out, *, n: int):
+    dz, dc = _bwd_math(z_ref[...], c_ref[...], dh_ref[...], dcn_ref[...])
+    dz_out[...] = dz
+    dc_out[...] = dc
+
+
+def _use_pallas(z: jax.Array, n: int) -> bool:
+    if not _HAS_PALLAS or z.dtype == jnp.float64:
+        return False
+    if jax.default_backend() not in ("tpu", "cpu"):
+        return False
+    # small widths don't fill the 128-wide VPU lanes — XLA's fused
+    # elementwise is already fine there, so keep the plain path
+    return n >= 128
+
+
+def _pallas_call(kernel, z, *args, out_shapes, n):
+    mb = z.shape[0]
+    bm = mb if mb <= 256 else 256
+    while mb % bm:
+        bm -= 1
+    if bm < 8:   # prime/odd batches → degenerate 1-row tiles; caller falls back
+        return None
+    grid = (mb // bm,)
+
+    def spec(width):
+        return pl.BlockSpec((bm, width), lambda b: (b, 0))
+
+    widths = [a.shape[1] for a in (z,) + args]
+    return pl.pallas_call(
+        functools.partial(kernel, n=n),
+        grid=grid,
+        in_specs=[spec(w) for w in widths],
+        out_specs=[spec(s[1]) for s in out_shapes],
+        out_shape=[jax.ShapeDtypeStruct(s, z.dtype) for s in out_shapes],
+        interpret=(jax.default_backend() == "cpu"),
+    )(z, *args)
+
+
+@jax.custom_vjp
+def fused_lstm_cell(z: jax.Array, c: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """(h', c') from preactivations z [mb, 4n] (gate order [i|f|o|g]) and
+    cell state c [mb, n].  One fused VMEM pass on TPU; exact fallbacks
+    elsewhere."""
+    n = c.shape[-1]
+    if not _use_pallas(z, n):
+        return _plain_cell(z, c)
+    out = _pallas_call(_fwd_kernel, z, c,
+                       out_shapes=[(z.shape[0], n), (z.shape[0], n)], n=n)
+    if out is None:   # no viable batch tiling
+        return _plain_cell(z, c)
+    return out[0], out[1]
+
+
+def _cell_fwd(z, c):
+    out = fused_lstm_cell(z, c)
+    return out, (z, c)
+
+
+def _cell_bwd(res, cts):
+    z, c = res
+    dh, dcn = cts
+    n = c.shape[-1]
+    # cotangents can arrive as zeros with a different weak type; normalize
+    dh = jnp.asarray(dh, z.dtype)
+    dcn = jnp.asarray(dcn, z.dtype)
+    if not _use_pallas(z, n):
+        return _bwd_math(z, c, dh, dcn)   # exact, f64-safe
+    out = _pallas_call(_bwd_kernel, z, c, dh, dcn,
+                       out_shapes=[z.shape, c.shape], n=n)
+    if out is None:
+        return _bwd_math(z, c, dh, dcn)
+    return out[0], out[1]
+
+
+fused_lstm_cell.defvjp(_cell_fwd, _cell_bwd)
