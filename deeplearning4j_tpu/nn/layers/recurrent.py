@@ -39,10 +39,11 @@ Array = jax.Array
 def _lstm_cell(cfg, params, carry, x_t, mask_t=None, suffix=""):
     """One LSTM step.  carry = (h, c); x_t [mb, n_in]; mask_t [mb] or None.
 
-    The standard sigmoid/tanh non-peephole cell routes its elementwise
-    gate math through the fused pallas kernel (ops/lstm_kernel.py, the
-    SURVEY M0 deliverable); custom activations and peepholes use the
-    general path."""
+    The standard sigmoid/tanh non-peephole cell calls
+    ops/lstm_kernel.fused_lstm_cell — which resolves to XLA's (faster,
+    epilogue-fused) plain math by default and to the pallas kernel when
+    opted in via DL4J_TPU_FUSED_LSTM=1; custom activations and peepholes
+    use the general path."""
     h, c = carry
     W = params["W" + suffix].astype(x_t.dtype)
     RW = params["RW" + suffix].astype(x_t.dtype)

@@ -11,6 +11,13 @@ from deeplearning4j_tpu.ops import lstm_kernel
 from deeplearning4j_tpu.ops.lstm_kernel import _plain_cell, fused_lstm_cell
 
 
+@pytest.fixture(autouse=True)
+def enable_kernel(monkeypatch):
+    """The kernel is opt-in (XLA epilogue fusion wins at common sizes);
+    parity tests exercise the pallas path explicitly."""
+    monkeypatch.setattr(lstm_kernel, "ENABLED", True)
+
+
 def zc(mb=8, n=128, seed=0, dtype=jnp.float32):
     k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
     z = jax.random.normal(k1, (mb, 4 * n), dtype)
