@@ -13,4 +13,5 @@ from .vocab import VocabCache, VocabWord, build_vocab, Huffman
 from .word2vec import Word2Vec
 from .sequencevectors import SequenceVectors, ParagraphVectors, WordVectorsBase
 from .glove import Glove, CoOccurrences
+from .distributed import DistributedWord2Vec
 from .serializer import write_word_vectors, read_word_vectors

@@ -58,14 +58,12 @@ def _occurrence_scale(indices: jnp.ndarray, vocab_size: int,
     return weights / jnp.maximum(counts[indices], 1.0)
 
 
-def _sg_chunk(syn0, syn1, centers, contexts, negatives, valid, lr):
-    """Skip-gram negative-sampling sparse update (one micro-chunk).
-
-    centers [B], contexts [B], negatives [B,K], valid [B] (0 = pad row).
-    Classic updates (Mikolov 2013):
-        for target t with label l:  g = (l - σ(v·u_t)) * lr
-        v      += Σ g * u_t ;  u_t += g * v
-    """
+def _sg_pair_grads(syn0, syn1, centers, contexts, negatives, valid, lr):
+    """Shared skip-gram pair gradients (Mikolov 2013):
+        for target t with label l:  g = (l − σ(v·u_t)) · lr
+    → (dv [B,D], du_flat [B·(1+K),D], flat_t, flat_tw).  Single source of
+    truth for the local step (_sg_chunk) and the mesh-sharded step
+    (nlp/distributed.py)."""
     v = syn0[centers]                         # [B,D]
     targets = jnp.concatenate([contexts[:, None], negatives], axis=1)  # [B,1+K]
     labels = jnp.zeros(targets.shape, syn0.dtype).at[:, 0].set(1.0)
@@ -76,11 +74,18 @@ def _sg_chunk(syn0, syn1, centers, contexts, negatives, valid, lr):
     du = g[..., None] * v[:, None, :]         # [B,1+K,D]
     flat_t = targets.reshape(-1)
     flat_tw = jnp.broadcast_to(valid[:, None], targets.shape).reshape(-1)
+    return dv, du.reshape(-1, du.shape[-1]), flat_t, flat_tw
+
+
+def _sg_chunk(syn0, syn1, centers, contexts, negatives, valid, lr):
+    """Skip-gram negative-sampling sparse update (one micro-chunk).
+    centers [B], contexts [B], negatives [B,K], valid [B] (0 = pad row)."""
+    dv, du_flat, flat_t, flat_tw = _sg_pair_grads(
+        syn0, syn1, centers, contexts, negatives, valid, lr)
     syn0 = syn0.at[centers].add(
         dv * _occurrence_scale(centers, syn0.shape[0], valid)[:, None])
     syn1 = syn1.at[flat_t].add(
-        du.reshape(-1, du.shape[-1])
-        * _occurrence_scale(flat_t, syn1.shape[0], flat_tw)[:, None])
+        du_flat * _occurrence_scale(flat_t, syn1.shape[0], flat_tw)[:, None])
     return syn0, syn1
 
 
@@ -339,6 +344,13 @@ class SequenceVectors(WordVectorsBase):
 
     # ------------------------------------------------------------------
 
+    def _sg_step(self, syn0, syn1, centers, contexts, negatives, valid, lr,
+                 chunks=1):
+        """Skip-gram update seam — DistributedWord2Vec overrides this with
+        the mesh-sharded step (nlp/distributed.py)."""
+        return _sg_neg_step(syn0, syn1, centers, contexts, negatives, valid,
+                            lr, chunks)
+
     def fit_sequences(self,
                       sequences: Sequence[Sequence[Hashable]],
                       labels: Optional[Sequence[Hashable]] = None) -> "SequenceVectors":
@@ -471,10 +483,10 @@ class SequenceVectors(WordVectorsBase):
             else:
                 negs = rng.choice(len(unigram), size=(self.batch_size, self.negative),
                                   p=unigram).astype(np.int32)
-                syn0, syn1 = _sg_neg_step(syn0, syn1, jnp.asarray(centers),
-                                          jnp.asarray(targets), jnp.asarray(negs),
-                                          jnp.asarray(valid), lr_j,
-                                          chunk_divisor(16) if dbow else 1)
+                syn0, syn1 = self._sg_step(syn0, syn1, jnp.asarray(centers),
+                                           jnp.asarray(targets), jnp.asarray(negs),
+                                           jnp.asarray(valid), lr_j,
+                                           chunk_divisor(16) if dbow else 1)
             pairs_c, pairs_t, cbow_ctx = [], [], []
 
         use_cbow_path = self.cbow or (labels is not None and self.dm
