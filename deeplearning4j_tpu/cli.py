@@ -157,10 +157,7 @@ def cmd_summary(args) -> int:
     from .nn.conf.memory import memory_report
 
     print(f"model: {type(net).__name__}, {net.num_params():,} params")
-    try:
-        print(memory_report(net, minibatch=args.batch_size))
-    except Exception as e:  # graphs have no memory_report yet
-        print(f"(memory report unavailable: {e})")
+    print(memory_report(net, minibatch=args.batch_size))
     return 0
 
 

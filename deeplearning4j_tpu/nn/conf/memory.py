@@ -91,21 +91,56 @@ def _updater_copies(updater) -> int:
     return 1
 
 
+def _safe_elems(out_t) -> int:
+    if out_t is None:
+        return 0
+    try:
+        return out_t.flat_size()
+    except ValueError:   # variable-length recurrent
+        return out_t.size
+
+
 def memory_report(net, minibatch: int = 32) -> NetworkMemoryReport:
-    """Estimate memory for an initialized MultiLayerNetwork
-    (reference MultiLayerConfiguration.getMemoryReport)."""
+    """Estimate memory for an initialized MultiLayerNetwork or
+    ComputationGraph (reference MultiLayerConfiguration /
+    ComputationGraphConfiguration .getMemoryReport)."""
     conf = net.conf
     pbytes = np.dtype(conf.param_dtype).itemsize
     abytes = np.dtype(conf.compute_dtype).itemsize
     reports: List[LayerMemoryReport] = []
+
+    if hasattr(conf, "vertices"):  # ComputationGraph
+        import jax
+
+        for spec in conf.vertices:
+            p = net.params.get(spec.name, {})
+            pcount = sum(int(np.prod(a.shape))
+                         for a in jax.tree_util.tree_leaves(p))
+            layer = getattr(spec.vertex, "layer", None)
+            upd = (layer.updater if layer is not None and layer.updater is not None
+                   else conf.updater)
+            act_elems = _safe_elems(net.vertex_out_types.get(spec.name))
+            reports.append(LayerMemoryReport(
+                name=spec.name,
+                layer_type=(type(layer).__name__ if layer is not None
+                            else type(spec.vertex).__name__),
+                param_count=pcount,
+                param_bytes=pcount * pbytes,
+                updater_state_bytes=pcount * pbytes * _updater_copies(upd),
+                activation_elements_per_example=act_elems,
+                activation_bytes_per_example=act_elems * abytes,
+            ))
+        return NetworkMemoryReport(reports, minibatch, conf.param_dtype,
+                                   conf.compute_dtype)
+
+    import jax
+
     for i, layer in enumerate(conf.layers):
-        pcount = sum(int(np.prod(a.shape)) for a in net.params[i].values()) \
-            if i < len(net.params) and net.params[i] else 0
+        pcount = sum(int(np.prod(a.shape))
+                     for a in jax.tree_util.tree_leaves(
+                         net.params[i] if i < len(net.params) else {}))
         out_t = layer.output_type(net.input_types[i]) if net.input_types else None
-        try:
-            act_elems = out_t.flat_size() if out_t is not None else 0
-        except ValueError:   # variable-length recurrent
-            act_elems = out_t.size if out_t is not None else 0
+        act_elems = _safe_elems(out_t)
         upd = layer.updater if layer.updater is not None else conf.updater
         reports.append(LayerMemoryReport(
             name=layer.name or f"layer_{i}",

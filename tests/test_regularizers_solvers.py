@@ -171,6 +171,18 @@ class TestRBM:
 
 
 class TestMemoryReport:
+    def test_graph_report(self):
+        """ComputationGraph memory reports (the CLI summary path)."""
+        from deeplearning4j_tpu.nn.graph import ComputationGraph
+        from deeplearning4j_tpu.models import ResNet50
+        net = ResNet50(height=32, width=32, channels=3, num_classes=10)
+        net.init()
+        assert isinstance(net, ComputationGraph)
+        rep = memory_report(net, minibatch=16)
+        assert rep.total_param_bytes == 4 * net.num_params()
+        assert rep.total_activation_bytes > 0
+        assert "TOTAL" in str(rep)
+
     def test_report_counts_and_renders(self):
         net = build_net()
         rep = memory_report(net, minibatch=64)
