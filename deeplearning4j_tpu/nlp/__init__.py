@@ -14,4 +14,4 @@ from .word2vec import Word2Vec
 from .sequencevectors import SequenceVectors, ParagraphVectors, WordVectorsBase
 from .glove import Glove, CoOccurrences
 from .distributed import DistributedWord2Vec
-from .serializer import write_word_vectors, read_word_vectors
+from .serializer import load_static_model, read_word_vectors, write_word_vectors

@@ -72,3 +72,27 @@ def read_word_vectors(path: str, binary: bool = False) -> Dict[str, np.ndarray]:
                 continue
             out[parts[0]] = np.asarray([float(x) for x in parts[1:d + 1]], np.float32)
     return out
+
+
+def load_static_model(path: str, binary: bool = False):
+    """Saved vectors → a queryable read-only WordVectors table with the
+    full lookup API (similarity / words_nearest / words_nearest_vector) —
+    the reference's WordVectorSerializer.loadStaticModel: embeddings
+    usable for inference without the trainer."""
+    from .sequencevectors import WordVectorsBase
+    from .vocab import VocabCache
+
+    pairs = read_word_vectors(path, binary=binary)
+    if not pairs:
+        raise ValueError(f"{path}: no vectors found")
+
+    model = WordVectorsBase()
+    vocab = VocabCache()
+    rows = []
+    for word, vec in pairs.items():
+        vocab.add(word, 1)
+        rows.append(np.asarray(vec, np.float32))
+    model.vocab = vocab
+    model.syn0 = np.stack(rows)
+    model._norms = None
+    return model

@@ -352,6 +352,50 @@ class TestFunctionalImport:
         want = (ha + hb) @ Wo + bo
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
 
+    def test_functional_lstm_last_timestep(self, tmp_path):
+        """Functional model ending in LSTM(return_sequences=False): the
+        importer must wire a LastTimeStepVertex and point the output at it."""
+        rng = _rng()
+        n_in, units, T = 3, 4, 5
+        K = rng.normal(size=(n_in, 4 * units), scale=0.5).astype(np.float32)
+        R = rng.normal(size=(units, 4 * units), scale=0.5).astype(np.float32)
+        b = np.zeros((4 * units,), np.float32)
+        cfg = {"class_name": "Model", "config": {
+            "layers": [
+                {"class_name": "InputLayer", "config": {
+                    "name": "in", "batch_input_shape": [None, T, n_in]},
+                 "inbound_nodes": []},
+                {"class_name": "LSTM", "config": {
+                    "name": "lstm", "units": units, "activation": "tanh",
+                    "recurrent_activation": "sigmoid",
+                    "return_sequences": False},
+                 "inbound_nodes": [[["in", 0, 0, {}]]]},
+            ],
+            "input_layers": [["in", 0, 0]],
+            "output_layers": [["lstm", 0, 0]],
+        }}
+        path = str(tmp_path / "flstm.h5")
+        _write_keras_file(path, cfg, None, {"lstm": {
+            "lstm/kernel:0": K, "lstm/recurrent_kernel:0": R, "lstm/bias:0": b}})
+        graph = import_keras_model_and_weights(path)
+        x = rng.normal(size=(2, T, n_in)).astype(np.float32)
+        got = graph.output(x)[0]
+        assert got.shape == (2, units)  # last step only, not (2, T, units)
+
+        def sig(v):
+            return 1.0 / (1.0 + np.exp(-v))
+
+        h = np.zeros((2, units), np.float32)
+        c = np.zeros((2, units), np.float32)
+        for t in range(T):
+            z = x[:, t] @ K + h @ R + b
+            i, f = sig(z[:, :units]), sig(z[:, units:2 * units])
+            g = np.tanh(z[:, 2 * units:3 * units])
+            o = sig(z[:, 3 * units:])
+            c = f * c + i * g
+            h = o * np.tanh(c)
+        np.testing.assert_allclose(got, h, rtol=1e-4, atol=1e-4)
+
     def test_concatenate_merge(self, tmp_path):
         rng = _rng()
         Wa = rng.normal(size=(3, 2)).astype(np.float32)

@@ -107,3 +107,19 @@ class TestWord2Vec:
     def test_empty_vocab_raises(self):
         with pytest.raises(ValueError, match="vocabulary"):
             Word2Vec(min_word_frequency=100).fit(["one two three"])
+
+    def test_load_static_model(self, tmp_path):
+        """WordVectorSerializer.loadStaticModel parity: saved vectors come
+        back as a queryable read-only lookup table."""
+        from deeplearning4j_tpu.nlp import load_static_model
+        w2v = Word2Vec(layer_size=16, min_word_frequency=2, epochs=4, seed=0)
+        w2v.fit(topic_corpus(200))
+        path = str(tmp_path / "static.txt")
+        write_word_vectors(w2v, path)
+        static = load_static_model(path)
+        np.testing.assert_allclose(static.word_vector("cat"),
+                                   w2v.word_vector("cat"), rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(static.similarity("cat", "dog"),
+                                   w2v.similarity("cat", "dog"), atol=1e-4)
+        assert set(static.words_nearest("cat", 3)) == \
+            set(w2v.words_nearest("cat", 3))
