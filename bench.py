@@ -211,11 +211,13 @@ def bench_word2vec_lstm():
     from deeplearning4j_tpu.datasets import DataSet
 
     # word2vec: words/sec — first fit pays jit compilation, second fit on a
-    # fresh model hits the jit cache (same batch shapes) = steady state
+    # fresh model hits the jit cache (same batch shapes) = steady state.
+    # Corpus large enough that fixed costs (vocab build, final table
+    # readback) amortize — the metric is steady-state training throughput.
     rng = np.random.default_rng(0)
     vocab = [f"w{i}" for i in range(2000)]
     sentences = [" ".join(rng.choice(vocab, size=20))
-                 for _ in range(40 if QUICK else 400)]
+                 for _ in range(100 if QUICK else 8000)]
     n_words = sum(len(s.split()) for s in sentences)
 
     def make_w2v():
@@ -228,18 +230,26 @@ def bench_word2vec_lstm():
     w2v_rate = n_words / (time.perf_counter() - t0)
 
     # char-LSTM: chars/sec through the REAL training path — fit_batch with
-    # the model's configured TBPTT(50) chunking, not a monolithic BPTT
+    # the model's configured TBPTT(50) chunking (all chunk steps fused into
+    # one scanned dispatch).  Characters ship as int32 indices — the
+    # TPU-native data layout (LSTM gathers its input-weight rows, the loss
+    # one-hots on device; numerically identical to one-hot inputs, see
+    # tests/test_recurrent.py) — and each step sees a different batch.
     batch, T, vocab_sz = 64, 100, 96
     net = TextGenerationLSTM(vocab_size=vocab_sz, updater=Adam(lr=1e-3))
-    ds = DataSet(rng.normal(size=(batch, T, vocab_sz)).astype(np.float32),
-                 np.eye(vocab_sz, dtype=np.float32)[
-                     rng.integers(0, vocab_sz, (batch, T))])
+    dss = [DataSet(rng.integers(0, vocab_sz, (batch, T)).astype(np.int32),
+                   rng.integers(0, vocab_sz, (batch, T)).astype(np.int32))
+           for _ in range(20)]
+    # fit_batch returns a LazyScore (loss stays on device) — steps chain
+    # without host round trips; sync explicitly at the window edges
     for _ in range(3):
-        net.fit_batch(ds)
+        net.fit_batch(dss[0])
+    _sync(net.params)
     steps = 5 if QUICK else 100
     t0 = time.perf_counter()
-    for _ in range(steps):
-        net.fit_batch(ds)
+    for i in range(steps):
+        net.fit_batch(dss[i % len(dss)])
+    _sync(net.params)
     sec = (time.perf_counter() - t0) / steps
     return [
         {"metric": "word2vec_words_per_sec", "value": round(w2v_rate, 1),
@@ -280,11 +290,15 @@ def bench_sharded_resnet(platform: str):
     # per-step host→device upload of the same 77MB batch
     ds = trainer.shard_dataset(ds)
     steps = 5 if QUICK else 100
+    # async fit path: losses stay device-resident, so the loop enqueues
+    # steps back-to-back; value-readback sync bounds the timed window
     for _ in range(3):
         trainer.fit_batch(ds)
+    _sync(net.params)
     t0 = time.perf_counter()
     for _ in range(steps):
         trainer.fit_batch(ds)
+    _sync(net.params)
     sec = (time.perf_counter() - t0) / steps
     grad_bytes = 2 * _param_bytes(net)
     return {"metric": "sharded_resnet50_images_per_sec",

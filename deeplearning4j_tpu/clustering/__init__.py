@@ -11,5 +11,7 @@ reason).
 
 from .kmeans import KMeansClustering
 from .knn import NearestNeighbors, pairwise_distances
+from .knn_server import NearestNeighborsClient, NearestNeighborsServer
 
-__all__ = ["KMeansClustering", "NearestNeighbors", "pairwise_distances"]
+__all__ = ["KMeansClustering", "NearestNeighbors", "pairwise_distances",
+           "NearestNeighborsClient", "NearestNeighborsServer"]

@@ -15,3 +15,12 @@ from .records import (
     RecordReaderDataSetIterator,
     SequenceRecordReaderDataSetIterator,
 )
+from .remote import (
+    LocalProvider,
+    RemoteDataSetIterator,
+    S3Provider,
+    StorageProvider,
+    load_dataset,
+    register_provider,
+    save_dataset,
+)

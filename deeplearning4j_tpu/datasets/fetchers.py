@@ -240,6 +240,54 @@ def load_tiny_imagenet(train: bool = True, allow_synthetic: bool = True,
     return _synthetic_images(synthetic_n, 64, 64, 3, 200, seed=50 if train else 51)
 
 
+def load_lfw(train: bool = True, allow_synthetic: bool = True,
+             synthetic_n: int = 256, min_faces_per_person: int = 2,
+             image_size: int = 250,
+             limit: Optional[int] = None) -> Tuple[np.ndarray, np.ndarray]:
+    """Labeled Faces in the Wild → ([n,S,S,3] float32 in [0,1], [n] int32).
+
+    Reads the standard ``lfw/<person_name>/<person>_NNNN.jpg`` layout
+    (reference LFWDataSetIterator.java:31 / LFWLoader); labels are person
+    indices over people with ≥ ``min_faces_per_person`` images, and the
+    deterministic 80/20 per-person split replaces the reference's random
+    train/test sampling.  Falls back to a synthetic surrogate when the
+    archive is absent (zero-egress environments)."""
+    root = os.path.join(data_dir(), "lfw")
+    if os.path.isdir(root):
+        from PIL import Image
+        people = sorted(d for d in os.listdir(root)
+                        if os.path.isdir(os.path.join(root, d)))
+        kept = []
+        for p in people:
+            files = sorted(f for f in os.listdir(os.path.join(root, p))
+                           if f.lower().endswith((".jpg", ".jpeg", ".png")))
+            if len(files) >= min_faces_per_person:
+                kept.append((p, files))
+        if not kept:
+            raise ValueError(f"no people with >= {min_faces_per_person} "
+                             f"faces under {root}")
+        xs_list, ys_list = [], []
+        for idx, (p, files) in enumerate(kept):
+            cut = max(1, int(len(files) * 0.8))
+            use = files[:cut] if train else files[cut:]
+            for fn in use:
+                img = Image.open(os.path.join(root, p, fn)).convert("RGB")
+                if img.size != (image_size, image_size):
+                    img = img.resize((image_size, image_size))
+                xs_list.append(np.asarray(img, np.float32) / 255.0)
+                ys_list.append(idx)
+                if limit and len(xs_list) >= limit:
+                    return np.stack(xs_list), np.asarray(ys_list, np.int32)
+        if not xs_list:  # tiny archives can have empty test splits
+            raise ValueError("empty LFW split — lower min_faces_per_person")
+        return np.stack(xs_list), np.asarray(ys_list, np.int32)
+    if not allow_synthetic:
+        raise FileNotFoundError(f"lfw/ not found under {data_dir()}")
+    logger.warning("LFW not found under %s — synthetic surrogate", data_dir())
+    return _synthetic_images(synthetic_n, image_size, image_size, 3, 5,
+                             seed=60 if train else 61)
+
+
 # ---------------------------------------------------------------------------
 # UCI synthetic control — sequence classification (reference
 # UciSequenceDataFetcher: 600 series × 60 steps, 6 classes)
@@ -348,6 +396,16 @@ def TinyImageNetDataSetIterator(batch_size: int, train: bool = True,
                                 seed: int = 123, **kw) -> ListDataSetIterator:
     xs, ys = load_tiny_imagenet(train=train, **kw)
     ds = DataSet(xs, _one_hot(ys, 200)).shuffle(seed)
+    return ListDataSetIterator(ds.batch_by(batch_size))
+
+
+def LFWDataSetIterator(batch_size: int, train: bool = True, seed: int = 123,
+                       **kw) -> ListDataSetIterator:
+    """Face classification batches (reference LFWDataSetIterator.java:31);
+    label width adapts to the people found in the archive."""
+    xs, ys = load_lfw(train=train, **kw)
+    n_classes = int(ys.max()) + 1
+    ds = DataSet(xs, _one_hot(ys, n_classes)).shuffle(seed)
     return ListDataSetIterator(ds.batch_by(batch_size))
 
 

@@ -349,6 +349,17 @@ def _map_dropout(cfg: dict) -> Layer:
     return d
 
 
+def _map_spatial_dropout(cfg: dict) -> Layer:
+    """SpatialDropout2D drops whole channels — mapping it to element-wise
+    Dropout would silently change fine-tuning noise structure (reference
+    KerasSpatialDropout → dl4j SpatialDropout)."""
+    from ..nn.conf.regularizers import SpatialDropout
+    d = DropoutLayer(dropout=SpatialDropout(
+        p=float(cfg.get("rate", cfg.get("p", 0.5)))))
+    d.name = cfg.get("name")
+    return d
+
+
 def _map_lstm(cfg: dict) -> Layer:
     # return_sequences=False is handled by the import loops, which append a
     # LastTimeStep layer / LastTimeStepVertex after this one
@@ -423,7 +434,7 @@ _LAYER_MAP: Dict[str, Callable[[dict], Layer]] = {
     "ELU": lambda c: ActivationLayer(
         activation=f"elu({float(c.get('alpha', 1.0))})"),
     "Dropout": _map_dropout,
-    "SpatialDropout2D": _map_dropout,
+    "SpatialDropout2D": _map_spatial_dropout,
     "LSTM": _map_lstm,
     "SimpleRNN": _map_simple_rnn,
     "Embedding": _map_embedding,
@@ -728,6 +739,18 @@ def _import_sequential(archive: Hdf5Archive, layer_dicts: List[dict],
 # ---------------------------------------------------------------------------
 
 
+def _check_concatenate_axis(cfg: dict, name: str, in_rank: Optional[int]) -> None:
+    """MergeVertex always concatenates the trailing axis; a Keras
+    Concatenate on any other axis would import silently wrong — reject it
+    loudly (mirrors the channels_first rejection)."""
+    axis = cfg.get("axis", -1)
+    ok = axis == -1 or (in_rank is not None and axis == in_rank - 1)
+    if not ok:
+        raise InvalidKerasConfigurationException(
+            f"Concatenate layer '{name}' uses axis={axis}; only the "
+            f"trailing feature axis (-1) is supported by MergeVertex")
+
+
 def _inbound_names(ld: dict) -> List[str]:
     """Flatten Keras inbound_nodes (nested [[name, node_idx, tensor_idx, {}]])."""
     nodes = ld.get("inbound_nodes", [])
@@ -811,6 +834,8 @@ def _import_functional(archive: Hdf5Archive, layer_dicts: List[dict],
             vertex_rank[name] = in_rank
             continue
         if cls in ("Concatenate", "Merge"):
+            if cls == "Concatenate":
+                _check_concatenate_axis(cfg, name, in_rank)
             builder.add_vertex(name, MergeVertex(), *inputs)
             keras_to_vertex[name] = name
             vertex_rank[name] = in_rank

@@ -8,7 +8,16 @@ negative-sampling objective — embedding gathers + batched dot products on
 the MXU, one XLA program per step, no lock-free mutation needed.
 """
 
-from .tokenization import DefaultTokenizerFactory, CommonPreprocessor
+from .tokenization import (
+    AggregatingSentenceIterator,
+    CJKTokenizerFactory,
+    CollectionSentenceIterator,
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    LineSentenceIterator,
+    get_tokenizer_factory,
+    register_tokenizer_factory,
+)
 from .vocab import VocabCache, VocabWord, build_vocab, Huffman
 from .word2vec import Word2Vec
 from .sequencevectors import SequenceVectors, ParagraphVectors, WordVectorsBase

@@ -108,6 +108,9 @@ class DistributedWord2Vec(Word2Vec):
         self.mesh = mesh
         self.data_axis = data_axis
         self._dp_step = make_dp_sg_step(mesh, data_axis)
+        # the sharded step has no multi-batch scan — dispatch one batch at a
+        # time (chunks stays 1; see _sg_step's loud failure for chunks>1)
+        self._device_batches = 1
 
     # SequenceVectors' flush calls _sg_neg_step via the module global; the
     # narrowest seam is overriding fit_sequences' step through this hook:

@@ -43,6 +43,53 @@ logger = logging.getLogger("deeplearning4j_tpu")
 # DeepWalk; see module docstring for the batching-vs-sequential rationale)
 # ---------------------------------------------------------------------------
 
+def _build_alias_table(p: np.ndarray):
+    """Walker alias-method tables for an arbitrary discrete distribution:
+    returns (prob [n], alias [n]); sample with  i ~ U{0..n-1}, u ~ U[0,1),
+    result = i if u < prob[i] else alias[i].  O(n) build, O(1) draws."""
+    n = len(p)
+    prob = np.asarray(p, np.float64) * n
+    alias = np.zeros(n, np.int64)
+    small = list(np.where(prob < 1.0)[0])
+    large = list(np.where(prob >= 1.0)[0])
+    while small and large:
+        s, l = small.pop(), large.pop()
+        alias[s] = l
+        prob[l] -= 1.0 - prob[s]
+        (small if prob[l] < 1.0 else large).append(l)
+    # leftovers are 1.0 up to float error
+    for i in small + large:
+        prob[i] = 1.0
+    return prob, alias
+
+
+@partial(jax.jit, static_argnums=(3, 4))
+def _device_negs(base_key, counters, tables, n_neg: int, rows: int):
+    """Sample negatives ON DEVICE via the alias tables: one (rows, n_neg)
+    draw per batch counter, keyed by fold_in(base, counter) so the draw for
+    batch i is a pure function of i — identical whether batches dispatch
+    alone or stacked, and at any mesh size.  Keeps ~20 bytes/pair of
+    negative indices off the (slow, ~50MB/s on a tunnelled TPU) host→device
+    link."""
+    nprob, nalias = tables
+    vocab = nprob.shape[0]
+
+    def one(i):
+        k1, k2 = jax.random.split(jax.random.fold_in(base_key, i))
+        idx = jax.random.randint(k1, (rows, n_neg), 0, vocab)
+        u = jax.random.uniform(k2, (rows, n_neg))
+        return jnp.where(u < nprob[idx], idx, nalias[idx]).astype(jnp.int32)
+
+    return jax.vmap(one)(counters).reshape(-1, n_neg)
+
+
+@partial(jax.jit, static_argnums=(0,))
+def _valid_mask(n: int, n_valid):
+    """[n] float mask with the first n_valid entries 1 — built on device so
+    the padded-tail mask costs a scalar upload, not n floats."""
+    return (jnp.arange(n) < n_valid).astype(jnp.float32)
+
+
 def _occurrence_scale(indices: jnp.ndarray, vocab_size: int,
                       weights: jnp.ndarray) -> jnp.ndarray:
     """weights/count(row) per entry: rows hit k times in one batch receive
@@ -92,24 +139,34 @@ def _sg_chunk(syn0, syn1, centers, contexts, negatives, valid, lr):
 @partial(jax.jit, static_argnums=(7,), donate_argnums=(0, 1))
 def _sg_neg_step(syn0, syn1, centers, contexts, negatives, valid, lr, chunks=1):
     """Skip-gram step; ``chunks`` > 1 scans micro-chunks that each re-read
-    the freshly updated tables.  Word2Vec uses chunks=1 (rows recur across
-    batches anyway); sequence-label training (DBOW) needs chunking because a
-    label's pairs are CONSECUTIVE — one batch would average them into a
-    single effective update (see _occurrence_scale)."""
+    the freshly updated tables.  Two users of the chunked path:
+      - DBOW label training: a label's pairs are CONSECUTIVE — one batch
+        would average them into a single effective update
+        (see _occurrence_scale), so micro-chunks restore sequentiality.
+      - dispatch amortization: the host stacks several LR-annotated batches
+        into one device call (``lr`` may be a [chunks] vector, one entry per
+        micro-chunk) — on a remote-TPU link this cuts per-step dispatch
+        latency by the stacking factor while keeping per-batch semantics
+        bit-identical to separate calls.
+    """
     if chunks <= 1:
         return _sg_chunk(syn0, syn1, centers, contexts, negatives, valid, lr)
 
+    lr_vec = jnp.broadcast_to(
+        jnp.reshape(jnp.asarray(lr, syn0.dtype), (-1,)), (chunks,))
+
     def body(tables, args):
         s0, s1 = tables
-        c, t, n, v = args
-        return _sg_chunk(s0, s1, c, t, n, v, lr), None
+        c, t, n, v, l = args
+        return _sg_chunk(s0, s1, c, t, n, v, l), None
 
     def split(a):
         return a.reshape(chunks, a.shape[0] // chunks, *a.shape[1:])
 
     (syn0, syn1), _ = jax.lax.scan(
         body, (syn0, syn1),
-        (split(centers), split(contexts), split(negatives), split(valid)))
+        (split(centers), split(contexts), split(negatives), split(valid),
+         lr_vec))
     return syn0, syn1
 
 
@@ -158,10 +215,13 @@ def _cbow_neg_step(syn0, syn1, context_windows, window_mask, targets_pos,
         return _cbow_chunk(syn0, syn1, context_windows, window_mask,
                            targets_pos, negatives, lr)
 
+    lr_vec = jnp.broadcast_to(
+        jnp.reshape(jnp.asarray(lr, syn0.dtype), (-1,)), (chunks,))
+
     def body(tables, args):
         s0, s1 = tables
-        c, m, t, n = args
-        return _cbow_chunk(s0, s1, c, m, t, n, lr), None
+        c, m, t, n, l = args
+        return _cbow_chunk(s0, s1, c, m, t, n, l), None
 
     def split(a):
         return a.reshape(chunks, a.shape[0] // chunks, *a.shape[1:])
@@ -169,7 +229,7 @@ def _cbow_neg_step(syn0, syn1, context_windows, window_mask, targets_pos,
     (syn0, syn1), _ = jax.lax.scan(
         body, (syn0, syn1),
         (split(context_windows), split(window_mask), split(targets_pos),
-         split(negatives)))
+         split(negatives), lr_vec))
     return syn0, syn1
 
 
@@ -203,17 +263,21 @@ def _sg_hs_step(syn0, syn1hs, centers, points, codes, code_mask, lr, chunks=1):
     if chunks <= 1:
         return _sg_hs_chunk(syn0, syn1hs, centers, points, codes, code_mask, lr)
 
+    lr_vec = jnp.broadcast_to(
+        jnp.reshape(jnp.asarray(lr, syn0.dtype), (-1,)), (chunks,))
+
     def body(tables, args):
         s0, s1 = tables
-        c, p, cd, m = args
-        return _sg_hs_chunk(s0, s1, c, p, cd, m, lr), None
+        c, p, cd, m, l = args
+        return _sg_hs_chunk(s0, s1, c, p, cd, m, l), None
 
     def split(a):
         return a.reshape(chunks, a.shape[0] // chunks, *a.shape[1:])
 
     (syn0, syn1hs), _ = jax.lax.scan(
         body, (syn0, syn1hs),
-        (split(centers), split(points), split(codes), split(code_mask)))
+        (split(centers), split(points), split(codes), split(code_mask),
+         lr_vec))
     return syn0, syn1hs
 
 
@@ -341,6 +405,11 @@ class SequenceVectors(WordVectorsBase):
         self.syn1: Optional[np.ndarray] = None
         self.label_index: Dict[Hashable, int] = {}
         self._norms = None
+        # batches stacked per device dispatch (amortizes remote-TPU dispatch
+        # latency; per-batch LR/semantics preserved via the per-chunk lr
+        # vector in _sg_neg_step).  Subclasses whose step can't scan multiple
+        # batches (DistributedWord2Vec) set this to 1.
+        self._device_batches = 16
 
     # ------------------------------------------------------------------
 
@@ -418,16 +487,13 @@ class SequenceVectors(WordVectorsBase):
         total_words = sum(len(s) for s in idx_corpus) * self.epochs
         words_done = 0
 
-        def current_lr():
-            frac = words_done / max(total_words, 1)
+        def lr_at(done) -> float:
+            """Linear LR decay at a words-done watermark (word2vec.c)."""
+            frac = float(done) / max(total_words, 1)
             return max(self.min_lr, self.lr * (1.0 - frac))
 
-        # batched pair buffers (see word2vec.py flush() for the padding rules)
-        pairs_c: List[int] = []
-        pairs_t: List[int] = []
-        cbow_ctx: List[np.ndarray] = []
-        # DM window width: contexts + optionally the label slot
-        W_ctx = 2 * self.window + (1 if (labels is not None and self.dm) else 0)
+        def current_lr():
+            return lr_at(words_done)
 
         def chunk_divisor(target_chunk: int) -> int:
             """Largest divisor of batch_size giving chunks of ≥ target size."""
@@ -441,92 +507,234 @@ class SequenceVectors(WordVectorsBase):
         # _occurrence_scale (see _sg_neg_step docstring)
         dbow = self.train_sequences and not self.dm
 
-        def flush():
-            nonlocal syn0, syn1, pairs_c, pairs_t, cbow_ctx
-            if not pairs_c:
-                return
-            n = len(pairs_c)
-            pad = self.batch_size - n
-            centers = np.asarray(pairs_c + [0] * pad, np.int32)
-            targets = np.asarray(pairs_t + [0] * pad, np.int32)
-            valid = np.zeros(self.batch_size, np.float32)
-            valid[:n] = 1.0
-            lr_j = jnp.asarray(current_lr(), jnp.float32)
+        # Vectorized window generation.  The reference walks sentences one
+        # token at a time per Hogwild thread (SkipGram.java:271-283); a
+        # Python translation of that loop caps the host at ~20K words/s with
+        # the TPU idle.  The walk is data-parallel: every center's candidate
+        # contexts live at fixed offsets [-W..-1, 1..W]; masking |off| ≤ b
+        # (the per-center dynamic window draw) and the sentence bounds yields
+        # the exact sequential pair stream — position-major, offsets in
+        # increasing j — in one numpy pass per sentence.
+        offs = np.concatenate([np.arange(-self.window, 0),
+                               np.arange(1, self.window + 1)])
+
+        if self.hs:
+            # vocab-indexed Huffman tables so flush() can gather per-target
+            # paths instead of looping: row i = word i's (points, codes, len)
+            hs_pts = np.zeros((V, max_code), np.int32)
+            hs_cds = np.zeros((V, max_code), np.float32)
+            hs_msk = np.zeros((V, max_code), np.float32)
+            for i, w in enumerate(self.vocab.words):
+                l = min(len(w.points), max_code)
+                hs_pts[i, :l] = w.points[:l]
+                hs_cds[i, :l] = w.codes[:l]
+                hs_msk[i, :l] = 1.0
+
+        # negative sampling: Walker alias table over unigram^0.75 — O(1)
+        # per draw (the reference's 10⁸-slot UnigramTable without the
+        # memory).  Tables live on device; draws happen there too
+        # (_device_negs), keyed by global batch index so results are
+        # invariant to _device_batches and mesh size (the
+        # DistributedWord2Vec parity tests rely on this).
+        a_prob, a_alias = _build_alias_table(unigram)
+        neg_tables = (jnp.asarray(a_prob.astype(np.float32)),
+                      jnp.asarray(a_alias.astype(np.int32)))
+        neg_key = jax.random.PRNGKey(np.random.SeedSequence(
+            [self.seed, 977]).generate_state(1)[0])
+        batch_counter = 0  # global batch index across the whole fit
+
+        def flush_multi(centers, targets, n_valid, lrs,
+                        ctx=None, cmask=None) -> None:
+            """One device dispatch covering ``len(lrs)`` stacked batches
+            (arrays are [n_b·batch_size] row-major; the first ``n_valid``
+            rows are genuine, the rest masked padding).  Per-batch LR rides
+            the scan's per-chunk lr vector, so semantics match n_b separate
+            flushes exactly."""
+            nonlocal syn0, syn1, batch_counter
+            n_b = len(lrs)
+            inner = chunk_divisor(32) if (ctx is not None and not self.hs) \
+                else (chunk_divisor(16) if dbow else 1)
+            chunks = n_b * inner
+            if chunks > 1:
+                lr_arg = jnp.asarray(
+                    np.repeat(np.asarray(lrs, np.float32), inner))
+            else:
+                lr_arg = jnp.asarray(lrs[0], jnp.float32)
             if self.hs:
-                Lc = max_code
-                pts = np.zeros((self.batch_size, Lc), np.int32)
-                cds = np.zeros((self.batch_size, Lc), np.float32)
-                msk = np.zeros((self.batch_size, Lc), np.float32)
-                for i in range(n):
-                    w = self.vocab.words[targets[i]]
-                    l = min(len(w.points), Lc)
-                    pts[i, :l] = w.points[:l]
-                    cds[i, :l] = w.codes[:l]
-                    msk[i, :l] = 1.0
+                valid = np.zeros(len(centers), np.float32)
+                valid[:n_valid] = 1.0
+                pts = hs_pts[targets]
+                cds = hs_cds[targets]
+                msk = hs_msk[targets] * valid[:, None]
                 syn0, syn1 = _sg_hs_step(syn0, syn1, jnp.asarray(centers),
                                          jnp.asarray(pts), jnp.asarray(cds),
-                                         jnp.asarray(msk), lr_j,
-                                         chunk_divisor(16) if dbow else 1)
-            elif cbow_ctx:
-                ctx = np.zeros((self.batch_size, W_ctx), np.int32)
-                msk = np.zeros((self.batch_size, W_ctx), np.float32)
-                for i, c in enumerate(cbow_ctx):
-                    l = min(len(c), W_ctx)
-                    ctx[i, :l] = c[:l]
-                    msk[i, :l] = 1.0
-                negs = rng.choice(len(unigram), size=(self.batch_size, self.negative),
-                                  p=unigram).astype(np.int32)
+                                         jnp.asarray(msk), lr_arg, chunks)
+                return
+            counters = jnp.asarray(
+                np.arange(batch_counter, batch_counter + n_b, dtype=np.uint32))
+            batch_counter += n_b
+            negs = _device_negs(neg_key, counters, neg_tables,
+                                self.negative, self.batch_size)
+            if ctx is not None:
                 syn0, syn1 = _cbow_neg_step(syn0, syn1, jnp.asarray(ctx),
-                                            jnp.asarray(msk),
-                                            jnp.asarray(targets), jnp.asarray(negs),
-                                            lr_j, chunk_divisor(32))
+                                            jnp.asarray(cmask),
+                                            jnp.asarray(targets),
+                                            negs, lr_arg, chunks)
             else:
-                negs = rng.choice(len(unigram), size=(self.batch_size, self.negative),
-                                  p=unigram).astype(np.int32)
-                syn0, syn1 = self._sg_step(syn0, syn1, jnp.asarray(centers),
-                                           jnp.asarray(targets), jnp.asarray(negs),
-                                           jnp.asarray(valid), lr_j,
-                                           chunk_divisor(16) if dbow else 1)
-            pairs_c, pairs_t, cbow_ctx = [], [], []
+                # one stacked upload: per-array puts pay ~10ms latency each
+                # on a tunnelled TPU, and bandwidth there is ~50MB/s
+                ct = jnp.asarray(np.stack([centers, targets]))
+                valid = _valid_mask(len(centers), jnp.asarray(n_valid, jnp.int32))
+                syn0, syn1 = self._sg_step(syn0, syn1, ct[0], ct[1],
+                                           negs, valid, lr_arg, chunks)
+
+        # pending pair chunks, drained ``k_super`` exact batches per device
+        # call; batch boundaries and per-batch LR match the sequential
+        # stream (pend_lr snapshots current_lr at each boundary crossing)
+        pend_c: List[np.ndarray] = []
+        pend_t: List[np.ndarray] = []
+        pend_x: List[np.ndarray] = []
+        pend_m: List[np.ndarray] = []
+        pend_lr: List[float] = []
+        pend_n = 0
+        k_super = max(1, int(self._device_batches))
+
+        def drain(final: bool = False) -> None:
+            nonlocal pend_c, pend_t, pend_x, pend_m, pend_lr, pend_n
+            bs = self.batch_size
+            if pend_n == 0 or (pend_n < bs * k_super and not final):
+                return
+            c = np.concatenate(pend_c)
+            t = np.concatenate(pend_t)
+            x = np.concatenate(pend_x) if pend_x else None
+            m = np.concatenate(pend_m) if pend_m else None
+            lrs = list(pend_lr)
+            orig_len = len(c)  # genuine pairs, before tail padding
+            tail = orig_len - (orig_len // bs) * bs
+            if final and tail:
+                # pad the tail to a full masked batch and take it too
+                pad = bs - tail
+                c = np.concatenate([c, np.zeros(pad, np.int32)])
+                t = np.concatenate([t, np.zeros(pad, np.int32)])
+                if x is not None:
+                    x = np.concatenate([x, np.zeros((pad, x.shape[1]), np.int32)])
+                    m = np.concatenate([m, np.zeros((pad, m.shape[1]), np.float32)])
+                lrs.append(current_lr())
+            n_batches = len(c) // bs if final else (len(c) // bs) // k_super * k_super
+            for g in range(0, n_batches, k_super):
+                gb = min(k_super, n_batches - g)
+                s = slice(g * bs, (g + gb) * bs)
+                n_valid = max(0, min(orig_len - g * bs, gb * bs))
+                flush_multi(c[s], t[s], n_valid, lrs[g:g + gb],
+                            None if x is None else x[s],
+                            None if m is None else m[s])
+            rem = slice(n_batches * bs, len(c) if not final else n_batches * bs)
+            kept = c[rem]
+            pend_c = [kept] if len(kept) else []
+            pend_t = [t[rem]] if len(kept) else []
+            pend_x = [x[rem]] if (x is not None and len(kept)) else []
+            pend_m = [m[rem]] if (m is not None and len(kept)) else []
+            pend_lr = lrs[n_batches:]
+            pend_n = len(kept)
+
+        def push(c, t, x=None, m=None, wdone=None) -> None:
+            """Queue a pair chunk.  ``wdone`` (per-pair words-done counts)
+            drives per-batch LR at word granularity; without it the batch
+            takes the LR of the current words_done watermark."""
+            nonlocal pend_n
+            if len(c) == 0:
+                return
+            start = pend_n
+            pend_c.append(np.ascontiguousarray(c, np.int32))
+            pend_t.append(np.ascontiguousarray(t, np.int32))
+            if x is not None:
+                pend_x.append(np.ascontiguousarray(x, np.int32))
+                pend_m.append(np.ascontiguousarray(m, np.float32))
+            pend_n += len(c)
+            while len(pend_lr) < pend_n // self.batch_size:
+                bidx = (len(pend_lr) + 1) * self.batch_size - 1 - start
+                pend_lr.append(lr_at(wdone[bidx]) if wdone is not None
+                               else current_lr())
+            drain()
 
         use_cbow_path = self.cbow or (labels is not None and self.dm
                                       and self.train_sequences)
 
+        # Flatten the corpus once: per-sentence numpy calls cost ~40µs each
+        # in fixed overhead, which at DL4J-corpus scale re-creates the host
+        # bottleneck the vectorization exists to remove.  Window masks use
+        # sentence-id equality, so one pass handles every sentence at once;
+        # blocks are cut at sentence boundaries to bound peak memory.
+        flat_lens = np.asarray([len(s) for s in idx_corpus], np.int64)
+        flat_tokens = (np.concatenate(idx_corpus) if idx_corpus
+                       else np.zeros(0, np.int32))
+        flat_sids = np.repeat(np.arange(len(idx_corpus)), flat_lens)
+        has_labels = labels is not None
+        flat_labs = (np.repeat(np.asarray(
+            [(-1 if l is None else l) for l in seq_label_idx], np.int32),
+            flat_lens) if has_labels else None)
+        BLOCK = 1 << 18  # ~256K tokens → ≤ ~1.5M pairs in flight
+
         for _ in range(self.epochs):
-            for sent, lbl in zip(idx_corpus, seq_label_idx):
-                if self.subsampling > 0:
-                    keep = rng.random(len(sent)) < keep_prob[sent]
-                    sent = sent[keep]
-                words_done += len(sent)
-                for pos, center in enumerate(sent):
-                    b = rng.integers(1, self.window + 1)  # dynamic window
-                    lo, hi = max(0, pos - b), min(len(sent), pos + b + 1)
-                    context = [int(sent[j]) for j in range(lo, hi) if j != pos]
-                    if use_cbow_path:
-                        ctx = list(context)
-                        if lbl is not None and self.train_sequences and self.dm:
-                            ctx.append(lbl)  # DM: label joins the window
-                        if not ctx:
-                            continue
-                        pairs_c.append(int(center))
-                        pairs_t.append(int(center))
-                        cbow_ctx.append(np.asarray(ctx, np.int32))
-                        if len(pairs_c) >= self.batch_size:
-                            flush()
+            if self.subsampling > 0:
+                keepm = rng.random(len(flat_tokens)) < keep_prob[flat_tokens]
+                toks = flat_tokens[keepm]
+                sids = flat_sids[keepm]
+                labs = flat_labs[keepm] if has_labels else None
+            else:
+                toks, sids, labs = flat_tokens, flat_sids, flat_labs
+            N = len(toks)
+            startpos = 0
+            while startpos < N:
+                cap = min(startpos + BLOCK, N)
+                if cap < N:
+                    # cut before the sentence containing position cap
+                    cut = int(np.searchsorted(sids, sids[cap - 1], side="left"))
+                    if cut <= startpos:  # single sentence > BLOCK: take it whole
+                        cut = int(np.searchsorted(sids, sids[cap - 1], side="right"))
+                else:
+                    cut = N
+                bt = toks[startpos:cut]
+                bsid = sids[startpos:cut]
+                blab = None if labs is None else labs[startpos:cut]
+                Lb = len(bt)
+                b = rng.integers(1, self.window + 1, size=Lb)  # dynamic window
+                j = np.arange(Lb)[:, None] + offs[None, :]     # [Lb, 2W]
+                jc = np.clip(j, 0, Lb - 1)
+                inwin = ((j >= 0) & (j < Lb)
+                         & (np.abs(offs)[None, :] <= b[:, None])
+                         & (bsid[jc] == bsid[:, None]))
+                ctx_ids = bt[jc]                               # [Lb, 2W]
+                # words-done after each center (for word-granular LR decay)
+                wd = words_done + startpos + 1 + np.arange(Lb, dtype=np.int64)
+                if use_cbow_path:
+                    if has_labels and self.dm:
+                        # DM: the label joins every averaged window
+                        ctx_full = np.concatenate([ctx_ids, blab[:, None]], 1)
+                        mask_full = np.concatenate(
+                            [inwin, (blab >= 0)[:, None]], 1)
                     else:
-                        if self.train_elements:
-                            for t in context:
-                                pairs_c.append(int(center))
-                                pairs_t.append(t)
-                                if len(pairs_c) >= self.batch_size:
-                                    flush()
-                        if lbl is not None and self.train_sequences and not self.dm:
-                            # DBOW: the label predicts each word of the window
-                            pairs_c.append(lbl)
-                            pairs_t.append(int(center))
-                            if len(pairs_c) >= self.batch_size:
-                                flush()
-        flush()
+                        ctx_full, mask_full = ctx_ids, inwin
+                    rows = mask_full.any(axis=1)  # skip empty-context centers
+                    push(bt[rows], bt[rows], ctx_full[rows],
+                         mask_full[rows].astype(np.float32), wd[rows])
+                else:
+                    cen = np.broadcast_to(bt[:, None], inwin.shape)
+                    tgt = ctx_ids
+                    vmat = inwin if self.train_elements else np.zeros_like(inwin)
+                    if has_labels and not self.dm:
+                        # DBOW: after each center's window pairs, the label
+                        # predicts the center (DBOW.java pair order)
+                        cen = np.concatenate([cen, blab[:, None]], axis=1)
+                        tgt = np.concatenate([tgt, bt[:, None]], axis=1)
+                        vmat = np.concatenate(
+                            [vmat, (blab >= 0)[:, None]], axis=1)
+                    keep_m = vmat.ravel()
+                    wexp = np.broadcast_to(wd[:, None], vmat.shape).ravel()[keep_m]
+                    push(cen.ravel()[keep_m], tgt.ravel()[keep_m], wdone=wexp)
+                startpos = cut
+            words_done += N
+        drain(final=True)
         self.syn0 = np.asarray(syn0)
         self.syn1 = np.asarray(syn1)
         self._norms = None

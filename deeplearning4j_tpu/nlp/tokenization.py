@@ -1,16 +1,26 @@
-"""Tokenization — TokenizerFactory/Tokenizer + preprocessors.
+"""Tokenization — TokenizerFactory registry + preprocessors + CJK.
 
-Parity target: reference text/tokenization/ (DefaultTokenizerFactory wraps
-a streaming whitespace tokenizer; CommonPreprocessor lowercases and strips
-punctuation).  The CJK language packs (chinese/japanese/korean vendored
-analyzers, 19,739 LoC) are out of scope for round 1 — the factory interface
-accepts pluggable tokenizers so they can slot in.
+Parity targets: reference text/tokenization/ (DefaultTokenizerFactory
+wraps a streaming whitespace tokenizer; CommonPreprocessor lowercases and
+strips punctuation) and the CJK language packs —
+deeplearning4j-nlp-chinese/.../ChineseTokenizer.java (word segmentation),
+deeplearning4j-nlp-japanese (kuromoji), deeplearning4j-nlp-korean.
+
+Zero-egress inversion of the language packs: their ~19.7K LoC are mostly
+VENDORED DICTIONARIES + analyzer glue.  The capability — segmenting
+unspaced CJK text into trainable tokens — is covered by
+``CJKTokenizerFactory``: longest-match against a user-supplied dictionary
+(the hook where a real lexicon slots in), falling back to overlapping
+bigrams (the standard statistical-IR baseline for CJK) or single
+characters.  The registry (``register_tokenizer_factory`` /
+``get_tokenizer_factory``) mirrors the reference's pluggable
+TokenizerFactory class-name configuration.
 """
 
 from __future__ import annotations
 
 import re
-from typing import Callable, Iterable, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
 
 class CommonPreprocessor:
@@ -45,6 +55,126 @@ class DefaultTokenizerFactory:
         return [t for t in tokens if t]
 
 
+def _is_cjk(ch: str) -> bool:
+    """CJK Unified Ideographs (+ext A), Hiragana, Katakana, Hangul."""
+    o = ord(ch)
+    return (0x4E00 <= o <= 0x9FFF      # CJK Unified Ideographs
+            or 0x3400 <= o <= 0x4DBF   # CJK Extension A
+            or 0x3040 <= o <= 0x309F   # Hiragana
+            or 0x30A0 <= o <= 0x30FF   # Katakana
+            or 0xAC00 <= o <= 0xD7AF   # Hangul syllables
+            or 0x1100 <= o <= 0x11FF)  # Hangul jamo
+
+
+class CJKTokenizerFactory:
+    """Segmenter for unspaced CJK text with a user-dictionary hook.
+
+    Within a CJK run, greedy longest-match against ``user_dictionary``
+    takes priority (ChineseTokenizer's lexicon role); unmatched spans fall
+    back to ``mode``:
+      - "bigram": overlapping character bigrams (standard CJK IR baseline;
+        a single leftover char becomes a unigram)
+      - "char": one token per character
+    Non-CJK spans (latin words, digits) tokenize by whitespace with the
+    preprocessor applied, so mixed-script corpora work end-to-end.
+    """
+
+    def __init__(self, user_dictionary: Optional[Sequence[str]] = None,
+                 mode: str = "bigram", preprocessor=None):
+        if mode not in ("bigram", "char"):
+            raise ValueError(f"mode must be 'bigram' or 'char', got {mode!r}")
+        self.mode = mode
+        self.preprocessor = preprocessor or CommonPreprocessor()
+        self.dictionary = set(user_dictionary or ())
+        self._max_word = max((len(w) for w in self.dictionary), default=0)
+
+    def _segment_cjk(self, run: str) -> List[str]:
+        out: List[str] = []
+        i, n = 0, len(run)
+        pending_start = 0
+
+        def flush_fallback(start: int, end: int) -> None:
+            span = run[start:end]
+            if not span:
+                return
+            if self.mode == "char" or len(span) == 1:
+                out.extend(span)
+            else:
+                out.extend(span[j:j + 2] for j in range(len(span) - 1))
+
+        while i < n:
+            match = None
+            if self.dictionary:
+                for L in range(min(self._max_word, n - i), 0, -1):
+                    if run[i:i + L] in self.dictionary:
+                        match = run[i:i + L]
+                        break
+            if match:
+                flush_fallback(pending_start, i)
+                out.append(match)
+                i += len(match)
+                pending_start = i
+            else:
+                i += 1
+        flush_fallback(pending_start, n)
+        return out
+
+    def tokenize(self, sentence: str) -> List[str]:
+        tokens: List[str] = []
+        buf: List[str] = []  # non-CJK accumulator
+
+        def flush_non_cjk() -> None:
+            if buf:
+                for t in "".join(buf).split():
+                    t = self.preprocessor.pre_process(t) if self.preprocessor else t
+                    if t:
+                        tokens.append(t)
+                buf.clear()
+
+        i = 0
+        while i < len(sentence):
+            if _is_cjk(sentence[i]):
+                flush_non_cjk()
+                j = i
+                while j < len(sentence) and _is_cjk(sentence[j]):
+                    j += 1
+                tokens.extend(self._segment_cjk(sentence[i:j]))
+                i = j
+            else:
+                buf.append(sentence[i])
+                i += 1
+        flush_non_cjk()
+        return tokens
+
+
+#: name → factory constructor (the reference configures TokenizerFactory
+#: by class name; this registry is the same seam without reflection)
+_TOKENIZER_FACTORIES: Dict[str, Callable[..., object]] = {}
+
+
+def register_tokenizer_factory(name: str, ctor: Callable[..., object]) -> None:
+    _TOKENIZER_FACTORIES[name.lower()] = ctor
+
+
+def get_tokenizer_factory(name: str, **kwargs):
+    """Build a registered tokenizer factory by name
+    ('default', 'cjk', 'chinese', 'japanese', 'korean', ...)."""
+    key = name.lower()
+    if key not in _TOKENIZER_FACTORIES:
+        raise ValueError(f"unknown tokenizer factory {name!r} "
+                         f"(known: {sorted(_TOKENIZER_FACTORIES)})")
+    return _TOKENIZER_FACTORIES[key](**kwargs)
+
+
+register_tokenizer_factory("default", DefaultTokenizerFactory)
+register_tokenizer_factory("cjk", CJKTokenizerFactory)
+# the language-specific names share the CJK segmenter; a real lexicon
+# arrives via user_dictionary (the vendored-dictionary seam)
+register_tokenizer_factory("chinese", CJKTokenizerFactory)
+register_tokenizer_factory("japanese", CJKTokenizerFactory)
+register_tokenizer_factory("korean", CJKTokenizerFactory)
+
+
 class LineSentenceIterator:
     """Sentence-per-line corpus iterator (reference BasicLineIterator)."""
 
@@ -65,3 +195,18 @@ class CollectionSentenceIterator:
 
     def __iter__(self):
         return iter(self.sentences)
+
+
+class AggregatingSentenceIterator:
+    """Chain several sentence iterators (reference
+    AggregatingSentenceIterator), with an optional per-sentence
+    preprocessor (reference SentencePreProcessor)."""
+
+    def __init__(self, *iterators, preprocessor: Optional[Callable[[str], str]] = None):
+        self.iterators = list(iterators)
+        self.preprocessor = preprocessor
+
+    def __iter__(self):
+        for it in self.iterators:
+            for s in it:
+                yield self.preprocessor(s) if self.preprocessor else s

@@ -49,7 +49,7 @@ class Word2Vec(SequenceVectors):
                  batch_size: int = 2048,
                  seed: int = 12345,
                  tokenizer_factory=None):
-        from .tokenization import DefaultTokenizerFactory
+        from .tokenization import DefaultTokenizerFactory, get_tokenizer_factory
 
         super().__init__(
             layer_size=layer_size,
@@ -64,6 +64,9 @@ class Word2Vec(SequenceVectors):
             epochs=epochs,
             batch_size=batch_size,
             seed=seed)
+        if isinstance(tokenizer_factory, str):
+            # registry names: 'default', 'cjk', 'chinese', 'japanese', ...
+            tokenizer_factory = get_tokenizer_factory(tokenizer_factory)
         self.tokenizer = tokenizer_factory or DefaultTokenizerFactory()
 
     def _tokenize_corpus(self, sentences: Iterable[str]) -> List[List[str]]:

@@ -51,6 +51,27 @@ class Dropout:
 
 @register_config
 @dataclasses.dataclass
+class SpatialDropout:
+    """Channel-wise dropout (reference SpatialDropout.java, Tompson et al.
+    2015): entire feature maps are dropped together.  Mask shape keeps the
+    batch and trailing channel axis and broadcasts over the spatial/time
+    axes between them — [mb,h,w,c] → mask [mb,1,1,c], [mb,t,f] →
+    [mb,1,f] — so adjacent-pixel correlations can't leak through
+    element-wise dropout.  ``p`` is the DROP probability."""
+
+    p: float = 0.5
+
+    def apply(self, rng: Array, x: Array, train: bool) -> Array:
+        if not train or self.p <= 0.0:
+            return x
+        keep = 1.0 - self.p
+        shape = (x.shape[0],) + (1,) * (x.ndim - 2) + (x.shape[-1],)
+        mask = jax.random.bernoulli(rng, keep, shape)
+        return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+@register_config
+@dataclasses.dataclass
 class AlphaDropout:
     """SELU-compatible dropout (reference AlphaDropout.java, Klambauer et
     al. 2017): dropped units take α' = −λα, then an affine correction

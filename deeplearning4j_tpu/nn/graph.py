@@ -33,6 +33,7 @@ from .conf.inputs import InputType
 from .conf.regularizers import apply_constraints, maybe_weight_noise
 from .layers.base import Layer, config_from_dict, config_to_dict, register_config
 from .updaters import Adam, GradientNormalization, Updater, normalize_gradients
+from ..optimize.score import LazyScore, materialize_scores
 
 Array = jax.Array
 
@@ -542,6 +543,11 @@ class ComputationGraph:
         streaming — the DAG analog of the reference's
         rnnActivateUsingStoredState (ComputationGraph.java:1602)."""
         compute = jnp.dtype(self.conf.compute_dtype)
+        # integer-index inputs can't carry the compute dtype — stamp it on
+        # layers so e.g. LSTM gathers in the right precision
+        for spec in self.conf.vertices:
+            if getattr(spec.vertex, "layer", None) is not None:
+                spec.vertex.layer._compute_dtype = self.conf.compute_dtype
         acts: Dict[str, Array] = {}
         mks: Dict[str, Optional[Array]] = {}
         for k, v in inputs.items():
@@ -691,7 +697,9 @@ class ComputationGraph:
                                 [ds.features_mask], [ds.labels_mask])
         raise TypeError(type(ds))
 
-    def fit_batch(self, ds) -> float:
+    def fit_batch(self, ds):
+        """One step; returns a :class:`LazyScore` (device-resident loss that
+        syncs only when read — see optimize/score.py)."""
         mds = self._to_mds(ds)
         if self.conf.backprop_type == "tbptt":
             return self._fit_batch_tbptt(mds)
@@ -711,10 +719,10 @@ class ComputationGraph:
             self.params, self.state, self.opt_state,
             jnp.asarray(self.iteration, jnp.int32), inputs, labels, sub, masks, lmasks)
         self.iteration += 1
-        loss_val = float(loss)
+        score = LazyScore(loss)
         for lst in self.listeners:
-            lst.iteration_done(self, self.iteration, loss_val)
-        return loss_val
+            lst.iteration_done(self, self.iteration, score)
+        return score
 
     def _fit_batch_tbptt(self, mds: MultiDataSet) -> float:
         """Slice the time axis into tbptt_length chunks, carry recurrent
@@ -738,7 +746,7 @@ class ComputationGraph:
         fmasks = mds.features_masks or [None] * len(feats)
         lmasks_l = mds.labels_masks or [None] * len(labs)
         carries = self._init_carries(mb)
-        total, chunks = 0.0, 0
+        total, chunks = None, 0
 
         def tslice(a, s):
             """Features/labels: only rank-3 arrays carry a time axis —
@@ -771,18 +779,23 @@ class ComputationGraph:
                 jnp.asarray(self.iteration, jnp.int32), inputs, labels, sub,
                 masks, lmasks, carries)
             self.iteration += 1
-            total += float(loss)
+            # accumulate on device — no host sync inside the chunk loop
+            total = loss if total is None else total + loss
             chunks += 1
             for lst in self.listeners:
-                lst.iteration_done(self, self.iteration, float(loss))
-        return total / max(chunks, 1)
+                lst.iteration_done(self, self.iteration, LazyScore(loss))
+        return LazyScore(total / max(chunks, 1))
 
     def fit(self, data, epochs: int = 1) -> List[float]:
         losses = []
         it = self._as_iterator(data)
+        synced = 0
         for _ in range(epochs):
             for ds in it:
                 losses.append(self.fit_batch(ds))
+            # one batched transfer per epoch frees the per-step buffers
+            materialize_scores(losses[synced:])
+            synced = len(losses)
             self.epoch += 1
         return losses
 

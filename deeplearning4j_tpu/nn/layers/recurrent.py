@@ -36,8 +36,11 @@ from .base import ForwardOut, Layer, register_layer
 Array = jax.Array
 
 
-def _lstm_cell(cfg, params, carry, x_t, mask_t=None, suffix=""):
+def _lstm_cell(cfg, params, carry, x_t, mask_t=None, suffix="", zx_t=None):
     """One LSTM step.  carry = (h, c); x_t [mb, n_in]; mask_t [mb] or None.
+    ``zx_t`` is the precomputed input projection x_t @ W (see _scan_lstm —
+    batching the projection over all timesteps is one big MXU matmul
+    instead of T small ones, and enables integer-index inputs).
 
     The standard sigmoid/tanh non-peephole cell calls
     ops/lstm_kernel.fused_lstm_cell — which resolves to XLA's (faster,
@@ -45,10 +48,11 @@ def _lstm_cell(cfg, params, carry, x_t, mask_t=None, suffix=""):
     opted in via DL4J_TPU_FUSED_LSTM=1; custom activations and peepholes
     use the general path."""
     h, c = carry
-    W = params["W" + suffix].astype(x_t.dtype)
-    RW = params["RW" + suffix].astype(x_t.dtype)
-    b = params["b" + suffix].astype(x_t.dtype)
-    z = x_t @ W + h @ RW + b  # [mb, 4*n_out]
+    if zx_t is None:
+        zx_t = x_t @ params["W" + suffix].astype(x_t.dtype)
+    RW = params["RW" + suffix].astype(zx_t.dtype)
+    b = params["b" + suffix].astype(zx_t.dtype)
+    z = zx_t + h @ RW + b  # [mb, 4*n_out]
     n = cfg.n_out
     if (not cfg.peephole and cfg.gate_activation == "sigmoid"
             and cfg.activation == "tanh"):
@@ -63,7 +67,7 @@ def _lstm_cell(cfg, params, carry, x_t, mask_t=None, suffix=""):
     gate = get_activation(cfg.gate_activation)
     act = get_activation(cfg.activation)
     if cfg.peephole:
-        pW = params["pW" + suffix].astype(x_t.dtype)
+        pW = params["pW" + suffix].astype(z.dtype)
         pi, pf, po = pW[:n], pW[n:2 * n], pW[2 * n:]
         i = gate(zi + c * pi)
         f = gate(zf + c * pf)
@@ -81,16 +85,32 @@ def _lstm_cell(cfg, params, carry, x_t, mask_t=None, suffix=""):
 
 
 def _scan_lstm(cfg, params, x, mask, h0, c0, reverse=False, suffix=""):
-    """Scan the cell over time. x [mb,t,f] → outputs [mb,t,n_out] + final carry."""
-    xT = jnp.swapaxes(x, 0, 1)  # [t, mb, f]
+    """Scan the cell over time. x [mb,t,f] (or int indices [mb,t]) →
+    outputs [mb,t,n_out] + final carry.
+
+    The input projection x @ W is hoisted out of the scan: one [mb·t, f]
+    × [f, 4n] MXU matmul instead of t small ones.  Integer inputs take the
+    gather form W[x] — mathematically identical to one_hot(x) @ W with the
+    same parameters, but the host ships 2-byte indices instead of f-float
+    one-hots (a ~vocab× smaller transfer, which matters on tunnelled
+    TPUs and real pods alike)."""
+    W = params["W" + suffix]
+    if jnp.issubdtype(x.dtype, jnp.integer):
+        # gather in the COMPUTE dtype (h0's dtype — the carry carries it):
+        # W.dtype is the param dtype, which under mixed precision (f32
+        # params, bf16 compute) would poison the scan carry dtype
+        zx = W[x].astype(h0.dtype)      # [mb, t, 4n] embedding-style gather
+    else:
+        zx = x @ W.astype(x.dtype)      # [mb, t, 4n]
+    zxT = jnp.swapaxes(zx, 0, 1)        # [t, mb, 4n]
     maskT = None if mask is None else jnp.swapaxes(mask, 0, 1)  # [t, mb]
 
     def body(carry, inp):
-        x_t, m_t = inp
-        new = _lstm_cell(cfg, params, carry, x_t, m_t, suffix)
+        zx_t, m_t = inp
+        new = _lstm_cell(cfg, params, carry, None, m_t, suffix, zx_t=zx_t)
         return new, new[0]
 
-    inputs = (xT, maskT if maskT is not None else jnp.ones(xT.shape[:2], x.dtype))
+    inputs = (zxT, maskT if maskT is not None else jnp.ones(zxT.shape[:2], zx.dtype))
     (hF, cF), hs = lax.scan(body, (h0, c0), inputs, reverse=reverse)
     return jnp.swapaxes(hs, 0, 1), (hF, cF)
 
@@ -142,14 +162,26 @@ class LSTM(Layer):
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None,
                 carry=None) -> ForwardOut:
-        x = self._maybe_dropout(x, train, rng)
-        h0, c0 = carry if carry is not None else self.zero_carry(x.shape[0], x.dtype)
+        if not jnp.issubdtype(x.dtype, jnp.integer):
+            x = self._maybe_dropout(x, train, rng)
+            cdt = x.dtype
+        else:
+            # index inputs: dropout on raw ids is meaningless — skip; the
+            # compute dtype comes from the container (set per trace by
+            # _apply_layers), falling back to the param dtype
+            cdt = jnp.dtype(getattr(self, "_compute_dtype", None)
+                            or params["W"].dtype)
+        h0, c0 = carry if carry is not None else self.zero_carry(x.shape[0], cdt)
         ys, final = _scan_lstm(self, params, x, mask, h0, c0)
         return ForwardOut(ys, state, mask, final)
 
     def step(self, params, carry, x_t):
-        """Single streaming step (rnnTimeStep parity): x_t [mb, n_in]."""
-        new = _lstm_cell(self, params, carry, x_t)
+        """Single streaming step (rnnTimeStep parity): x_t [mb, n_in]
+        dense, or [mb] integer indices (same gather form as _scan_lstm)."""
+        if jnp.issubdtype(x_t.dtype, jnp.integer):
+            new = _lstm_cell(self, params, carry, None, zx_t=params["W"][x_t])
+        else:
+            new = _lstm_cell(self, params, carry, x_t)
         return new[0], new
 
 
@@ -183,8 +215,13 @@ class GravesBidirectionalLSTM(LSTM):
         return p
 
     def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
-        x = self._maybe_dropout(x, train, rng)
-        h0, c0 = self.zero_carry(x.shape[0], x.dtype)
+        if not jnp.issubdtype(x.dtype, jnp.integer):
+            x = self._maybe_dropout(x, train, rng)
+            cdt = x.dtype
+        else:
+            cdt = jnp.dtype(getattr(self, "_compute_dtype", None)
+                            or params["WF"].dtype)
+        h0, c0 = self.zero_carry(x.shape[0], cdt)
         fwd, _ = _scan_lstm(self, params, x, mask, h0, c0, reverse=False, suffix="F")
         bwd, _ = _scan_lstm(self, params, x, mask, h0, c0, reverse=True, suffix="B")
         return ForwardOut(fwd + bwd, state, mask)

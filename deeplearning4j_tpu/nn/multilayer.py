@@ -30,6 +30,7 @@ from .conf.preprocessors import Preprocessor
 from .conf.regularizers import apply_constraints, maybe_weight_noise
 from .layers.base import Layer, config_from_dict, config_to_dict
 from .updaters import Adam, GradientNormalization, Updater, normalize_gradients
+from ..optimize.score import LazyScore, materialize_scores
 
 Array = jax.Array
 
@@ -155,6 +156,9 @@ class MultiLayerNetwork:
         self.input_types: List[InputType] = []
         self._jit_step = None
         self._jit_step_tbptt = None
+        self._jit_step_tbptt_scan = None
+        self._it_dev = None        # device-resident iteration counter
+        self._it_dev_val = -1      # python value _it_dev mirrors
         self._jit_output = None
         self._jit_score = None
         self._jit_stream = None
@@ -219,6 +223,19 @@ class MultiLayerNetwork:
     def _updater_for(self, layer: Layer) -> Updater:
         return layer.updater if layer.updater is not None else self.conf.updater
 
+    def _iter_scalar(self, advance: int):
+        """Device-resident iteration counter: a fresh host scalar upload per
+        step costs ~10ms of serialized latency on a tunnelled TPU, so the
+        counter lives on device and advances with an (async) eager add.
+        Falls back to an upload whenever python-side ``iteration`` was
+        changed externally (checkpoint restore, manual reset)."""
+        if self._it_dev is None or self._it_dev_val != self.iteration:
+            self._it_dev = jnp.asarray(self.iteration, jnp.int32)
+        it = self._it_dev
+        self._it_dev = it + advance
+        self._it_dev_val = self.iteration + advance
+        return it
+
     def num_params(self) -> int:
         return sum(int(np.prod(x.shape)) for p in self.params for x in jax.tree_util.tree_leaves(p))
 
@@ -238,6 +255,11 @@ class MultiLayerNetwork:
         for TBPTT / streaming (reference rnnActivateUsingStoredState).
         """
         n = len(self.conf.layers) if upto is None else upto
+        # layers needing the compute dtype independent of their input's
+        # dtype (integer-index LSTM inputs) read it from this attribute —
+        # refreshed per trace because conf.compute_dtype is user-mutable
+        for layer in self.conf.layers:
+            layer._compute_dtype = self.conf.compute_dtype
         new_state = list(state)
         new_carries = list(carries) if carries is not None else [None] * len(self.conf.layers)
         acts: List[Array] = []
@@ -350,9 +372,10 @@ class MultiLayerNetwork:
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def _make_step_tbptt(self):
-        """TBPTT step: like _make_step but threads recurrent carries across
-        sequence chunks; truncation is automatic because each chunk is its
-        own value_and_grad (reference doTruncatedBPTT():1386)."""
+        """One TBPTT chunk step: like _make_step but threads recurrent
+        carries; truncation is automatic because each chunk is its own
+        value_and_grad (reference doTruncatedBPTT():1386).  Used for the
+        tail chunk when T % tbptt_length != 0."""
         def step(params, state, opt_state, it, x, labels, rng, mask, label_mask, carries):
             def loss_fn(p):
                 loss, aux = self._loss(p, state, x, labels, train=True, rng=rng,
@@ -367,8 +390,74 @@ class MultiLayerNetwork:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
-    def fit_batch(self, ds: DataSet) -> float:
-        """One optimization step on one minibatch (reference fit(DataSet))."""
+    def _make_step_tbptt_scan(self):
+        """Whole-batch TBPTT: every T//L chunk optimizer-step runs inside
+        ONE jit via lax.scan — one upload + one dispatch per minibatch
+        instead of per chunk.  The reference walks chunks in a Java loop
+        (doTruncatedBPTT():1386); on a remote TPU each interleaved
+        host→device upload costs ~45ms of serialized latency, so chunk
+        steps must be fused device-side.  Semantics identical: sequential
+        chunk steps, carries threaded, per-chunk iteration counter."""
+        L = self.conf.tbptt_length
+
+        def step(params, state, opt_state, it0, x, labels, rng, mask,
+                 label_mask, carries):
+            n = x.shape[1] // L
+            mb = x.shape[0]
+            if carries is None:
+                # carry init traced into the program — no per-batch eager
+                # zeros dispatches on the host
+                dtype = jnp.dtype(self.conf.compute_dtype)
+                carries = [l.init_carry(mb, dtype) if l.recurrent else None
+                           for l in self.conf.layers]
+
+            def chunkify(a):
+                """[mb, n·L, ...] → [n, mb, L, ...] scan-major."""
+                if a is None:
+                    return None
+                a2 = a.reshape((a.shape[0], n, L) + a.shape[2:])
+                return jnp.moveaxis(a2, 1, 0)
+
+            xs = chunkify(x)
+            ys = jax.tree_util.tree_map(chunkify, labels)
+            ms = chunkify(mask)
+            lms = chunkify(label_mask)
+            keys = jax.random.split(rng, n + 1)
+            its = it0 + jnp.arange(n, dtype=jnp.int32)
+
+            def body(carry, inp):
+                params, state, opt_state, carries = carry
+                xc, yc, mc, lmc, k, it = inp
+
+                def loss_fn(p):
+                    loss, aux = self._loss(p, state, xc, yc, train=True,
+                                           rng=k, mask=mc, label_mask=lmc,
+                                           carries=carries)
+                    return loss, aux
+
+                (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                new_params, new_opt = self._apply_updates(
+                    grads, params, opt_state, it.astype(jnp.float32))
+                return (new_params, new_state, new_opt, new_carries), loss
+
+            (params, state, opt_state, carries), losses = jax.lax.scan(
+                body, (params, state, opt_state, carries),
+                (xs, ys, ms, lms, keys[:n], its))
+            # mean + fresh rng computed in-program: a fit_batch with no
+            # tail chunk runs exactly ONE device dispatch
+            return (params, state, opt_state, carries, losses,
+                    jnp.mean(losses), keys[n])
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def fit_batch(self, ds: DataSet):
+        """One optimization step on one minibatch (reference fit(DataSet)).
+
+        Returns the loss as a :class:`LazyScore` — a float-like view of the
+        device scalar that only syncs when read, so chained ``fit_batch``
+        calls keep the TPU busy with zero per-step host round trips (the
+        readback the reference pays at MultiLayerNetwork.java:1165)."""
         if self.conf.backprop_type == "tbptt":
             return self._fit_batch_tbptt(ds)
         if self._jit_step is None:
@@ -381,45 +470,121 @@ class MultiLayerNetwork:
         lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
         self.params, self.state, self.opt_state, loss = self._jit_step(
             self.params, self.state, self.opt_state,
-            jnp.asarray(self.iteration, jnp.int32), x, y, sub, m, lm)
+            self._iter_scalar(1), x, y, sub, m, lm)
         self.iteration += 1
-        loss_val = float(loss)
+        score = LazyScore(loss)
         for lst in self.listeners:
-            lst.iteration_done(self, self.iteration, loss_val)
-        return loss_val
+            lst.iteration_done(self, self.iteration, score)
+        return score
 
     def _fit_batch_tbptt(self, ds: DataSet) -> float:
         """Truncated BPTT: slice the time axis into tbptt_length chunks,
         carry recurrent state forward between chunks, one optimizer step per
-        chunk (reference doTruncatedBPTT():1386 semantics)."""
-        if self._jit_step_tbptt is None:
-            self._jit_step_tbptt = self._make_step_tbptt()
-        x = np.asarray(ds.features)
-        y = None if ds.labels is None else np.asarray(ds.labels)
-        if x.ndim != 3 or (y is not None and y.ndim != 3):
+        chunk (reference doTruncatedBPTT():1386 semantics).  All full
+        chunks run in one scanned jit (_make_step_tbptt_scan); a ragged
+        tail chunk runs through the per-chunk step."""
+        # device arrays pass through untouched (np.asarray would force a
+        # device→host round trip); [mb, time, features] dense — or
+        # [mb, time] integer indices (sparse inputs gathered by the LSTM /
+        # sparse labels one-hotted in the loss)
+        def _keep(a):
+            return a if isinstance(a, jax.Array) else (
+                None if a is None else np.asarray(a))
+        x = _keep(ds.features)
+        y = _keep(ds.labels)
+
+        def _rank_ok(a):
+            return a.ndim == 3 or (a.ndim == 2
+                                   and jnp.issubdtype(a.dtype, jnp.integer))
+        if not _rank_ok(x) or (y is not None and not _rank_ok(y)):
             raise ValueError("TBPTT requires [mb, time, features] inputs and "
-                             "[mb, time, classes] labels")
+                             "[mb, time, classes] labels (or [mb, time] "
+                             "integer index arrays)")
         L = self.conf.tbptt_length
         mb, T = x.shape[0], x.shape[1]
-        dtype = jnp.dtype(self.conf.compute_dtype)
-        carries = [l.init_carry(mb, dtype) if l.recurrent else None
-                   for l in self.conf.layers]
-        total, chunks = 0.0, 0
-        for s in range(0, T, L):
-            xs = jnp.asarray(x[:, s:s + L])
-            ys = None if y is None else jnp.asarray(y[:, s:s + L])
-            m = None if ds.features_mask is None else jnp.asarray(ds.features_mask[:, s:s + L])
-            lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask[:, s:s + L])
+        fm = _keep(ds.features_mask)
+        lm = _keep(ds.labels_mask)
+        # Listeners that act on the model mid-run (checkpointing, eval)
+        # need each chunk's params at callback time — the fused scan only
+        # has end-of-batch params, so such listeners route through the
+        # per-chunk step loop (slower: one dispatch per chunk).  Plain
+        # score/throughput listeners keep the fused path; they get called
+        # after the batch with per-chunk losses.
+        if any(getattr(l, "requires_model_state", False) for l in self.listeners):
+            return self._fit_batch_tbptt_chunked(x, y, fm, lm, mb, T, L)
+        n = T // L
+        tail = T % L
+        carries = None
+        chunk_losses = []
+        mean_loss = None
+        if n:
+            if self._jit_step_tbptt_scan is None:
+                self._jit_step_tbptt_scan = self._make_step_tbptt_scan()
+            cut = None if tail == 0 else n * L
+            clip = (lambda a: a) if cut is None else (
+                lambda a: None if a is None else a[:, :cut])
+            (self.params, self.state, self.opt_state, carries, losses,
+             mean_loss, self._rng) = self._jit_step_tbptt_scan(
+                self.params, self.state, self.opt_state,
+                self._iter_scalar(n),
+                jnp.asarray(clip(x)),
+                None if y is None else jnp.asarray(clip(y)),
+                self._rng, clip(fm), clip(lm), None)
+            self.iteration += n
+            if self.listeners:
+                chunk_losses = [(self.iteration - n + i + 1, LazyScore(losses[i]))
+                                for i in range(n)]
+        if tail:
+            if self._jit_step_tbptt is None:
+                self._jit_step_tbptt = self._make_step_tbptt()
+            if carries is None:
+                dtype = jnp.dtype(self.conf.compute_dtype)
+                carries = [l.init_carry(mb, dtype) if l.recurrent else None
+                           for l in self.conf.layers]
+            s = n * L
             self._rng, sub = jax.random.split(self._rng)
             self.params, self.state, self.opt_state, carries, loss = self._jit_step_tbptt(
                 self.params, self.state, self.opt_state,
-                jnp.asarray(self.iteration, jnp.int32), xs, ys, sub, m, lm, carries)
+                self._iter_scalar(1),
+                jnp.asarray(x[:, s:]),
+                None if y is None else jnp.asarray(y[:, s:]), sub,
+                None if fm is None else jnp.asarray(fm[:, s:]),
+                None if lm is None else jnp.asarray(lm[:, s:]), carries)
             self.iteration += 1
-            total += float(loss)
+            if self.listeners:
+                chunk_losses.append((self.iteration, LazyScore(loss)))
+            mean_loss = loss if mean_loss is None else (
+                (mean_loss * n + loss) / (n + 1))
+        for it, score in chunk_losses:
+            for lst in self.listeners:
+                lst.iteration_done(self, it, score)
+        return LazyScore(mean_loss)
+
+    def _fit_batch_tbptt_chunked(self, x, y, fm, lm, mb, T, L):
+        """Per-chunk TBPTT loop: one dispatch per chunk so listeners with
+        ``requires_model_state`` observe each chunk's params (the fused
+        scan path only has end-of-batch params)."""
+        if self._jit_step_tbptt is None:
+            self._jit_step_tbptt = self._make_step_tbptt()
+        dtype = jnp.dtype(self.conf.compute_dtype)
+        carries = [l.init_carry(mb, dtype) if l.recurrent else None
+                   for l in self.conf.layers]
+        total, chunks = None, 0
+        for s in range(0, T, L):
+            self._rng, sub = jax.random.split(self._rng)
+            self.params, self.state, self.opt_state, carries, loss = self._jit_step_tbptt(
+                self.params, self.state, self.opt_state,
+                self._iter_scalar(1),
+                jnp.asarray(x[:, s:s + L]),
+                None if y is None else jnp.asarray(y[:, s:s + L]), sub,
+                None if fm is None else jnp.asarray(fm[:, s:s + L]),
+                None if lm is None else jnp.asarray(lm[:, s:s + L]), carries)
+            self.iteration += 1
+            total = loss if total is None else total + loss
             chunks += 1
             for lst in self.listeners:
-                lst.iteration_done(self, self.iteration, float(loss))
-        return total / max(chunks, 1)
+                lst.iteration_done(self, self.iteration, LazyScore(loss))
+        return LazyScore(total / max(chunks, 1))
 
     # ------------------------------------------------------------------
     # streaming RNN inference (rnnTimeStep parity)
@@ -427,12 +592,17 @@ class MultiLayerNetwork:
 
     def rnn_time_step(self, x) -> np.ndarray:
         """Stateful streaming inference: feeds [mb, f] (one step) or
-        [mb, t, f] and keeps hidden state across calls (reference
-        rnnTimeStep():2636)."""
+        [mb, t, f] — or [mb] / [mb, t] integer index inputs — and keeps
+        hidden state across calls (reference rnnTimeStep():2636)."""
         xa = jnp.asarray(x)
-        squeeze = xa.ndim == 2
-        if squeeze:
-            xa = xa[:, None, :]
+        if jnp.issubdtype(xa.dtype, jnp.integer):
+            squeeze = xa.ndim == 1
+            if squeeze:
+                xa = xa[:, None]
+        else:
+            squeeze = xa.ndim == 2
+            if squeeze:
+                xa = xa[:, None, :]
         mb = xa.shape[0]
         if self._stream_carries is not None:
             for c in jax.tree_util.tree_leaves(self._stream_carries):
@@ -464,9 +634,15 @@ class MultiLayerNetwork:
         iterator's job — wrap with AsyncDataSetIterator for parity)."""
         it = self._as_iterator(data)
         losses: List[float] = []
+        synced = 0
         for _ in range(epochs):
             for ds in it:
                 losses.append(self.fit_batch(ds))
+            # materialize the epoch's scores: ONE device transfer per epoch
+            # — keeps the intra-epoch loop async while freeing the
+            # per-step 0-d device buffers (they'd otherwise pin memory)
+            materialize_scores(losses[synced:])
+            synced = len(losses)
             self.epoch += 1
             for lst in self.listeners:
                 if hasattr(lst, "epoch_done"):

@@ -51,6 +51,12 @@ class Loss:
         self._feature_mean = feature_mean
 
     def per_element(self, labels: Array, preout: Array, activation="identity") -> Array:
+        if (jnp.issubdtype(labels.dtype, jnp.integer)
+                and labels.ndim == preout.ndim - 1):
+            # sparse class-index labels (the TPU-native data path: the host
+            # ships 4-byte ids, the device materializes the one-hot) —
+            # numerically identical to dense one-hot labels
+            labels = jax.nn.one_hot(labels, preout.shape[-1], dtype=preout.dtype)
         if self.name in ("mcxent", "negativeloglikelihood") and _act_name(activation) == "softmax":
             logp = jax.nn.log_softmax(preout, axis=-1)
             return -labels * logp

@@ -8,4 +8,5 @@ from .listeners import (
     CheckpointListener,
     ComposableListener,
 )
+from .score import LazyScore
 from .solvers import SolverResult, backtrack_line_search, fit_solver, minimize

@@ -22,7 +22,19 @@ logger = logging.getLogger("deeplearning4j_tpu")
 
 
 class TrainingListener:
-    """Base no-op listener (reference IterationListener/TrainingListener)."""
+    """Base no-op listener (reference IterationListener/TrainingListener).
+
+    ``score`` is a float-like LazyScore — reading it (format, compare,
+    ``float()``) syncs the device; listeners that only log every N
+    iterations therefore only sync every N iterations.
+
+    ``requires_model_state``: set True on listeners whose callback acts on
+    the model's *current* params (checkpointing, evaluation).  Fused
+    multi-step paths (TBPTT scan) fall back to stepping one chunk per
+    dispatch when such a listener is attached, so the callback sees each
+    iteration's params rather than end-of-batch params."""
+
+    requires_model_state = False
 
     def iteration_done(self, model, iteration: int, score: float) -> None:
         pass
@@ -109,6 +121,8 @@ class TimeIterationListener(TrainingListener):
 class EvaluativeListener(TrainingListener):
     """Periodic evaluation on a held-out iterator (reference EvaluativeListener)."""
 
+    requires_model_state = True
+
     def __init__(self, data, frequency: int = 100, evaluation_factory=None,
                  out: Optional[Callable[[str], None]] = None):
         self.data = data
@@ -128,6 +142,8 @@ class EvaluativeListener(TrainingListener):
 class CheckpointListener(TrainingListener):
     """Periodic checkpointing to a directory, keeping the last N
     (reference CheckpointListener semantics; format = utils.serializer zip)."""
+
+    requires_model_state = True
 
     def __init__(self, directory: str, save_every_iterations: Optional[int] = None,
                  save_every_epochs: Optional[int] = None, keep_last: int = 3):

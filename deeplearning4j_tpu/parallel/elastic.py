@@ -40,7 +40,11 @@ class CheckpointManager:
 
     def save(self, net, step: int) -> str:
         path = self._path(step)
-        net.save(path)
+        # temp-file + atomic rename: a crash mid-write must never leave a
+        # truncated zip as the latest (restore would load garbage)
+        tmp = path + ".tmp"
+        net.save(tmp)
+        os.replace(tmp, path)
         self._prune()
         return path
 
@@ -112,7 +116,9 @@ class ElasticTrainer:
                  max_restarts: int = 3,
                  failure_detector: Optional[FailureDetector] = None,
                  rebuild_fn: Optional[Callable[[], Any]] = None,
-                 loader: Optional[Callable[[str], Any]] = None):
+                 loader: Optional[Callable[[str], Any]] = None,
+                 sync_every: int = 10,
+                 restart_reset_after: Optional[int] = None):
         self.trainer = trainer
         self.ckpt = CheckpointManager(checkpoint_dir, keep_last)
         self.checkpoint_every = max(1, checkpoint_every)
@@ -120,8 +126,19 @@ class ElasticTrainer:
         self.detector = failure_detector or FailureDetector()
         self.rebuild_fn = rebuild_fn
         self.loader = loader or self._default_loader
-        self.restarts = 0
+        self.sync_every = max(1, sync_every)
+        self.restarts = 0        # consecutive-failure budget (resets)
+        self.total_restarts = 0  # lifetime count, for observability
         self.global_step = 0
+        # max_restarts bounds CONSECUTIVE failures, not lifetime failures:
+        # after this many successful steps the counter resets, so a
+        # months-long job surviving occasional pre-emptions doesn't
+        # eventually die with 'exceeded max_restarts' despite every
+        # incident having recovered
+        self.restart_reset_after = (restart_reset_after
+                                    if restart_reset_after is not None
+                                    else checkpoint_every)
+        self._ok_steps = 0
 
     @staticmethod
     def _default_loader(path: str):
@@ -147,18 +164,39 @@ class ElasticTrainer:
         logger.info("restored checkpoint @ step %d", step)
 
     def fit_batch(self, ds) -> float:
-        """One step with checkpoint + recovery semantics."""
+        """One step with checkpoint + recovery semantics.
+
+        The underlying fit_batch is async (device-resident LazyScore); a
+        device failure would otherwise surface at some later read, outside
+        this try block.  Materializing every ``sync_every`` steps keeps the
+        failure inside the recovery loop while amortizing the host sync —
+        at most sync_every steps are replayed from the last checkpoint."""
         while True:
             try:
                 loss = self.trainer.fit_batch(ds)
                 self.global_step += 1
-                if self.global_step % self.checkpoint_every == 0:
+                saving = self.global_step % self.checkpoint_every == 0
+                if (saving or self.global_step % self.sync_every == 0) \
+                        and hasattr(loss, "value"):
+                    # device barrier: surfaces async failures — ALWAYS
+                    # before a checkpoint write, so a latent failure can't
+                    # first materialize mid-save and corrupt the newest
+                    # checkpoint
+                    loss.value()
+                if saving:
                     self.ckpt.save(self.net, self.global_step)
+                self._ok_steps += 1
+                if self._ok_steps >= self.restart_reset_after and self.restarts:
+                    logger.info("%d successful steps since last failure — "
+                                "resetting restart counter", self._ok_steps)
+                    self.restarts = 0
                 return loss
             except Exception as exc:
                 if not self.detector.is_recoverable(exc):
                     raise
+                self._ok_steps = 0
                 self.restarts += 1
+                self.total_restarts += 1
                 self.detector.on_failure(exc, self.restarts)
                 if self.restarts > self.max_restarts:
                     raise RuntimeError(

@@ -45,6 +45,22 @@ def build_mesh(axes: Optional[Dict[str, int]] = None,
     return Mesh(arr, names)
 
 
+def put_global(arr, sharding: NamedSharding):
+    """Place a host array onto a (possibly multi-process) sharding.
+
+    Single-process: plain ``device_put``.  Multi-process: ``device_put``
+    cannot target non-addressable devices, so the global array is built
+    from per-shard callbacks — each process materializes only the rows its
+    local devices own (replicated specs read the same full array
+    everywhere).  Callers pass the GLOBAL array on every host; per-host
+    disjoint loading composes via ``distributed.local_batch_slice``."""
+    import numpy as np
+    if jax.process_count() == 1:
+        return jax.device_put(arr, sharding)
+    a = np.asarray(arr)
+    return jax.make_array_from_callback(a.shape, sharding, lambda idx: a[idx])
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -18,6 +18,7 @@ The bubble is the standard GPipe (P-1)/(M+P-1) fraction; raise
 from __future__ import annotations
 
 import functools
+import logging
 from typing import Any, Callable
 
 import jax
@@ -25,6 +26,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from .mesh import vary_over
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 Array = jax.Array
 
@@ -85,8 +88,18 @@ def pipeline_apply(block_fn: Callable[[Any, Array], Array],
     b_local = x.shape[0] // dp
     if x.shape[0] % dp:
         raise ValueError(f"batch {x.shape[0]} not divisible by {dim0} ({dp})")
+    requested_microbatches = n_microbatches
     while b_local % n_microbatches:
         n_microbatches -= 1
+    if n_microbatches != requested_microbatches:
+        # GPipe bubble fraction is (stages-1)/(m+stages-1): shrinking m
+        # degrades pipelining — at m=1 every stage but one idles.  Never
+        # do this silently (a prime b_local collapses all the way to 1).
+        logger.warning(
+            "n_microbatches=%d does not divide local batch %d — degraded to "
+            "%d%s; pad the batch or pick a divisor to keep the pipeline full",
+            requested_microbatches, b_local, n_microbatches,
+            " (NO pipelining: full GPipe bubble)" if n_microbatches == 1 else "")
     param_spec = param_specs if param_specs is not None else \
         jax.tree_util.tree_map(
             lambda a: P(axis, *([None] * (a.ndim - 1))), stacked_params)

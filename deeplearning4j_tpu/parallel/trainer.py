@@ -25,7 +25,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..datasets.dataset import DataSet
 from ..datasets.iterators import DataSetIterator
-from .mesh import DATA_AXIS, MODEL_AXIS, build_mesh, infer_param_shardings, replicated
+from .mesh import (
+    DATA_AXIS, MODEL_AXIS, build_mesh, infer_param_shardings, put_global,
+    replicated,
+)
 
 
 class ShardedTrainer:
@@ -54,11 +57,20 @@ class ShardedTrainer:
         """Move params/opt-state onto the mesh (TP rules), replicate state."""
         net = self.net
         self.param_shardings = infer_param_shardings(net.params, self.mesh, self.model_axis)
-        net.params = jax.device_put(net.params, self.param_shardings)
+        net.params = jax.tree_util.tree_map(put_global, net.params,
+                                            self.param_shardings)
         # opt state mirrors param shapes (Adam m/v etc.) → same shardings
         net.opt_state = self._put_like_params(net.opt_state)
         rep = replicated(self.mesh)
-        net.state = jax.device_put(net.state, rep)
+        net.state = jax.tree_util.tree_map(lambda a: put_global(a, rep),
+                                           net.state)
+        # ephemeral device scalars (rng key, device iteration counter) may
+        # be committed to a PREVIOUS mesh (elastic resize) — pull to host
+        # and let the next step recommit them under this mesh
+        if getattr(net, "_rng", None) is not None:
+            net._rng = jnp.asarray(np.asarray(net._rng))
+        if getattr(net, "_it_dev", None) is not None:
+            net._it_dev = None
 
     def _put_like_params(self, opt_state):
         """Shard optimizer state structurally: per layer, each state subtree
@@ -76,9 +88,9 @@ class ShardedTrainer:
 
             def place_sub(sub):
                 if jax.tree_util.tree_structure(sub) == p_struct:
-                    return jax.tree_util.tree_map(jax.device_put, sub, s_layer)
+                    return jax.tree_util.tree_map(put_global, sub, s_layer)
                 return jax.tree_util.tree_map(
-                    lambda a: jax.device_put(a, rep), sub)
+                    lambda a: put_global(a, rep), sub)
 
             return {k: place_sub(v) for k, v in os_layer.items()}
 
@@ -107,7 +119,7 @@ class ShardedTrainer:
             raise ValueError(
                 f"global batch {arr.shape[0]} not divisible by data axis {dp} "
                 "(pad or drop the remainder — XLA needs static shapes)")
-        return jax.device_put(jnp.asarray(arr), self.batch_sharding)
+        return put_global(arr, self.batch_sharding)
 
     def shard_dataset(self, ds: DataSet) -> DataSet:
         """Pre-place a batch on the mesh (public so callers that reuse a
@@ -131,9 +143,14 @@ class ShardedTrainer:
     def fit(self, data, epochs: int = 1) -> List[float]:
         losses = []
         it = self.net._as_iterator(data)
+        synced = 0
         for _ in range(epochs):
             for ds in it:
                 losses.append(self.fit_batch(ds))
+            # one batched transfer per epoch frees the per-step buffers
+            from ..optimize.score import materialize_scores
+            materialize_scores(losses[synced:])
+            synced = len(losses)
             self.net.epoch += 1
         return losses
 

@@ -173,7 +173,7 @@ class ShardedTransformerLM:
 
         return jax.jit(step, donate_argnums=(0, 1))
 
-    def fit_batch(self, tokens: np.ndarray, targets: np.ndarray) -> float:
+    def fit_batch(self, tokens: np.ndarray, targets: np.ndarray):
         if self._jit_step is None:
             self._jit_step = self._build_step()
         tokens = jax.device_put(jnp.asarray(tokens, jnp.int32), self.token_sharding)
@@ -183,7 +183,8 @@ class ShardedTransformerLM:
                 self.params, self.opt_state,
                 jnp.asarray(self.iteration, jnp.int32), tokens, targets)
         self.iteration += 1
-        return float(loss)
+        from ..optimize.score import LazyScore
+        return LazyScore(loss)
 
     def logits(self, tokens: np.ndarray) -> Array:
         if self._jit_logits is None:

@@ -1,0 +1,6 @@
+from .pubsub import (
+    NDArrayConsumer,
+    NDArrayPublisher,
+    StreamingDataSetIterator,
+    TensorBroker,
+)

@@ -14,11 +14,12 @@ deep performance traces.
 from .stats import StatsListener
 from .storage import FileStatsStorage, InMemoryStatsStorage, SqliteStatsStorage
 from .render import render_dashboard
+from .remote import RemoteStatsRouter
 from .server import UIServer
 from .profiler import profile_trace
 
 __all__ = [
     "StatsListener",
     "InMemoryStatsStorage", "FileStatsStorage", "SqliteStatsStorage",
-    "render_dashboard", "UIServer", "profile_trace",
+    "render_dashboard", "RemoteStatsRouter", "UIServer", "profile_trace",
 ]

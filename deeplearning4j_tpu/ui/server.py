@@ -19,9 +19,11 @@ from .render import render_session_html
 class UIServer:
     """``UIServer(port).attach(storage).start()`` → browse /."""
 
-    def __init__(self, port: int = 9000, host: str = "127.0.0.1"):
+    def __init__(self, port: int = 9000, host: str = "127.0.0.1",
+                 enable_remote: bool = False):
         self.port = port
         self.host = host
+        self.enable_remote = enable_remote
         self._storages: List = []
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
@@ -29,6 +31,26 @@ class UIServer:
     def attach(self, storage) -> "UIServer":
         self._storages.append(storage)
         return self
+
+    def enable_remote_listener(self) -> "UIServer":
+        """Accept POSTed stats on /remote into the first attached storage
+        (reference RemoteReceiverModule: UIServer.enableRemoteListener())."""
+        self.enable_remote = True
+        return self
+
+    def _handle_remote(self, body: bytes) -> int:
+        """POST /remote body: {"session_id": ..., "record": {...}} or a
+        list of such — returns HTTP status."""
+        import json
+        if not self.enable_remote:
+            return 403
+        if not self._storages:
+            return 503
+        payload = json.loads(body)
+        items = payload if isinstance(payload, list) else [payload]
+        for item in items:
+            self._storages[0].put_update(item["session_id"], item["record"])
+        return 200
 
     def _render_index(self) -> str:
         rows = []
@@ -66,6 +88,21 @@ class UIServer:
                     self.send_response(500)
                     self.end_headers()
                     self.wfile.write(str(e).encode())
+
+            def do_POST(self):
+                try:
+                    if self.path != "/remote":
+                        self.send_response(404)
+                        self.end_headers()
+                        return
+                    n = int(self.headers.get("Content-Length", 0))
+                    code = server._handle_remote(self.rfile.read(n))
+                    self.send_response(code)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                except Exception:
+                    self.send_response(400)
+                    self.end_headers()
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_address[1]  # resolves port=0

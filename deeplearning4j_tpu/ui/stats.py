@@ -90,6 +90,10 @@ class StatsListener(TrainingListener):
 
     # -- TrainingListener --------------------------------------------------
 
+    # reads model.params each callback → needs each chunk's params, not
+    # end-of-batch params, under fused multi-step (TBPTT scan) paths
+    requires_model_state = True
+
     def iteration_done(self, model, iteration: int, loss: float) -> None:
         if iteration % self.update_frequency != 0:
             return

@@ -1,0 +1,70 @@
+"""Worker for the 2-process distributed test (NOT collected by pytest).
+
+Usage: python _mp_worker.py <process_id> <num_processes> <port> <out.json>
+
+Each process gets 4 virtual CPU devices; together they form the 8-device
+global mesh — the reference's `local[N]` Spark-test analog
+(BaseSparkTest.java:89) across real OS processes with a real coordinator.
+"""
+
+import json
+import os
+import sys
+
+proc_id, nproc, port, out_path = (int(sys.argv[1]), int(sys.argv[2]),
+                                  sys.argv[3], sys.argv[4])
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=4").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.datasets import DataSet  # noqa: E402
+from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: E402
+from deeplearning4j_tpu.nn.layers import Dense, OutputLayer  # noqa: E402
+from deeplearning4j_tpu.nn.multilayer import (  # noqa: E402
+    MultiLayerNetwork, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.updaters import Sgd  # noqa: E402
+from deeplearning4j_tpu.parallel import ShardedTrainer, build_mesh  # noqa: E402
+from deeplearning4j_tpu.parallel import distributed  # noqa: E402
+
+distributed.initialize(coordinator_address=f"127.0.0.1:{port}",
+                       num_processes=nproc, process_id=proc_id)
+
+assert jax.process_count() == nproc
+assert jax.local_device_count() == 4
+assert jax.device_count() == 4 * nproc
+assert distributed.is_coordinator() == (proc_id == 0)
+
+# deterministic model + data, identical on every process
+conf = (NeuralNetConfiguration.builder()
+        .seed(7)
+        .updater(Sgd(lr=0.1))
+        .layer(Dense(n_out=16, activation="tanh"))
+        .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(6)).build())
+net = MultiLayerNetwork(conf)
+net.init()
+
+mesh = build_mesh({"data": 4 * nproc})
+trainer = ShardedTrainer(net, mesh)
+
+rng = np.random.default_rng(0)
+B = 32
+x = rng.normal(size=(B, 6)).astype(np.float32)
+y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, B)]
+
+# sanity: per-host disjoint loading helper covers the whole batch
+sl = distributed.local_batch_slice(B)
+assert (sl.stop - sl.start) * nproc == B
+
+losses = [float(trainer.fit_batch(DataSet(x, y))) for _ in range(5)]
+
+with open(out_path, "w") as f:
+    json.dump({"process": proc_id, "losses": losses,
+               "devices": jax.device_count()}, f)

@@ -1,0 +1,83 @@
+"""Multi-process distributed training proof (VERDICT round 2, Next #4):
+2 OS processes × 4 virtual CPU devices, a real jax.distributed
+coordinator on localhost, one global 8-device mesh, cross-process psum —
+the reference's `local[N]` Spark test (BaseSparkTest.java:89) with real
+process boundaries.  Asserts loss parity with the single-process
+8-device run of the identical seeded model."""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_WORKER = os.path.join(_REPO, "tests", "_mp_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _single_process_reference():
+    """Same seeded model/data on the in-process 8-device mesh."""
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import (
+        MultiLayerNetwork, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    from deeplearning4j_tpu.parallel import ShardedTrainer, build_mesh
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater(Sgd(lr=0.1))
+            .layer(Dense(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    trainer = ShardedTrainer(net, build_mesh({"data": 8}))
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(32, 6)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+    return [float(trainer.fit_batch(DataSet(x, y))) for _ in range(5)]
+
+
+def test_two_process_cluster_matches_single_process(tmp_path):
+    port = _free_port()
+    outs = [str(tmp_path / f"proc{i}.json") for i in range(2)]
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)  # worker sets its own 4-device flag
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [subprocess.Popen(
+        [sys.executable, _WORKER, str(i), "2", str(port), outs[i]],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE)
+        for i in range(2)]
+    results = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process worker timed out")
+        results.append((p.returncode, out, err))
+    for rc, out, err in results:
+        assert rc == 0, f"worker failed:\n{err.decode()[-3000:]}"
+    payloads = [json.load(open(o)) for o in outs]
+    # both processes observed the global mesh and agree on every loss
+    assert all(p["devices"] == 8 for p in payloads)
+    np.testing.assert_allclose(payloads[0]["losses"], payloads[1]["losses"],
+                               rtol=1e-6)
+    # and the 2-process run matches the single-process 8-device run
+    ref = _single_process_reference()
+    np.testing.assert_allclose(payloads[0]["losses"], ref, rtol=1e-4)
+    assert payloads[0]["losses"][-1] < payloads[0]["losses"][0]

@@ -1,0 +1,225 @@
+"""Networked periphery — loopback integration tests (VERDICT round 2,
+Missing #2/#3/#4): tensor pub-sub streaming, KNN REST server/client,
+remote stats routing.  Everything runs on 127.0.0.1 with auto-assigned
+ports; no external services."""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    NearestNeighborsClient,
+    NearestNeighborsServer,
+)
+from deeplearning4j_tpu.streaming import (
+    NDArrayConsumer,
+    NDArrayPublisher,
+    StreamingDataSetIterator,
+    TensorBroker,
+)
+from deeplearning4j_tpu.ui import InMemoryStatsStorage, RemoteStatsRouter, UIServer
+
+
+class TestTensorPubSub:
+    def test_publish_consume_roundtrip(self):
+        broker = TensorBroker().start()
+        try:
+            sub = NDArrayConsumer(broker.address, "t").connect()
+            time.sleep(0.05)  # let the broker register the subscription
+            pub = NDArrayPublisher(broker.address, "t").connect()
+            rng = np.random.default_rng(0)
+            sent = [rng.normal(size=(4, 3)).astype(np.float32) for _ in range(5)]
+            for a in sent:
+                pub.publish(a)
+            got = [sub.next(timeout=5) for _ in range(5)]
+            for a, b in zip(sent, got):
+                np.testing.assert_allclose(a, b)
+            pub.close()
+            sub.close()
+        finally:
+            broker.stop()
+
+    def test_fanout_to_multiple_subscribers(self):
+        broker = TensorBroker().start()
+        try:
+            subs = [NDArrayConsumer(broker.address, "x").connect()
+                    for _ in range(3)]
+            time.sleep(0.05)
+            pub = NDArrayPublisher(broker.address, "x").connect()
+            arr = np.arange(6, dtype=np.float32).reshape(2, 3)
+            pub.publish(arr)
+            for s in subs:
+                np.testing.assert_allclose(s.next(timeout=5), arr)
+        finally:
+            broker.stop()
+
+    def test_topic_isolation(self):
+        broker = TensorBroker().start()
+        try:
+            sub_a = NDArrayConsumer(broker.address, "a").connect()
+            sub_b = NDArrayConsumer(broker.address, "b").connect()
+            time.sleep(0.05)
+            NDArrayPublisher(broker.address, "a").connect().publish(
+                np.ones((2,), np.float32))
+            np.testing.assert_allclose(sub_a.next(timeout=5), np.ones(2))
+            with pytest.raises(Exception):  # queue.Empty
+                sub_b._q.get(timeout=0.2)
+        finally:
+            broker.stop()
+
+    def test_streaming_iterator_trains_a_model(self):
+        """End-to-end: stream feature/label batches through the broker into
+        MultiLayerNetwork.fit (the reference's Camel-route role)."""
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import (
+            MultiLayerNetwork, NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.updaters import Adam
+
+        broker = TensorBroker().start()
+        try:
+            it = StreamingDataSetIterator(broker.address, max_batches=4,
+                                          timeout=10)
+            time.sleep(0.05)
+            fpub = NDArrayPublisher(broker.address, "features").connect()
+            lpub = NDArrayPublisher(broker.address, "labels").connect()
+            rng = np.random.default_rng(0)
+            for _ in range(4):
+                labels = rng.integers(0, 2, 16)
+                x = (labels[:, None] * 2.0 - 1.0
+                     + rng.normal(scale=0.3, size=(16, 4))).astype(np.float32)
+                fpub.publish(x)
+                lpub.publish(np.eye(2, dtype=np.float32)[labels])
+            conf = (NeuralNetConfiguration.builder()
+                    .updater(Adam(lr=0.05))
+                    .layer(Dense(n_out=8, activation="relu"))
+                    .layer(OutputLayer(n_out=2, activation="softmax",
+                                       loss="mcxent"))
+                    .set_input_type(InputType.feed_forward(4)).build())
+            net = MultiLayerNetwork(conf)
+            net.init()
+            losses = net.fit(it)
+            assert len(losses) == 4
+            assert all(np.isfinite(float(l)) for l in losses)
+        finally:
+            broker.stop()
+
+
+class TestKnnServer:
+    @pytest.fixture()
+    def server(self):
+        rng = np.random.default_rng(0)
+        pts = rng.normal(size=(50, 8)).astype(np.float32)
+        srv = NearestNeighborsServer(pts).start()
+        yield srv, pts
+        srv.stop()
+
+    def test_knnnew_matches_local_index(self, server):
+        srv, pts = server
+        client = NearestNeighborsClient(srv.url)
+        q = pts[7] + 0.01
+        results = client.knn_new(q, k=3)
+        assert len(results) == 3
+        assert results[0]["index"] == 7
+        d_local, i_local = srv.index.knn(q[None, :], 3)
+        assert [r["index"] for r in results] == [int(x) for x in i_local[0]]
+        np.testing.assert_allclose([r["distance"] for r in results],
+                                   d_local[0], rtol=1e-5)
+
+    def test_knn_by_id_excludes_self(self, server):
+        srv, pts = server
+        client = NearestNeighborsClient(srv.url)
+        results = client.knn(index=3, k=4)
+        assert len(results) == 4
+        assert all(r["index"] != 3 for r in results)
+
+    def test_bad_requests_are_400(self, server):
+        srv, _ = server
+        req = urllib.request.Request(
+            srv.url + "/knn", data=json.dumps({"id": 999, "k": 2}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 400
+
+
+class TestRemoteStatsRouting:
+    def test_router_posts_into_remote_storage(self):
+        storage = InMemoryStatsStorage()
+        ui = UIServer(port=0, enable_remote=True).attach(storage).start()
+        try:
+            router = RemoteStatsRouter(f"http://127.0.0.1:{ui.port}")
+            router.put_update("sess-1", {"iteration": 1, "score": 0.5})
+            router.put_update("sess-1", {"iteration": 2, "score": 0.4})
+            assert storage.list_session_ids() == ["sess-1"]
+            recs = storage.get_updates("sess-1")
+            assert [r["iteration"] for r in recs] == [1, 2]
+        finally:
+            ui.stop()
+
+    def test_remote_disabled_rejects(self):
+        storage = InMemoryStatsStorage()
+        ui = UIServer(port=0).attach(storage).start()  # remote NOT enabled
+        try:
+            router = RemoteStatsRouter(f"http://127.0.0.1:{ui.port}",
+                                       max_retries=1, backoff=0.01)
+            router.put_update("s", {"iteration": 1})
+            assert storage.get_updates("s") == []
+            assert len(router._pending) == 1  # buffered, not lost
+        finally:
+            ui.stop()
+
+    def test_buffering_and_flush_after_outage(self):
+        router = RemoteStatsRouter("http://127.0.0.1:1", max_retries=1,
+                                   backoff=0.01, timeout=0.2)
+        router.put_update("s", {"iteration": 1})
+        assert len(router._pending) == 1  # dead endpoint → buffered
+        storage = InMemoryStatsStorage()
+        ui = UIServer(port=0, enable_remote=True).attach(storage).start()
+        try:
+            router.url = f"http://127.0.0.1:{ui.port}/remote"
+            router.put_update("s", {"iteration": 2})
+            recs = storage.get_updates("s")
+            assert [r["iteration"] for r in recs] == [1, 2]
+            assert router._pending == []
+        finally:
+            ui.stop()
+
+    def test_statslistener_through_router_end_to_end(self):
+        """Train in-process, stats appear in the 'remote' UIServer storage —
+        the RemoteUIStatsStorageRouter.java:32 flow on loopback."""
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import (
+            MultiLayerNetwork, NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.ui import StatsListener
+
+        storage = InMemoryStatsStorage()
+        ui = UIServer(port=0, enable_remote=True).attach(storage).start()
+        try:
+            router = RemoteStatsRouter(f"http://127.0.0.1:{ui.port}")
+            conf = (NeuralNetConfiguration.builder()
+                    .layer(Dense(n_out=8, activation="relu"))
+                    .layer(OutputLayer(n_out=2, activation="softmax",
+                                       loss="mcxent"))
+                    .set_input_type(InputType.feed_forward(4)).build())
+            net = MultiLayerNetwork(conf)
+            net.init()
+            net.set_listeners(StatsListener(router, session_id="remote-run",
+                                            update_frequency=1))
+            rng = np.random.default_rng(0)
+            from deeplearning4j_tpu.datasets import DataSet
+            ds = DataSet(rng.normal(size=(16, 4)).astype(np.float32),
+                         np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)])
+            for _ in range(3):
+                net.fit_batch(ds)
+            recs = storage.get_updates("remote-run")
+            assert len(recs) == 3
+            assert all("score" in r for r in recs)
+        finally:
+            ui.stop()

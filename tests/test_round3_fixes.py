@@ -1,0 +1,225 @@
+"""Round-3 correctness/completeness closures (VERDICT Weak #6/#7,
+Missing #7/#9 + ADVICE items): spatial dropout semantics, Keras
+Concatenate-axis rejection, elastic restart-counter reset, pipeline
+microbatch degradation warning, LFW iterator, remote-storage seam."""
+
+import io
+import logging
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.datasets.fetchers import LFWDataSetIterator, load_lfw
+from deeplearning4j_tpu.datasets.remote import (
+    RemoteDataSetIterator,
+    load_dataset,
+    save_dataset,
+)
+from deeplearning4j_tpu.nn.conf.regularizers import SpatialDropout
+
+
+class TestSpatialDropout:
+    def test_drops_whole_channels(self):
+        sd = SpatialDropout(p=0.5)
+        rng = jax.random.PRNGKey(0)
+        x = jnp.ones((8, 6, 6, 16), jnp.float32)
+        y = np.asarray(sd.apply(rng, x, train=True))
+        # each (sample, channel) slice is all-zero or all-scaled
+        for b in range(8):
+            for c in range(16):
+                sl = y[b, :, :, c]
+                assert np.all(sl == 0.0) or np.allclose(sl, 2.0), \
+                    "channel partially dropped — not spatial semantics"
+        # roughly half survive
+        kept = (y[:, 0, 0, :] != 0).mean()
+        assert 0.2 < kept < 0.8
+
+    def test_rnn_rank3_mask_shape(self):
+        sd = SpatialDropout(p=0.5)
+        y = np.asarray(sd.apply(jax.random.PRNGKey(1),
+                                jnp.ones((4, 10, 8)), train=True))
+        for b in range(4):
+            for f in range(8):
+                sl = y[b, :, f]
+                assert np.all(sl == 0.0) or np.allclose(sl, 2.0)
+
+    def test_inference_identity(self):
+        sd = SpatialDropout(p=0.5)
+        x = jnp.ones((2, 3, 3, 4))
+        assert np.allclose(sd.apply(jax.random.PRNGKey(0), x, train=False), x)
+
+    def test_keras_spatial_dropout_maps_to_channel_dropout(self):
+        from deeplearning4j_tpu.modelimport.keras import _map_spatial_dropout
+        layer = _map_spatial_dropout({"rate": 0.3, "name": "sd"})
+        assert isinstance(layer.dropout, SpatialDropout)
+        assert layer.dropout.p == pytest.approx(0.3)
+
+
+class TestKerasConcatenateAxis:
+    def test_non_trailing_axis_rejected(self):
+        from deeplearning4j_tpu.modelimport.keras import (
+            InvalidKerasConfigurationException, _check_concatenate_axis,
+        )
+        with pytest.raises(InvalidKerasConfigurationException, match="axis"):
+            _check_concatenate_axis({"axis": 1}, "cat", in_rank=3)
+
+    def test_trailing_axis_ok(self):
+        from deeplearning4j_tpu.modelimport.keras import _check_concatenate_axis
+        _check_concatenate_axis({"axis": -1}, "cat", in_rank=3)
+        _check_concatenate_axis({"axis": 2}, "cat", in_rank=3)
+        _check_concatenate_axis({}, "cat", in_rank=None)
+
+
+class TestElasticRestartReset:
+    def test_counter_resets_after_successful_steps(self, tmp_path):
+        from deeplearning4j_tpu.parallel.elastic import ElasticTrainer
+
+        class Flaky:
+            """Fails once every `every` steps with a recoverable error."""
+
+            def __init__(self, every):
+                self.calls = 0
+                self.every = every
+                self.params, self.state, self.opt_state, self.iteration = [], [], [], 0
+
+            def fit_batch(self, ds):
+                self.calls += 1
+                if self.calls % self.every == 0:
+                    raise RuntimeError("DATA_LOSS: preemption")  # recoverable
+                return 0.5
+
+            def save(self, path):
+                with open(path, "w") as f:
+                    f.write("ckpt")
+
+        inner = Flaky(every=7)
+        tr = ElasticTrainer(inner, str(tmp_path), checkpoint_every=1000,
+                            max_restarts=2, restart_reset_after=3,
+                            loader=lambda p: None, sync_every=1)
+        # 30 steps → ~4 failures, each separated by ≥3 successes: with the
+        # reset the lifetime count never exceeds max_restarts=2
+        for _ in range(30):
+            tr.fit_batch(None)
+        assert tr.restarts <= 2
+
+    def test_without_reset_same_run_would_die(self, tmp_path):
+        from deeplearning4j_tpu.parallel.elastic import ElasticTrainer
+
+        class Flaky:
+            def __init__(self):
+                self.calls = 0
+                self.params, self.state, self.opt_state, self.iteration = [], [], [], 0
+
+            def fit_batch(self, ds):
+                self.calls += 1
+                if self.calls % 7 == 0:
+                    raise RuntimeError("DATA_LOSS: preemption")
+                return 0.5
+
+            def save(self, path):
+                open(path, "w").write("ckpt")
+
+        tr = ElasticTrainer(Flaky(), str(tmp_path), checkpoint_every=1000,
+                            max_restarts=2, restart_reset_after=10**9,
+                            loader=lambda p: None, sync_every=1)
+        with pytest.raises(RuntimeError, match="max_restarts"):
+            for _ in range(40):
+                tr.fit_batch(None)
+
+
+class TestPipelineMicrobatchWarning:
+    def test_degradation_logged(self, caplog):
+        from deeplearning4j_tpu.parallel.mesh import build_mesh
+        from deeplearning4j_tpu.parallel.pipeline import (
+            pipeline_apply, stack_stage_params, stage_sharding,
+        )
+        mesh = build_mesh({"pipe": 2}, devices=jax.devices()[:2])
+        rng = np.random.default_rng(0)
+        params = [{"W": rng.normal(size=(6, 6)).astype(np.float32)} for _ in range(2)]
+        stacked = jax.device_put(stack_stage_params(params),
+                                 stage_sharding(mesh, stack_stage_params(params)))
+        x = rng.normal(size=(7, 6)).astype(np.float32)  # 7 is prime
+
+        def stage(p, h):
+            return jnp.tanh(h @ p["W"])
+
+        with caplog.at_level(logging.WARNING, logger="deeplearning4j_tpu"):
+            pipeline_apply(stage, stacked, jnp.asarray(x), mesh, axis="pipe",
+                           n_microbatches=4, data_axis=None)
+        assert any("microbatch" in r.message.lower() for r in caplog.records)
+
+
+class TestLFW:
+    def test_real_layout_roundtrip(self, tmp_path, monkeypatch):
+        from PIL import Image
+        root = tmp_path / "lfw"
+        rng = np.random.default_rng(0)
+        for person, n in (("Ada_Lovelace", 5), ("Grace_Hopper", 5),
+                          ("One_Shot", 1)):
+            d = root / person
+            d.mkdir(parents=True)
+            for i in range(n):
+                arr = rng.integers(0, 255, (250, 250, 3)).astype(np.uint8)
+                Image.fromarray(arr).save(d / f"{person}_{i:04d}.jpg")
+        monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+        xs, ys = load_lfw(train=True, min_faces_per_person=2)
+        # One_Shot excluded; 80% of 5 = 4 each
+        assert xs.shape == (8, 250, 250, 3) and set(ys) == {0, 1}
+        xs_t, ys_t = load_lfw(train=False, min_faces_per_person=2)
+        assert xs_t.shape[0] == 2
+        it = LFWDataSetIterator(batch_size=4, train=True,
+                                min_faces_per_person=2)
+        batch = next(iter(it))
+        assert batch.features.shape == (4, 250, 250, 3)
+        assert batch.labels.shape == (4, 2)
+
+    def test_synthetic_fallback(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+        xs, ys = load_lfw(train=True, synthetic_n=16, image_size=32)
+        assert xs.shape == (16, 32, 32, 3)
+
+
+class TestRemoteStorageSeam:
+    def test_dataset_npz_roundtrip(self):
+        rng = np.random.default_rng(0)
+        ds = DataSet(rng.normal(size=(4, 3)).astype(np.float32),
+                     np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)])
+        buf = io.BytesIO()
+        save_dataset(ds, buf)
+        buf.seek(0)
+        ds2 = load_dataset(buf)
+        np.testing.assert_allclose(ds.features, ds2.features)
+        np.testing.assert_allclose(ds.labels, ds2.labels)
+
+    def test_remote_iterator_streams_local_uri(self, tmp_path):
+        rng = np.random.default_rng(1)
+        for i in range(3):
+            ds = DataSet(rng.normal(size=(4, 3)).astype(np.float32),
+                         np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)])
+            with open(tmp_path / f"part{i}.npz", "wb") as f:
+                save_dataset(ds, f)
+        it = RemoteDataSetIterator(f"file://{tmp_path}")
+        batches = list(it)
+        assert len(batches) == 3
+        assert batches[0].features.shape == (4, 3)
+        # re-iterable (reset semantics)
+        assert len(list(it)) == 3
+
+    def test_unknown_scheme_clear_error(self):
+        with pytest.raises(ValueError, match="provider"):
+            RemoteDataSetIterator("gs://bucket/prefix")
+
+    def test_s3_without_boto3_clear_error(self):
+        from deeplearning4j_tpu.datasets.remote import S3Provider
+        try:
+            import boto3  # noqa: F401
+            pytest.skip("boto3 present")
+        except ImportError:
+            pass
+        with pytest.raises(ImportError, match="boto3"):
+            S3Provider()

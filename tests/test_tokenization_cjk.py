@@ -1,0 +1,117 @@
+"""CJK tokenization + factory registry (VERDICT round 2, Missing #5 —
+the capability behind deeplearning4j-nlp-chinese/japanese/korean):
+segmentation modes, user-dictionary hook, mixed-script handling, and
+Word2Vec training on an unspaced CJK corpus end-to-end."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    AggregatingSentenceIterator,
+    CJKTokenizerFactory,
+    CollectionSentenceIterator,
+    Word2Vec,
+    get_tokenizer_factory,
+    register_tokenizer_factory,
+)
+
+
+class TestCJKTokenizer:
+    def test_char_mode(self):
+        tf = CJKTokenizerFactory(mode="char")
+        assert tf.tokenize("我爱北京") == ["我", "爱", "北", "京"]
+
+    def test_bigram_mode(self):
+        tf = CJKTokenizerFactory(mode="bigram")
+        assert tf.tokenize("我爱北京") == ["我爱", "爱北", "北京"]
+
+    def test_single_char_run_is_unigram(self):
+        tf = CJKTokenizerFactory(mode="bigram")
+        assert tf.tokenize("我") == ["我"]
+
+    def test_user_dictionary_longest_match(self):
+        tf = CJKTokenizerFactory(user_dictionary=["北京", "北京大学"],
+                                 mode="char")
+        # longest dictionary word wins; leftovers fall back to chars
+        assert tf.tokenize("我爱北京大学") == ["我", "爱", "北京大学"]
+
+    def test_dictionary_with_bigram_fallback(self):
+        tf = CJKTokenizerFactory(user_dictionary=["東京"], mode="bigram")
+        toks = tf.tokenize("私は東京です")
+        assert "東京" in toks
+        assert all(len(t) <= 2 for t in toks)
+
+    def test_mixed_script(self):
+        tf = CJKTokenizerFactory(user_dictionary=["机器学习"], mode="char")
+        toks = tf.tokenize("我用 JAX 做机器学习 v2!")
+        assert "jax" in toks          # latin words lowercased/cleaned
+        assert "机器学习" in toks      # dictionary hit
+        assert "v2" in toks
+
+    def test_hangul_and_kana_covered(self):
+        tf = CJKTokenizerFactory(mode="char")
+        assert tf.tokenize("한국") == ["한", "국"]
+        assert tf.tokenize("カタカナ") != []
+
+    def test_bad_mode_rejected(self):
+        with pytest.raises(ValueError, match="mode"):
+            CJKTokenizerFactory(mode="word")
+
+
+class TestRegistry:
+    def test_builtin_names(self):
+        for name in ("default", "cjk", "chinese", "japanese", "korean"):
+            assert get_tokenizer_factory(name) is not None
+
+    def test_kwargs_pass_through(self):
+        tf = get_tokenizer_factory("chinese", user_dictionary=["北京"],
+                                   mode="char")
+        assert tf.tokenize("北京") == ["北京"]
+
+    def test_unknown_name_lists_known(self):
+        with pytest.raises(ValueError, match="default"):
+            get_tokenizer_factory("klingon")
+
+    def test_custom_registration(self):
+        class Upper:
+            def tokenize(self, s):
+                return s.upper().split()
+
+        register_tokenizer_factory("upper-test", Upper)
+        assert get_tokenizer_factory("upper-test").tokenize("a b") == ["A", "B"]
+
+
+class TestSentenceIterators:
+    def test_aggregating_with_preprocessor(self):
+        it = AggregatingSentenceIterator(
+            CollectionSentenceIterator(["a b", "c"]),
+            CollectionSentenceIterator(["d"]),
+            preprocessor=str.upper)
+        assert list(it) == ["A B", "C", "D"]
+
+
+class TestWord2VecCJK:
+    def test_word2vec_trains_on_unspaced_cjk_corpus(self):
+        """End-to-end: unspaced CJK sentences → CJK tokenizer → Word2Vec;
+        words from the same topic end up closer than across topics."""
+        rng = np.random.default_rng(0)
+        animals = ["猫咪", "狗狗", "宠物", "毛皮"]
+        computers = ["电脑", "内存", "代码", "芯片"]
+        sentences = []
+        for _ in range(300):
+            topic = animals if rng.integers(0, 2) == 0 else computers
+            sentences.append("".join(rng.choice(topic, size=8)))
+        w2v = Word2Vec(layer_size=32, window=3, min_word_frequency=2,
+                       epochs=12, batch_size=128, learning_rate=0.05,
+                       seed=1, subsampling=0,
+                       tokenizer_factory=CJKTokenizerFactory(
+                           user_dictionary=animals + computers, mode="char"))
+        w2v.fit(sentences)
+        assert w2v.has_word("猫咪") and w2v.has_word("电脑")
+        within = w2v.similarity("猫咪", "狗狗")
+        across = w2v.similarity("猫咪", "电脑")
+        assert within > across + 0.2, f"within={within:.3f} across={across:.3f}"
+
+    def test_string_factory_name(self):
+        w2v = Word2Vec(tokenizer_factory="cjk")
+        assert isinstance(w2v.tokenizer, CJKTokenizerFactory)
