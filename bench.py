@@ -307,6 +307,58 @@ def bench_sharded_resnet(platform: str):
             "allreduce_gbps": round(grad_bytes / sec / 1e9, 3)}
 
 
+def bench_flash_attention(platform: str):
+    """Config 6 (TPU-first extension; no DL4J analog): fused flash
+    attention fwd+bwd at T=4096 vs the XLA O(T²) path — tokens/sec plus
+    the backward's temp-memory footprint (the reason the kernel exists)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.attention import flash_mha, mha
+
+    B, H, T, D = 2, 8, (512 if QUICK else 4096), 64
+    rng = np.random.default_rng(0)
+    mk = lambda: jnp.asarray(rng.normal(size=(B, H, T, D))
+                             .astype(np.float32)).astype(jnp.bfloat16)
+    q, k, v = mk(), mk(), mk()
+    mask = np.ones((B, T), np.float32)
+    mask[0, int(T * 0.7):] = 0.0
+    mj = jnp.asarray(mask)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_mha(q, k, v, True, kmask=mj).astype(jnp.float32) ** 2)
+
+    def loss_xla(q, k, v):
+        return jnp.sum(mha(q, k, v, causal=True,
+                           mask=mj[:, None, None, :]).astype(jnp.float32) ** 2)
+
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+    gx = jax.jit(jax.grad(loss_xla, argnums=(0, 1, 2)))
+
+    def timeit(f, n=(5 if QUICK else 30)):
+        f(q, k, v)
+        _sync(f(q, k, v))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            r = f(q, k, v)
+        _sync(r)
+        return (time.perf_counter() - t0) / n
+
+    sec_f, sec_x = timeit(gf), timeit(gx)
+    out = {"metric": "flash_attn_fwdbwd_tokens_per_sec",
+           "value": round(B * T / sec_f, 1), "unit": "tokens/sec",
+           "seq_len": T, "xla_tokens_per_sec": round(B * T / sec_x, 1),
+           "speedup_vs_xla": round(sec_x / sec_f, 3)}
+    try:
+        mf = gf.lower(q, k, v).compile().memory_analysis()
+        mx = gx.lower(q, k, v).compile().memory_analysis()
+        out["bwd_temp_mb"] = round(mf.temp_size_in_bytes / 1e6, 1)
+        out["xla_bwd_temp_mb"] = round(mx.temp_size_in_bytes / 1e6, 1)
+    except Exception:
+        pass
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -319,7 +371,8 @@ def main() -> None:
                      ("lenet_cifar10", bench_lenet_cifar),
                      ("resnet50", lambda: bench_resnet50(platform)),
                      ("word2vec_lstm", bench_word2vec_lstm),
-                     ("sharded_resnet50", lambda: bench_sharded_resnet(platform))]:
+                     ("sharded_resnet50", lambda: bench_sharded_resnet(platform)),
+                     ("flash_attention", lambda: bench_flash_attention(platform))]:
         try:
             t0 = time.perf_counter()
             out = fn()

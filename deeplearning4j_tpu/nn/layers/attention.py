@@ -93,13 +93,13 @@ class SelfAttention(Layer):
         q = self._split_heads(x @ params["Wq"])     # [B,H,T,D]
         k = self._split_heads(x @ params["Wk"])
         v = self._split_heads(x @ params["Wv"])
-        if mask is not None:
-            # [B,T] sequence mask → [B,1,1,T] attend-to mask; masked shapes
-            # route to the XLA path (flash kernel is mask-free by design)
-            att_mask = mask[:, None, None, :]
-            out = mha(q, k, v, causal=self.causal, mask=att_mask)
-        elif self.kernel == "flash":
-            out = flash_mha(q, k, v, self.causal)
+        if self.kernel == "flash":
+            # [B,T] sequence masks ride the kernel's key-padding input —
+            # DL4J-style variable-length batches stay on the fused path
+            out = flash_mha(q, k, v, self.causal, kmask=mask)
+        elif mask is not None:
+            out = mha(q, k, v, causal=self.causal,
+                      mask=mask[:, None, None, :])
         else:
             out = mha(q, k, v, causal=self.causal)
         merged = merge_heads(out)

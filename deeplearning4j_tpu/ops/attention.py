@@ -120,10 +120,14 @@ def blockwise_update(acc, m, l, q, k, v, scale, bias=None):
 # ---------------------------------------------------------------------------
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+def _flash_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, lse_ref,
+                  acc_ref, m_ref, l_ref,
                   *, scale: float, causal: bool, block_q: int, block_k: int):
     """Grid (BH, nQ, nK), k innermost — TPU grids run sequentially, so the
-    running (acc, m, l) stats live in VMEM scratch across k-steps."""
+    running (acc, m, l) stats live in VMEM scratch across k-steps.  Also
+    emits the log-sum-exp per query row (the residual the fused backward
+    kernels need to rebuild p without a second online-softmax pass).
+    ``km_ref`` is the optional [1, block_k] key-padding mask (1 = attend)."""
     kb = pl.program_id(2)
     n_k = pl.num_programs(2)
 
@@ -137,6 +141,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
     bias = None
     if causal:
         bias = causal_bias(block_q, block_k, qb * block_q, kb * block_k)
+    if km_ref is not None:
+        kbias = jnp.where(km_ref[0, 0] != 0, 0.0, _NEG_INF).astype(jnp.float32)
+        bias = kbias[None, :] if bias is None else bias + kbias[None, :]
 
     def _step():
         acc, m, l = blockwise_update(
@@ -156,7 +163,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
 
     @pl.when(kb == n_k - 1)
     def _finish():
-        o_ref[0] = (acc_ref[:] / jnp.maximum(l_ref[:], 1e-30)).astype(o_ref.dtype)
+        l_safe = jnp.maximum(l_ref[:], 1e-30)
+        o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        # Rows that never saw a live key (m still at the −LARGE init; note
+        # l is NOT 0 there — every masked score is exactly −LARGE after
+        # f32 absorption, so p=1 per entry and l=S) take lse = +LARGE: the
+        # backward's p = exp(s − lse) then reconstructs to 0, i.e. flash's
+        # convention is ZERO gradients for fully-masked rows (see
+        # _xla_attention_bwd for the rationale and the mha difference).
+        lse = jnp.where(m_ref[:] > _NEG_INF / 2,
+                        m_ref[:] + jnp.log(l_safe), 1e30)
+        lse_ref[0, 0] = lse[:, 0].astype(lse_ref.dtype)
 
 
 def _pick_block(n: int, preferred: int = 128) -> int:
@@ -166,72 +183,257 @@ def _pick_block(n: int, preferred: int = 128) -> int:
     return 0
 
 
-def _flash_forward(q: Array, k: Array, v: Array, causal: bool,
-                   scale: float) -> Array:
+def _kernel_eligible(q, block_q: int, block_k: int) -> bool:
+    """The kernel targets the TPU memory spaces; run it compiled on tpu,
+    interpreted on cpu (tests), and fall back to plain XLA elsewhere (gpu).
+    f64 also falls back: the kernel accumulates in f32 VMEM scratch, which
+    would silently degrade float64 gradient checks."""
+    backend = jax.default_backend()
+    return (_HAS_PALLAS and block_q > 0 and block_k > 0
+            and backend in ("tpu", "cpu") and q.dtype != jnp.float64)
+
+
+def _flash_forward(q: Array, k: Array, v: Array, kmask, causal: bool,
+                   scale: float):
+    """→ (o [B,H,T,D], lse [B*H,T] or None-on-fallback)."""
     B, H, T, D = q.shape
     S = k.shape[2]
     block_q = _pick_block(T)
     block_k = _pick_block(S)
-    # the kernel targets the TPU memory spaces; run it compiled on tpu,
-    # interpreted on cpu (tests), and fall back to plain XLA elsewhere (gpu).
-    # f64 also falls back: the kernel accumulates in f32 VMEM scratch, which
-    # would silently degrade float64 gradient checks.
-    backend = jax.default_backend()
-    if not (_HAS_PALLAS and block_q and block_k and backend in ("tpu", "cpu")) \
-            or q.dtype == jnp.float64:
-        return mha(q, k, v, causal=causal, scale=scale)
+    if not _kernel_eligible(q, block_q, block_k):
+        m = None if kmask is None else kmask[:, None, None, :]
+        return mha(q, k, v, causal=causal, mask=m, scale=scale), None
 
     qf = q.reshape(B * H, T, D)
     kf = k.reshape(B * H, S, D)
     vf = v.reshape(B * H, S, D)
     grid = (B * H, T // block_q, S // block_k)
-    kernel = functools.partial(_flash_kernel, scale=scale, causal=causal,
-                               block_q=block_q, block_k=block_k)
-    out = pl.pallas_call(
+    base = functools.partial(_flash_kernel, scale=scale, causal=causal,
+                             block_q=block_q, block_k=block_k)
+    in_specs = [
+        pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [qf, kf, vf]
+    if kmask is not None:
+        # [B,1,S] row blocks (TPU pallas wants the last two block dims
+        # (8,128)-aligned or equal to the array's); batch = flat_bh // H
+        in_specs.append(pl.BlockSpec((1, 1, block_k),
+                                     lambda b, i, j, H=H: (b // H, 0, j)))
+        args.append(kmask.astype(jnp.int32)[:, None, :])
+        kernel = base
+    else:
+        def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_s, l_s):
+            base(q_ref, k_ref, v_ref, None, o_ref, lse_ref, acc, m_s, l_s)
+
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
+        in_specs=in_specs,
+        out_specs=[
             pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+            jax.ShapeDtypeStruct((B * H, 1, T), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
-        interpret=(backend == "cpu"),
-    )(qf, kf, vf)
-    return out.reshape(B, H, T, D)
+        interpret=(jax.default_backend() == "cpu"),
+    )(*args)
+    return out.reshape(B, H, T, D), lse
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
-def flash_mha(q: Array, k: Array, v: Array, causal: bool = False,
-              scale: Optional[float] = None) -> Array:
-    """Fused blockwise attention (pallas TPU kernel, O(T) memory forward).
-
-    Backward recomputes scores with XLA einsums (O(T²) bwd memory — the
-    standard recompute tradeoff; a fused pallas backward is a drop-in
-    upgrade behind this same VJP seam).  Padding masks aren't supported
-    here — layers with masks route to ``mha``.
-    """
-    if scale is None:
-        scale = 1.0 / math.sqrt(q.shape[-1])
-    return _flash_forward(q, k, v, causal, scale)
+# ---------------------------------------------------------------------------
+# fused backward kernels (FlashAttention-2 style, O(T) memory)
+# ---------------------------------------------------------------------------
 
 
-def _flash_fwd(q, k, v, causal, scale):
-    if scale is None:
-        scale = 1.0 / math.sqrt(q.shape[-1])
-    return _flash_forward(q, k, v, causal, scale), (q, k, v)
+def _bwd_tile(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, km_ref,
+              qi, ki, *, scale, causal, block_q, block_k):
+    """Shared tile math for both backward kernels: rebuild p from the saved
+    lse and form ds — ONE definition so the masking/lse conventions cannot
+    desynchronize between dq and dk/dv.
+
+    Masking is where()-style to match the XLA oracle: no gradient flows
+    through blocked score entries (ds hard-zeroed there).  Fully-masked
+    rows carry the lse=+LARGE sentinel from the forward, so p — and with
+    it every gradient — is exactly 0 for them.
+    Returns (qb, kb, vb, gb, p, ds) as f32."""
+    qb = q_ref[0].astype(jnp.float32)               # [bq, D]
+    kb = k_ref[0].astype(jnp.float32)               # [bk, D]
+    vb = v_ref[0].astype(jnp.float32)
+    gb = g_ref[0].astype(jnp.float32)
+    s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
+    bias = jnp.zeros((block_q, block_k), jnp.float32)
+    if causal:
+        bias = bias + causal_bias(block_q, block_k,
+                                  qi * block_q, ki * block_k)
+    if km_ref is not None:
+        bias = bias + jnp.where(km_ref[0, 0] != 0, 0.0,
+                                _NEG_INF).astype(jnp.float32)[None, :]
+    p = jnp.exp(s + bias - lse_ref[0, 0][:, None])  # [bq, bk]
+    dp = jnp.dot(gb, vb.T, preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0, 0][:, None]) * scale
+    ds = ds * (bias > _NEG_INF / 2).astype(jnp.float32)
+    return qb, kb, vb, gb, p, ds
 
 
-def _flash_bwd(causal, scale, res, g):
-    q, k, v = res
-    if scale is None:
-        scale = 1.0 / math.sqrt(q.shape[-1])
+def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                           km_ref, dk_ref, dv_ref, dk_acc, dv_acc,
+                           *, scale, causal, block_q, block_k):
+    """Grid (BH, nK, nQ), q innermost; dk/dv accumulate in VMEM scratch.
+    p is rebuilt per tile from the saved lse — no [T,S] materialization."""
+    qi = pl.program_id(2)
+    n_q = pl.num_programs(2)
+    ki = pl.program_id(1)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    def _step():
+        qb, _, _, gb, p, ds = _bwd_tile(
+            q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, km_ref, qi, ki,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+        dv_acc[:] += jnp.dot(p.T, gb, preferred_element_type=jnp.float32)
+        dk_acc[:] += jnp.dot(ds.T, qb, preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            _step()
+    else:
+        _step()
+
+    @pl.when(qi == n_q - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                         km_ref, dq_ref, dq_acc,
+                         *, scale, causal, block_q, block_k):
+    """Grid (BH, nQ, nK), k innermost; dq accumulates in VMEM scratch."""
+    ki = pl.program_id(2)
+    n_k = pl.num_programs(2)
+    qi = pl.program_id(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    def _step():
+        _, kb, _, _, _, ds = _bwd_tile(
+            q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, km_ref, qi, ki,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+        dq_acc[:] += jnp.dot(ds, kb, preferred_element_type=jnp.float32)
+
+    if causal:
+        @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
+        def _():
+            _step()
+    else:
+        _step()
+
+    @pl.when(ki == n_k - 1)
+    def _finish():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_backward(q, k, v, kmask, o, lse, g, causal, scale):
+    """Fused O(T)-memory backward: rebuild p per tile from lse.  Falls back
+    to the XLA recompute path when the forward did (lse is None)."""
+    B, H, T, D = q.shape
+    S = k.shape[2]
+    block_q = _pick_block(T)
+    block_k = _pick_block(S)
+    if lse is None or not _kernel_eligible(q, block_q, block_k):
+        return _xla_attention_bwd(q, k, v, kmask, g, causal, scale)
+
+    flat = lambda x: x.reshape(B * H, *x.shape[2:])
+    qf, kf, vf, gf = flat(q), flat(k), flat(v), flat(g)
+    # delta_i = Σ_d g_i·o_i — the softmax-jacobian row term (Dao 2023 eq. 4)
+    delta = jnp.sum(gf.astype(jnp.float32) * flat(o).astype(jnp.float32),
+                    axis=-1)[:, None, :]                       # [BH, 1, T]
+    interp = jax.default_backend() == "cpu"
+
+    q_spec_i = pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, j, 0))
+    k_spec_o = pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0))
+    row_spec_i = pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, j))
+    if kmask is not None:
+        kmi = kmask.astype(jnp.int32)[:, None, :]
+
+    # dk/dv: grid (BH, nK, nQ)
+    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+    base_kv = functools.partial(_flash_bwd_dkdv_kernel, **kw)
+    specs_kv = [q_spec_i, k_spec_o,
+                pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0)),
+                pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, j, 0)),
+                row_spec_i, row_spec_i]
+    args_kv = [qf, kf, vf, gf, lse, delta]
+    if kmask is not None:
+        specs_kv.append(pl.BlockSpec((1, 1, block_k),
+                                     lambda b, i, j, H=H: (b // H, 0, i)))
+        args_kv.append(kmi)
+        kernel_kv = base_kv
+    else:
+        def kernel_kv(q_r, k_r, v_r, g_r, l_r, d_r, dk_r, dv_r, dka, dva):
+            base_kv(q_r, k_r, v_r, g_r, l_r, d_r, None, dk_r, dv_r, dka, dva)
+    dk, dv = pl.pallas_call(
+        kernel_kv,
+        grid=(B * H, S // block_k, T // block_q),
+        in_specs=specs_kv,
+        out_specs=[pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0)),
+                   pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
+                   jax.ShapeDtypeStruct((B * H, S, D), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
+                        pltpu.VMEM((block_k, D), jnp.float32)],
+        interpret=interp,
+    )(*args_kv)
+
+    # dq: grid (BH, nQ, nK)
+    base_q = functools.partial(_flash_bwd_dq_kernel, **kw)
+    specs_q = [pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+               pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+               pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, j, 0)),
+               pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+               pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
+               pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i))]
+    args_q = [qf, kf, vf, gf, lse, delta]
+    if kmask is not None:
+        specs_q.append(pl.BlockSpec((1, 1, block_k),
+                                    lambda b, i, j, H=H: (b // H, 0, j)))
+        args_q.append(kmi)
+        kernel_q = base_q
+    else:
+        def kernel_q(q_r, k_r, v_r, g_r, l_r, d_r, dq_r, dqa):
+            base_q(q_r, k_r, v_r, g_r, l_r, d_r, None, dq_r, dqa)
+    dq = pl.pallas_call(
+        kernel_q,
+        grid=(B * H, T // block_q, S // block_k),
+        in_specs=specs_q,
+        out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        interpret=interp,
+    )(*args_q)
+
+    unflat = lambda x: x.reshape(B, H, *x.shape[1:])
+    return unflat(dq), unflat(dk), unflat(dv)
+
+
+def _xla_attention_bwd(q, k, v, kmask, g, causal, scale):
+    """XLA recompute backward (O(T²) memory) — the fallback for shapes the
+    kernels don't tile and for f64 gradient checks."""
     # accumulate in f32 for low-precision inputs, but keep f64 at f64 so the
     # float64 gradient-check suite stays meaningful (matches mha's contract)
     acc = jnp.float64 if q.dtype == jnp.float64 else jnp.float32
@@ -239,14 +441,65 @@ def _flash_bwd(causal, scale, res, g):
     s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
     if causal:
         s = s + causal_bias(s.shape[-2], s.shape[-1])
+    if kmask is not None:
+        s = jnp.where(kmask[:, None, None, :].astype(bool), s, _NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
+    if causal or kmask is not None:
+        # zero-grad convention for rows with NO live key (matches the
+        # kernel path's lse sentinel): their p degenerates to uniform,
+        # which would leak a dv contribution from rows whose output is
+        # garbage-by-convention.  (mha's autodiff leaks that dv; the
+        # flash contract documents the difference.)
+        p = p * jnp.any(s > _NEG_INF / 2, axis=-1, keepdims=True)
     gf = g.astype(acc)
     dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
     dp = jnp.einsum("bhqd,bhkd->bhqk", gf, vf)
     ds = p * (dp - jnp.sum(dp * p, axis=-1, keepdims=True))
+    if causal or kmask is not None:
+        # where()-style masking: no score gradient through blocked entries
+        ds = jnp.where(s > _NEG_INF / 2, ds, 0.0)
     dq = jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
     dk = jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-flash_mha.defvjp(_flash_fwd, _flash_bwd)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5))
+def _flash_mha_p(q: Array, k: Array, v: Array, kmask, causal: bool,
+                 scale: float) -> Array:
+    return _flash_forward(q, k, v, kmask, causal, scale)[0]
+
+
+def _flash_fwd(q, k, v, kmask, causal, scale):
+    o, lse = _flash_forward(q, k, v, kmask, causal, scale)
+    return o, (q, k, v, kmask, o, lse)
+
+
+def _flash_bwd(causal, scale, res, g):
+    q, k, v, kmask, o, lse = res
+    dq, dk, dv = _flash_backward(q, k, v, kmask, o, lse, g, causal, scale)
+    return dq, dk, dv, None  # mask carries no gradient
+
+
+_flash_mha_p.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_mha(q: Array, k: Array, v: Array, causal: bool = False,
+              scale: Optional[float] = None,
+              kmask: Optional[Array] = None) -> Array:
+    """Fused blockwise attention — pallas TPU kernels, O(T) memory in BOTH
+    directions (forward: online softmax; backward: per-tile p rebuilt from
+    the saved log-sum-exp, FlashAttention-2 style).
+
+    ``kmask`` [B, S] (1 = attend) supports DL4J-style variable-length
+    padding without leaving the kernel.  Shapes that don't tile, f64, and
+    non-TPU/CPU backends fall back to XLA with identical semantics — with
+    one documented exception: query rows whose EVERY key is masked get
+    ZERO gradients here (both paths), where ``mha``'s autodiff leaks a
+    uniform-p dv contribution from them.  Such rows' outputs are
+    garbage-by-convention in both (the attention layer zeroes them via the
+    output mask, under which the two are gradient-identical — see
+    tests/test_attention.py::test_fully_masked_rows_*).
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash_mha_p(q, k, v, kmask, causal, scale)

@@ -22,6 +22,7 @@ from .mesh import build_mesh, replicated, shard_batch, infer_param_shardings
 from .trainer import ShardedTrainer
 from .inference import ParallelInference
 from .ring import ring_attention, ring_self_attention
+from .ulysses import ulysses_attention, ulysses_self_attention
 from .pipeline import pipeline_apply, stack_stage_params, stage_sharding
 from .transformer import ShardedTransformerLM
 from .elastic import CheckpointManager, ElasticTrainer, FailureDetector

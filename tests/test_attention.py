@@ -70,6 +70,62 @@ class TestFlashKernel:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-4)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_padding_mask_matches_xla(self, causal):
+        """Variable-length batches (the DL4J-parity case) stay on the
+        kernel: key-padding mask in both forward and fused backward."""
+        q, k, v = _qkv(b=2, h=2, t=64, d=16, seed=5)
+        mask = np.ones((2, 64), np.float32)
+        mask[0, 41:] = 0.0
+        mask[1, 13:] = 0.0
+        mj = jnp.asarray(mask)
+        ref = mha(q, k, v, causal=causal, mask=mj[:, None, None, :])
+        out = flash_mha(q, k, v, causal, kmask=mj)
+        w = mask[:, None, :, None]
+        np.testing.assert_allclose(np.asarray(out) * w, np.asarray(ref) * w,
+                                   rtol=2e-5, atol=2e-5)
+
+        def loss_fl(q, k, v):
+            o = flash_mha(q, k, v, causal, kmask=mj)
+            return jnp.sum((o * mj[:, None, :, None]) ** 2)
+
+        def loss_ref(q, k, v):
+            o = mha(q, k, v, causal=causal, mask=mj[:, None, None, :])
+            return jnp.sum((o * mj[:, None, :, None]) ** 2)
+
+        g_fl = jax.grad(loss_fl, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_fl, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_fully_masked_rows_finite_and_output_masked_equal(self):
+        """All-keys-masked rows produce garbage-by-convention in BOTH paths
+        (flash: the additive −LARGE bias is a constant row shift, softmax
+        cancels it; mha: uniform over where()-replaced scores) — the DL4J
+        contract is that such rows are zeroed DOWNSTREAM by the output
+        mask, which is exactly what the attention layer does.  What must
+        hold: finiteness, and output-masked loss gradients equal."""
+        q, k, v = _qkv(b=2, h=2, t=32, d=16, seed=6)
+        mask = np.ones((2, 32), np.float32)
+        mask[0, :] = 0.0   # row 0: ALL keys masked
+        mask[1, 20:] = 0.0
+        mj = jnp.asarray(mask)
+        w = mj[:, None, :, None]
+
+        def loss_fl(q, k, v):
+            return jnp.sum((flash_mha(q, k, v, False, kmask=mj) * w) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum((mha(q, k, v, mask=mj[:, None, None, :]) * w) ** 2)
+
+        g_fl = jax.grad(loss_fl, argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_fl, g_ref):
+            assert np.all(np.isfinite(np.asarray(a)))
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
 
 def _seq_data(n=4, t=8, f=6, c=3):
     x = RNG.normal(size=(n, t, f))

@@ -1,0 +1,88 @@
+"""Ulysses all-to-all sequence parallelism (VERDICT round 2, Missing #1 /
+SURVEY §5 "Long-context"): logit + gradient parity vs single-device mha,
+padding-mask support, ring-vs-ulysses agreement, head-divisibility guard.
+Runs on the 8-device virtual CPU mesh."""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.attention import mha
+from deeplearning4j_tpu.parallel import (
+    build_mesh,
+    ring_self_attention,
+    ulysses_self_attention,
+)
+
+
+def _qkv(B=2, H=8, T=64, D=16, seed=0):
+    rng = np.random.default_rng(seed)
+    return tuple(jnp.asarray(rng.normal(size=(B, H, T, D)).astype(np.float32))
+                 for _ in range(3))
+
+
+class TestUlyssesParity:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_forward_matches_mha(self, causal):
+        mesh = build_mesh({"seq": 8})
+        q, k, v = _qkv()
+        ref = mha(q, k, v, causal=causal)
+        out = ulysses_self_attention(q, k, v, mesh, causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_gradient_matches_mha(self):
+        mesh = build_mesh({"seq": 8})
+        q, k, v = _qkv()
+
+        def loss_u(q, k, v):
+            return jnp.sum(ulysses_self_attention(q, k, v, mesh,
+                                                  causal=True) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(mha(q, k, v, causal=True) ** 2)
+
+        gu = jax.grad(loss_u, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gu, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-4, atol=5e-5)
+
+    def test_padding_mask(self):
+        mesh = build_mesh({"seq": 8})
+        q, k, v = _qkv()
+        mask = np.ones((2, 64), np.float32)
+        mask[0, 40:] = 0.0
+        mask[1, 17:] = 0.0
+        mj = jnp.asarray(mask)
+        ref = mha(q, k, v, mask=mj[:, None, None, :])
+        out = ulysses_self_attention(q, k, v, mesh, kmask=mj)
+        # compare valid query rows only (fully-masked rows are convention)
+        w = mask[:, None, :, None]
+        np.testing.assert_allclose(np.asarray(out) * w, np.asarray(ref) * w,
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_agrees_with_ring(self):
+        """Ring and Ulysses are drop-in alternatives — same numbers."""
+        mesh = build_mesh({"seq": 8})
+        q, k, v = _qkv(seed=3)
+        a = ring_self_attention(q, k, v, mesh, causal=True)
+        b = ulysses_self_attention(q, k, v, mesh, causal=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+
+    def test_head_divisibility_guard(self):
+        mesh = build_mesh({"seq": 8})
+        q, k, v = _qkv(H=4)  # 4 heads < 8 devices
+        with pytest.raises(ValueError, match="ring"):
+            ulysses_self_attention(q, k, v, mesh, causal=False)
+
+    def test_seq_divisibility_guard(self):
+        mesh = build_mesh({"seq": 8})
+        q, k, v = _qkv(T=60)
+        with pytest.raises(ValueError, match="divisible"):
+            ulysses_self_attention(q, k, v, mesh)
