@@ -1,11 +1,12 @@
-"""Benchmark suite — the 5 BASELINE.md configs.
+"""Benchmark suite — the 5 BASELINE.md configs + flash attention.
 
 Primary (driver) metric: ResNet-50 training images/sec on one chip,
-printed as ONE JSON line on stdout (the driver's contract).  The full
-5-config protocol (BASELINE.md: MLP/MNIST, LeNet/CIFAR, ResNet-50,
-Word2Vec + LSTM char-RNN, sharded ResNet-50 with gradient allreduce) is
-measured with a ≥100-step steady-state window and written to
-``bench_results.json`` / echoed on stderr, including:
+printed as ONE JSON line on stdout (the driver's contract).  The 6-config
+protocol (BASELINE.md: MLP/MNIST, LeNet/CIFAR, ResNet-50, Word2Vec +
+LSTM char-RNN, sharded ResNet-50 with gradient allreduce; plus the
+TPU-first flash-attention fwd+bwd config) is measured with a ≥100-step
+steady-state window and written to ``bench_results.json`` / echoed on
+stderr, including:
   - mfu: model FLOPs utilization from XLA's compiled cost analysis vs the
     chip's peak (TPU v5e bf16 ≈ 197 TFLOP/s)
   - allreduce_gbps: per-step gradient bytes x step rate — the DP gradient

@@ -16,12 +16,13 @@ driver network.  Multi-host: call parallel.distributed.initialize()
 first and feed each host its corpus shard; the same program then spans
 hosts.
 
-Cost model: the psum moves DENSE [V, D] delta tables every flush —
-O(V·D) collective traffic per batch, independent of batch size.  At ICI
-bandwidth this is fine up to ~10⁵-word vocabularies / large batches;
-beyond that, raise ``batch_size`` (fewer flushes) or fall back to
-single-device Word2Vec (row-sparse collectives are the future upgrade
-path here).
+Cost model: collectives are ROW-SPARSE — each flush all_gathers the
+per-pair gradient rows and indices, O(B·D·(2+K)) wire traffic per batch
+independent of vocabulary size (the round-2 dense-[V,D]-psum cap is
+gone; at B=4096, K=5, D=128 that's ~15MB/flush whether V is 10³ or
+10⁷).  The scatter-add into the replicated tables happens identically
+on every device from the gathered global pair set, preserving exact
+single-device occurrence-averaging semantics.
 
 ``DistributedWord2Vec(mesh=...)`` is a drop-in Word2Vec whose jitted
 update runs sharded; with a 1-device mesh it reproduces the
@@ -42,43 +43,47 @@ from .sequencevectors import _sg_pair_grads
 from .word2vec import Word2Vec
 
 
-def _sg_raw_deltas(syn0, syn1, centers, contexts, negatives, valid, lr):
-    """UNSCALED table deltas + occurrence counts for one pair shard.
-    Summing (deltas, counts) across shards and dividing afterwards
-    reproduces the single-device _sg_chunk occurrence-averaging
-    independent of how pairs land on shards.  Gradient math shared with
-    the local step via _sg_pair_grads."""
-    dv, du_flat, flat_t, flat_tw = _sg_pair_grads(
-        syn0, syn1, centers, contexts, negatives, valid, lr)
-    d0 = jnp.zeros_like(syn0).at[centers].add(dv * valid[:, None])
-    n0 = jnp.zeros((syn0.shape[0],), jnp.float32).at[centers].add(valid)
-    d1 = jnp.zeros_like(syn1).at[flat_t].add(du_flat * flat_tw[:, None])
-    n1 = jnp.zeros((syn1.shape[0],), jnp.float32).at[flat_t].add(flat_tw)
-    return d0, n0, d1, n1
-
-
 def make_dp_sg_step(mesh: Mesh, data_axis: str = "data"):
     """Build the sharded skip-gram step: pairs split over ``data_axis``,
-    tables replicated; raw deltas AND occurrence counts psum, then the
-    global occurrence-average is applied — bit-for-bit the single-device
-    update semantics at any mesh size."""
+    tables replicated — with ROW-SPARSE collectives.
+
+    Instead of psum'ing dense [V,D] delta tables (O(V·D) wire traffic per
+    flush, the round-2 vocab cap), each shard all_gathers only its
+    per-pair gradient ROWS and indices — O(B·D·(2+K)) traffic,
+    independent of vocabulary size — and every device applies the
+    identical global scatter-add with occurrence averaging.  Numerically
+    this is the same sum-then-divide as the dense formulation (the
+    scatter temp is local HBM, never communicated), so single-device
+    semantics hold at any mesh size."""
 
     def shard_fn(syn0, syn1, centers, contexts, negatives, valid, lr):
-        d0, n0, d1, n1 = _sg_raw_deltas(syn0, syn1, centers, contexts,
-                                        negatives, valid, lr)
-        d0 = jax.lax.psum(d0, data_axis)
-        n0 = jax.lax.psum(n0, data_axis)
-        d1 = jax.lax.psum(d1, data_axis)
-        n1 = jax.lax.psum(n1, data_axis)
+        dv, du_flat, flat_t, flat_tw = _sg_pair_grads(
+            syn0, syn1, centers, contexts, negatives, valid, lr)
+        gather = lambda x: jax.lax.all_gather(x, data_axis, tiled=True)
+        # pair-level rows+indices cross the wire, not [V,D] tables
+        g_c = gather(centers)                        # [B]
+        g_w = gather(valid)                          # [B]
+        g_dv = gather(dv * valid[:, None])           # [B, D]
+        g_t = gather(flat_t)                         # [B·(1+K)]
+        g_tw = gather(flat_tw)                       # [B·(1+K)]
+        g_du = gather(du_flat * flat_tw[:, None])    # [B·(1+K), D]
+        n0 = jnp.zeros((syn0.shape[0],), jnp.float32).at[g_c].add(g_w)
+        d0 = jnp.zeros_like(syn0).at[g_c].add(g_dv)
+        n1 = jnp.zeros((syn1.shape[0],), jnp.float32).at[g_t].add(g_tw)
+        d1 = jnp.zeros_like(syn1).at[g_t].add(g_du)
         syn0 = syn0 + d0 / jnp.maximum(n0, 1.0)[:, None].astype(syn0.dtype)
         syn1 = syn1 + d1 / jnp.maximum(n1, 1.0)[:, None].astype(syn1.dtype)
         return syn0, syn1
 
+    # check_vma=False: the gathered pair set is identical on every device
+    # (tiled all_gather), so the scatter-added tables ARE replicated — the
+    # static varying-across-mesh inference just can't prove it; the
+    # exact-parity tests (test_nlp_distributed.py) pin the semantics.
     sharded = jax.shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(), P(), P(data_axis), P(data_axis), P(data_axis),
                   P(data_axis), P()),
-        out_specs=(P(), P()))
+        out_specs=(P(), P()), check_vma=False)
     return jax.jit(sharded, donate_argnums=(0, 1))
 
 

@@ -433,6 +433,9 @@ class ComputationGraph:
         self.listeners: List[Any] = []
         self._jit_step = None
         self._jit_step_tbptt = None
+        self._jit_step_tbptt_scan = None
+        self._it_dev = None        # device-resident iteration counter
+        self._it_dev_val = -1
         self._jit_output = None
         self._jit_stream = None
         self._stream_carries = None
@@ -581,6 +584,10 @@ class ComputationGraph:
                 mks[name] = spec.vertex.output_mask(min_)
         return acts, new_state, mks, new_carries
 
+    def _iter_scalar(self, advance: int):
+        from ..utils import device_iteration
+        return device_iteration(self, advance)
+
     def _init_carries(self, mb: int) -> Dict[str, Any]:
         """Zero carries for every recurrent LayerVertex (None elsewhere)."""
         dtype = jnp.dtype(self.conf.compute_dtype)
@@ -670,8 +677,8 @@ class ComputationGraph:
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
     def _make_step_tbptt(self):
-        """TBPTT step: threads recurrent carries across sequence chunks
-        (reference ComputationGraph.doTruncatedBPTT:1553)."""
+        """One TBPTT chunk step — used for the ragged tail chunk and the
+        stateful-listener fallback (reference doTruncatedBPTT:1553)."""
         conf = self.conf
 
         def step(params, state, opt_state, it, inputs, labels, rng, masks,
@@ -686,6 +693,75 @@ class ComputationGraph:
             new_params, new_opt = self._apply_updates(
                 grads, params, opt_state, it.astype(jnp.float32))
             return new_params, new_state, new_opt, new_carries, loss
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _make_step_tbptt_scan(self):
+        """Whole-batch TBPTT for the DAG: all T//L chunk optimizer-steps in
+        ONE jit via lax.scan (see multilayer._make_step_tbptt_scan for the
+        per-chunk-upload cost this removes).  Temporal entries (rank-3
+        features/labels, [mb,T] masks) are chunked into scan inputs;
+        static entries (rank-2 inputs, per-sequence masks) ride the trace
+        closure unchanged."""
+        L = self.conf.tbptt_length
+
+        def step(params, state, opt_state, it0, inputs, labels, rng,
+                 masks_t, masks_s, lmasks_t, lmasks_s, carries):
+            # masks arrive PRE-SPLIT into temporal/static dicts: the caller
+            # classifies against the ORIGINAL T, because after tail
+            # clipping a static rank-2 mask's dim-1 could coincidentally
+            # equal the clipped n·L and be mistaken for temporal here
+            T = next(a.shape[1]
+                     for a in list(inputs.values()) + list(labels.values())
+                     if a is not None and a.ndim == 3)
+            n = T // L
+            mb = next(iter(inputs.values())).shape[0]
+            if carries is None:
+                carries = self._init_carries(mb)
+
+            def chunkify(a):
+                a2 = a.reshape((a.shape[0], n, L) + a.shape[2:])
+                return jnp.moveaxis(a2, 1, 0)
+
+            def split_temporal(d, temporal_pred):
+                xs = {k: chunkify(v) for k, v in (d or {}).items()
+                      if temporal_pred(v)}
+                static = {k: v for k, v in (d or {}).items()
+                          if not temporal_pred(v)}
+                return xs, static
+
+            is_t = lambda a: a is not None and a.ndim == 3
+            xs_in, st_in = split_temporal(inputs, is_t)
+            xs_lab, st_lab = split_temporal(labels, is_t)
+            xs_m = {k: chunkify(v) for k, v in (masks_t or {}).items()}
+            st_m = dict(masks_s or {})
+            xs_lm = {k: chunkify(v) for k, v in (lmasks_t or {}).items()}
+            st_lm = dict(lmasks_s or {})
+            keys = jax.random.split(rng, n + 1)
+            its = it0 + jnp.arange(n, dtype=jnp.int32)
+
+            def body(carry, xs):
+                params, state, opt_state, carries = carry
+                ci, cl, cm, clm, k, it = xs
+
+                def loss_fn(p):
+                    return self._loss(p, state, {**st_in, **ci},
+                                      {**st_lab, **cl}, train=True, rng=k,
+                                      masks={**st_m, **cm},
+                                      label_masks={**st_lm, **clm},
+                                      carries=carries)
+
+                (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                new_params, new_opt = self._apply_updates(
+                    grads, params, opt_state, it.astype(jnp.float32))
+                return (new_params, new_state, new_opt, new_carries), loss
+
+            (params, state, opt_state, carries), losses = jax.lax.scan(
+                body, (params, state, opt_state, carries),
+                (xs_in, xs_lab, xs_m, xs_lm, keys[:n], its))
+            return (params, state, opt_state, carries, losses,
+                    jnp.mean(losses), keys[n])
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
@@ -727,9 +803,9 @@ class ComputationGraph:
     def _fit_batch_tbptt(self, mds: MultiDataSet) -> float:
         """Slice the time axis into tbptt_length chunks, carry recurrent
         state forward, one optimizer step per chunk (reference
-        doTruncatedBPTT:1553).  All rank-3 inputs/labels must share T."""
-        if self._jit_step_tbptt is None:
-            self._jit_step_tbptt = self._make_step_tbptt()
+        doTruncatedBPTT:1553).  All rank-3 inputs/labels must share T.
+        Full chunks run in one scanned jit; a ragged tail — and the
+        stateful-listener case — use the per-chunk step."""
         feats = [np.asarray(f) for f in mds.features]
         labs = [None if l is None else np.asarray(l) for l in mds.labels]
         T = None
@@ -745,46 +821,109 @@ class ComputationGraph:
         L = self.conf.tbptt_length
         fmasks = mds.features_masks or [None] * len(feats)
         lmasks_l = mds.labels_masks or [None] * len(labs)
-        carries = self._init_carries(mb)
-        total, chunks = None, 0
 
-        def tslice(a, s):
+        def tslice(a, s, e):
             """Features/labels: only rank-3 arrays carry a time axis —
             rank-2 static inputs pass through whole (their dim-1 may
             coincidentally equal T)."""
             if a is None:
                 return None
-            return a[:, s:s + L] if a.ndim == 3 else a
+            return a[:, s:e] if a.ndim == 3 else a
 
-        def mslice(m, s):
+        def mslice(m, s, e):
             """Masks are [mb, T] when temporal; other shapes pass through."""
             if m is None:
                 return None
             m = np.asarray(m)
-            return m[:, s:s + L] if m.ndim == 2 and m.shape[1] == T else m
+            return m[:, s:e] if m.ndim == 2 and m.shape[1] == T else m
 
-        for s in range(0, T, L):
-            inputs = {n: jnp.asarray(tslice(f, s))
+        def dicts(s, e):
+            inputs = {n: jnp.asarray(tslice(f, s, e))
                       for n, f in zip(self.conf.network_inputs, feats)}
-            labels = {n: (None if l is None else jnp.asarray(tslice(l, s)))
+            labels = {n: (None if l is None else jnp.asarray(tslice(l, s, e)))
                       for n, l in zip(self.conf.network_outputs, labs)}
-            masks = {n: (None if m is None else jnp.asarray(mslice(m, s)))
+            masks = {n: (None if m is None else jnp.asarray(mslice(m, s, e)))
                      for n, m in zip(self.conf.network_inputs, fmasks)}
-            lmasks = {n: (None if m is None else jnp.asarray(mslice(m, s)))
+            lmasks = {n: (None if m is None else jnp.asarray(mslice(m, s, e)))
                       for n, m in zip(self.conf.network_outputs, lmasks_l)}
-            self._rng, sub = jax.random.split(self._rng)
-            (self.params, self.state, self.opt_state, carries, loss
-             ) = self._jit_step_tbptt(
+            return inputs, labels, masks, lmasks
+
+        stateful = any(getattr(l, "requires_model_state", False)
+                       for l in self.listeners)
+        n = T // L
+        tail = T % L
+        carries = None
+        chunk_losses = []
+        mean_loss = None
+        if n and not stateful:
+            if self._jit_step_tbptt_scan is None:
+                self._jit_step_tbptt_scan = self._make_step_tbptt_scan()
+            inputs, labels, masks, lmasks = dicts(0, n * L)
+
+            def split_by_orig_T(slcd, originals, names):
+                """Temporal = the ORIGINAL array was [mb, T]; a static
+                mask whose dim-1 happens to equal the clipped n·L must
+                not be chunkified (the scan can't tell them apart)."""
+                t, s = {}, {}
+                for name in names:
+                    orig = originals.get(name)
+                    m = slcd.get(name)
+                    is_temporal = (orig is not None and orig.ndim == 2
+                                   and orig.shape[1] == T)
+                    (t if is_temporal else s)[name] = m
+                return t, s
+
+            orig_fm = {nm: (None if m is None else np.asarray(m))
+                       for nm, m in zip(self.conf.network_inputs, fmasks)}
+            orig_lm = {nm: (None if m is None else np.asarray(m))
+                       for nm, m in zip(self.conf.network_outputs, lmasks_l)}
+            masks_t, masks_s = split_by_orig_T(masks, orig_fm,
+                                               self.conf.network_inputs)
+            lm_t, lm_s = split_by_orig_T(lmasks, orig_lm,
+                                         self.conf.network_outputs)
+            (self.params, self.state, self.opt_state, carries, losses,
+             mean_loss, self._rng) = self._jit_step_tbptt_scan(
                 self.params, self.state, self.opt_state,
-                jnp.asarray(self.iteration, jnp.int32), inputs, labels, sub,
-                masks, lmasks, carries)
-            self.iteration += 1
-            # accumulate on device — no host sync inside the chunk loop
-            total = loss if total is None else total + loss
-            chunks += 1
+                self._iter_scalar(n), inputs, labels, self._rng,
+                masks_t, masks_s, lm_t, lm_s, None)
+            self.iteration += n
+            if self.listeners:
+                chunk_losses = [(self.iteration - n + i + 1, LazyScore(losses[i]))
+                                for i in range(n)]
+        if tail or stateful:
+            if self._jit_step_tbptt is None:
+                self._jit_step_tbptt = self._make_step_tbptt()
+            if carries is None:
+                carries = self._init_carries(mb)
+            total, chunks = None, 0
+            start = 0 if stateful else n * L
+            for s in range(start, T, L):
+                inputs, labels, masks, lmasks = dicts(s, s + L)
+                self._rng, sub = jax.random.split(self._rng)
+                (self.params, self.state, self.opt_state, carries, loss
+                 ) = self._jit_step_tbptt(
+                    self.params, self.state, self.opt_state,
+                    self._iter_scalar(1), inputs, labels, sub,
+                    masks, lmasks, carries)
+                self.iteration += 1
+                total = loss if total is None else total + loss
+                chunks += 1
+                if stateful:
+                    # per-chunk callbacks with each chunk's params
+                    for lst in self.listeners:
+                        lst.iteration_done(self, self.iteration,
+                                           LazyScore(loss))
+                elif self.listeners:
+                    chunk_losses.append((self.iteration, LazyScore(loss)))
+            tail_mean = total / max(chunks, 1)
+            if stateful:
+                return LazyScore(tail_mean)
+            mean_loss = tail_mean if mean_loss is None else (
+                (mean_loss * n + total) / (n + chunks))
+        for it, score in chunk_losses:
             for lst in self.listeners:
-                lst.iteration_done(self, self.iteration, LazyScore(loss))
-        return LazyScore(total / max(chunks, 1))
+                lst.iteration_done(self, it, score)
+        return LazyScore(mean_loss)
 
     def fit(self, data, epochs: int = 1) -> List[float]:
         losses = []

@@ -16,7 +16,6 @@ from typing import Dict, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.ad_checkpoint import checkpoint_name
 
 from ...ops.initializers import init_weight
 from ..conf.inputs import InputType
@@ -97,13 +96,10 @@ class Convolution2D(Layer):
     def forward(self, params, state, x, *, train=False, rng=None, mask=None) -> ForwardOut:
         x = self._maybe_dropout(x, train, rng)
         y = self._conv(x, params["W"].astype(x.dtype))
-        # named remat seam: a step wrapped in jax.checkpoint with
-        # save_only_these_names("conv_out") stores just conv outputs and
-        # recomputes the (cheap, elementwise) BN/activation tail in the
-        # backward pass — cutting stored-activation HBM traffic, the
-        # bottleneck the profiler shows for ResNet (docs/resnet_profile.md).
-        # Without such a policy the tag is a no-op.
-        y = checkpoint_name(y, "conv_out")
+        # NOTE: no checkpoint_name remat tag here — measured: the name
+        # primitive blocks conv-epilogue fusion (~20% on LeNet) even with
+        # no checkpoint policy active, and the save-only-conv-outputs
+        # policy itself lost to XLA's default (docs/resnet_profile.md).
         if self.has_bias:
             y = y + params["b"].astype(x.dtype)
         return ForwardOut(self._act(y), state, mask)

@@ -224,17 +224,8 @@ class MultiLayerNetwork:
         return layer.updater if layer.updater is not None else self.conf.updater
 
     def _iter_scalar(self, advance: int):
-        """Device-resident iteration counter: a fresh host scalar upload per
-        step costs ~10ms of serialized latency on a tunnelled TPU, so the
-        counter lives on device and advances with an (async) eager add.
-        Falls back to an upload whenever python-side ``iteration`` was
-        changed externally (checkpoint restore, manual reset)."""
-        if self._it_dev is None or self._it_dev_val != self.iteration:
-            self._it_dev = jnp.asarray(self.iteration, jnp.int32)
-        it = self._it_dev
-        self._it_dev = it + advance
-        self._it_dev_val = self.iteration + advance
-        return it
+        from ..utils import device_iteration
+        return device_iteration(self, advance)
 
     def num_params(self) -> int:
         return sum(int(np.prod(x.shape)) for p in self.params for x in jax.tree_util.tree_leaves(p))

@@ -183,6 +183,13 @@ def _pick_block(n: int, preferred: int = 128) -> int:
     return 0
 
 
+def _vma(x):
+    """Varying-across-mesh-axes of ``x`` (frozenset; empty outside
+    shard_map) — pallas out_shapes must carry it so the kernels trace
+    under shard_map's check_vma (ulysses/pipelined attention)."""
+    return getattr(jax.typeof(x), "vma", frozenset())
+
+
 def _kernel_eligible(q, block_q: int, block_k: int) -> bool:
     """The kernel targets the TPU memory spaces; run it compiled on tpu,
     interpreted on cpu (tests), and fall back to plain XLA elsewhere (gpu).
@@ -236,8 +243,8 @@ def _flash_forward(q: Array, k: Array, v: Array, kmask, causal: bool,
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
-            jax.ShapeDtypeStruct((B * H, 1, T), jnp.float32),
+            jax.ShapeDtypeStruct((B * H, T, D), q.dtype, vma=_vma(q)),
+            jax.ShapeDtypeStruct((B * H, 1, T), jnp.float32, vma=_vma(q)),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
@@ -393,8 +400,8 @@ def _flash_backward(q, k, v, kmask, o, lse, g, causal, scale):
         in_specs=specs_kv,
         out_specs=[pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0)),
                    pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0))],
-        out_shape=[jax.ShapeDtypeStruct((B * H, S, D), k.dtype),
-                   jax.ShapeDtypeStruct((B * H, S, D), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((B * H, S, D), k.dtype, vma=_vma(k)),
+                   jax.ShapeDtypeStruct((B * H, S, D), v.dtype, vma=_vma(v))],
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
         interpret=interp,
@@ -422,7 +429,7 @@ def _flash_backward(q, k, v, kmask, o, lse, g, causal, scale):
         grid=(B * H, T // block_q, S // block_k),
         in_specs=specs_q,
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype, vma=_vma(q)),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interp,
     )(*args_q)

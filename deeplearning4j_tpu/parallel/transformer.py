@@ -61,7 +61,7 @@ class ShardedTransformerLM:
     def __init__(self, vocab_size: int, n_layers: int, d_model: int,
                  n_heads: int, mesh: Mesh, d_ff: int = 0, max_len: int = 512,
                  n_microbatches: int = 2, seed: int = 0, updater=None,
-                 compute_dtype=None):
+                 compute_dtype=None, seq_parallel: str = "ring"):
         d_ff = d_ff or 4 * d_model
         # normalize to the canonical 4-axis mesh (absent axes = size 1) so
         # specs/collectives can reference every axis unconditionally
@@ -76,6 +76,16 @@ class ShardedTransformerLM:
         tp = mesh.shape.get("model", 1)
         if n_heads % tp:
             raise ValueError(f"n_heads {n_heads} not divisible by model={tp}")
+        if seq_parallel not in ("ring", "ulysses"):
+            raise ValueError(f"seq_parallel must be 'ring' or 'ulysses', "
+                             f"got {seq_parallel!r}")
+        if seq_parallel == "ulysses" and \
+                (n_heads // tp) % mesh.shape.get("seq", 1):
+            raise ValueError(
+                f"ulysses scatters heads over seq={mesh.shape.get('seq', 1)} "
+                f"but only {n_heads // tp} heads remain after TP — use "
+                "seq_parallel='ring' or raise n_heads")
+        self.seq_parallel = seq_parallel
         if n_layers % mesh.shape.get("pipe", 1):
             raise ValueError(
                 f"n_layers {n_layers} not divisible by pipe={mesh.shape['pipe']}")
@@ -136,10 +146,16 @@ class ShardedTransformerLM:
         blocks = params["blocks"] if cd is None else jax.tree_util.tree_map(
             lambda a: a.astype(cd), params["blocks"])
 
+        if self.seq_parallel == "ulysses":
+            from .ulysses import ulysses_attention
+            attn = functools.partial(ulysses_attention, axis_name="seq",
+                                     causal=True)
+        else:
+            attn = functools.partial(ring_attention, axis_name="seq",
+                                     causal=True)
         block_fn = functools.partial(
             block_apply, n_heads=self.n_heads_local, causal=True,
-            attention_fn=functools.partial(
-                ring_attention, axis_name="seq", causal=True),
+            attention_fn=attn,
             psum_axis="model" if self.mesh.shape.get("model", 1) > 1 else None)
 
         h = pipeline_apply(

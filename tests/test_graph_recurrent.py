@@ -142,3 +142,63 @@ class TestGraphStreaming:
             cur = np.eye(vocab, dtype=np.float32)[[nxt]]
         # the learned cycle must continue: 0,1,2,...
         assert generated[:6] == [0, 1, 2, 3, 4, 5], generated
+
+
+class TestTbpttScanMaskCoincidence:
+    def test_static_mask_with_coincidental_width_not_chunkified(self):
+        """T=70, L=30 → scan prefix is 60 wide; a STATIC rank-2 label mask
+        of width exactly 60 (per-output weighting, not temporal) must pass
+        through whole, not be chunkified into two 30-column fragments.
+        Parity oracle: the per-chunk path (stateful listener forces it)."""
+        import copy
+        from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder
+        from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        from deeplearning4j_tpu.optimize.listeners import TrainingListener
+
+        from deeplearning4j_tpu.nn.graph import LastTimeStepVertex
+        from deeplearning4j_tpu.nn.layers import OutputLayer
+
+        rng = np.random.default_rng(0)
+        mb, T, F, C = 2, 70, 5, 60  # C == n*L == 60: the coincidence
+
+        def build():
+            b = (GraphBuilder().seed(3).updater(Adam(lr=1e-2))
+                 .add_inputs("x")
+                 .set_input_types(x=InputType.recurrent(F))
+                 .add_layer("lstm", LSTM(n_out=8), "x")
+                 .add_layer("rnn_out", RnnOutputLayer(n_out=4, loss="mcxent",
+                                                      activation="softmax"),
+                            "lstm")
+                 .add_vertex("last", LastTimeStepVertex(), "lstm")
+                 .add_layer("ff_out", OutputLayer(n_out=C, loss="mse",
+                                                  activation="identity"),
+                            "last"))
+            b.set_outputs("rnn_out", "ff_out")
+            b._conf.backprop_type = "tbptt"
+            b._conf.tbptt_length = 30
+            net = ComputationGraph(b.build())
+            net.init()
+            return net
+
+        x = rng.normal(size=(mb, T, F)).astype(np.float32)
+        y_rnn = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (mb, T))]
+        y_ff = rng.normal(size=(mb, C)).astype(np.float32)
+        # STATIC per-output weighting mask [mb, C] for the FF head, with
+        # C == n*L == 60 — the width the clipped scan could mistake for
+        # temporal
+        lmask_ff = rng.random((mb, C)).astype(np.float32)
+        mds = MultiDataSet([x], [y_rnn, y_ff], None, [None, lmask_ff])
+
+        n1 = build()
+        scan_losses = [float(n1.fit_batch(copy.deepcopy(mds)))
+                       for _ in range(3)]
+
+        class Stateful(TrainingListener):
+            requires_model_state = True
+
+        n2 = build()
+        n2.set_listeners(Stateful())  # forces the per-chunk oracle path
+        chunk_losses = [float(n2.fit_batch(copy.deepcopy(mds)))
+                        for _ in range(3)]
+        np.testing.assert_allclose(scan_losses, chunk_losses, rtol=1e-5)

@@ -132,6 +132,27 @@ class TestShardedTransformerLM:
         losses = [lm.fit_batch(toks, tgts) for _ in range(3)]
         np.testing.assert_allclose(losses, ref_losses, rtol=2e-4)
 
+    def test_ulysses_seq_parallel_matches_ring(self):
+        """seq_parallel='ulysses' is a drop-in for ring: loss parity on a
+        data×seq mesh (heads stay divisible by seq after TP)."""
+        toks, tgts = self._data()
+        mesh = build_mesh({"data": 2, "seq": 4})
+        kw = dict(vocab_size=64, n_layers=4, d_model=32, n_heads=4,
+                  max_len=16, seed=7)
+        ring = ShardedTransformerLM(mesh=mesh, **kw)
+        uly = ShardedTransformerLM(mesh=mesh, seq_parallel="ulysses", **kw)
+        l_ring = [float(ring.fit_batch(toks, tgts)) for _ in range(3)]
+        l_uly = [float(uly.fit_batch(toks, tgts)) for _ in range(3)]
+        np.testing.assert_allclose(l_uly, l_ring, rtol=2e-4)
+
+    def test_ulysses_head_divisibility_guard(self):
+        import pytest
+        mesh = build_mesh({"data": 1, "model": 2, "seq": 4, "pipe": 1})
+        with pytest.raises(ValueError, match="ulysses"):
+            ShardedTransformerLM(vocab_size=64, n_layers=2, d_model=32,
+                                 n_heads=4, mesh=mesh, max_len=16,
+                                 seq_parallel="ulysses")
+
     def test_trains(self):
         # a learnable copy task: target = input shifted by one
         v = 32
