@@ -4,8 +4,9 @@ Primary (driver) metric: ResNet-50 training images/sec on one chip,
 printed as ONE JSON line on stdout (the driver's contract).  The 6-config
 protocol (BASELINE.md: MLP/MNIST, LeNet/CIFAR, ResNet-50, Word2Vec +
 LSTM char-RNN, sharded ResNet-50 with gradient allreduce; plus the
-TPU-first flash-attention fwd+bwd config) is measured with a ≥100-step
-steady-state window and written to ``bench_results.json`` / echoed on
+TPU-first flash-attention fwd+bwd config) is measured post-compile as
+the best of three ~33-step steady-state windows (tunnel-spike robust —
+see _steady_state) and written to ``bench_results.json`` / echoed on
 stderr, including:
   - mfu: model FLOPs utilization from XLA's compiled cost analysis vs the
     chip's peak (TPU v5e bf16 ≈ 197 TFLOP/s)
@@ -54,18 +55,21 @@ def _sync(state) -> None:
 def _steady_state(step_fn, state, steps=STEPS, warmup=WARMUP):
     """Post-compile steady-state timing: returns (state, sec_per_step).
 
-    Takes the BEST of 3 equal sub-windows: this chip is reached through a
-    shared tunnel whose latency spikes can triple the apparent time of
-    sub-millisecond steps (observed: the same MLP config measuring 80K
-    and 249K img/s minutes apart while ResNet-50 stayed within 1%) — the
-    fastest clean window is the honest steady-state figure."""
+    Takes the BEST of 3 equal sub-windows (full runs only; QUICK keeps a
+    single window — 5//3-step windows would just measure the sync RTT):
+    this chip is reached through a shared tunnel whose latency spikes can
+    triple the apparent time of sub-millisecond steps (observed: the same
+    MLP config measuring 80K and 249K img/s minutes apart while ResNet-50
+    stayed within 1%) — the fastest clean window is the honest
+    steady-state figure."""
     for i in range(warmup):
         state = step_fn(state, i)
     _sync(state)
-    per = max(1, steps // 3)
+    windows = 1 if QUICK else 3
+    per = max(1, steps // windows)
     best = float("inf")
     i = warmup
-    for _ in range(3):
+    for _ in range(windows):
         t0 = time.perf_counter()
         for _ in range(per):
             state = step_fn(state, i)
@@ -254,22 +258,11 @@ def bench_word2vec_lstm():
                    rng.integers(0, vocab_sz, (batch, T)).astype(np.int32))
            for _ in range(20)]
     # fit_batch returns a LazyScore (loss stays on device) — steps chain
-    # without host round trips; sync at window edges, best of 3 windows
-    # (see _steady_state for why)
-    for _ in range(3):
-        net.fit_batch(dss[0])
-    _sync(net.params)
-    steps = 5 if QUICK else 100
-    per = max(1, steps // 3)
-    sec = float("inf")
-    i = 0
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(per):
-            net.fit_batch(dss[i % len(dss)])
-            i += 1
-        _sync(net.params)
-        sec = min(sec, (time.perf_counter() - t0) / per)
+    # without host round trips; _steady_state handles warmup + windows
+    def rnn_step(_, i):
+        net.fit_batch(dss[i % len(dss)])
+        return net.params
+    _, sec = _steady_state(rnn_step, net.params, steps=(5 if QUICK else 100))
     return [
         {"metric": "word2vec_words_per_sec", "value": round(w2v_rate, 1),
          "unit": "words/sec"},
@@ -308,21 +301,14 @@ def bench_sharded_resnet(platform: str):
     # pre-place the batch on the mesh: measure compute+collectives, not the
     # per-step host→device upload of the same 77MB batch
     ds = trainer.shard_dataset(ds)
-    steps = 5 if QUICK else 100
     # async fit path: losses stay device-resident, so the loop enqueues
-    # steps back-to-back; value-readback sync bounds each timed window
-    # (best of 3 — see _steady_state)
-    for _ in range(3):
+    # steps back-to-back; _steady_state handles warmup + windows
+
+    def sharded_step(_, i):
         trainer.fit_batch(ds)
-    _sync(net.params)
-    per = max(1, steps // 3)
-    sec = float("inf")
-    for _ in range(3):
-        t0 = time.perf_counter()
-        for _ in range(per):
-            trainer.fit_batch(ds)
-        _sync(net.params)
-        sec = min(sec, (time.perf_counter() - t0) / per)
+        return net.params
+    _, sec = _steady_state(sharded_step, net.params,
+                           steps=(5 if QUICK else 100), warmup=3)
     grad_bytes = 2 * _param_bytes(net)
     return {"metric": "sharded_resnet50_images_per_sec",
             "value": round(batch / sec, 2), "unit": "images/sec",

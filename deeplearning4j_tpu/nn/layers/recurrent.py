@@ -111,7 +111,11 @@ def _scan_lstm(cfg, params, x, mask, h0, c0, reverse=False, suffix=""):
         return new, new[0]
 
     inputs = (zxT, maskT if maskT is not None else jnp.ones(zxT.shape[:2], zx.dtype))
-    (hF, cF), hs = lax.scan(body, (h0, c0), inputs, reverse=reverse)
+    # unroll=4: XLA pipelines/fuses across unrolled cell iterations —
+    # measured +40% char-RNN training throughput vs unroll=1 on the chip
+    # (unroll=8 regresses: code bloat); semantics unchanged
+    (hF, cF), hs = lax.scan(body, (h0, c0), inputs, reverse=reverse,
+                            unroll=4)
     return jnp.swapaxes(hs, 0, 1), (hF, cF)
 
 
