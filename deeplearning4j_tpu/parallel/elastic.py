@@ -23,16 +23,53 @@ import os
 import time
 from typing import Any, Callable, List, Optional
 
+import jax
+
 logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class _HostSnapshot:
+    """Detached host-side copy of a model's persistent state — quacks like
+    the net for utils/serializer.save_model, so serialization can run on a
+    background thread after the training loop has moved on (and donated
+    the device buffers the snapshot was taken from)."""
+
+    def __init__(self, net):
+        import numpy as _np
+
+        def host(t):
+            return jax.tree_util.tree_map(lambda a: _np.asarray(a), t)
+
+        self.conf = net.conf
+        self.params = host(net.params)
+        self.state = host(net.state)
+        self.opt_state = host(net.opt_state)
+        self.iteration = net.iteration
+        self.epoch = getattr(net, "epoch", 0)
+        # serializer writes this into meta.json — the checkpoint must
+        # record the REAL network class, not the snapshot wrapper
+        self._model_class = type(net).__name__
+
+    def save(self, path: str, save_updater: bool = True) -> None:
+        from ..utils.serializer import save_model
+        save_model(self, path, save_updater=save_updater)
 
 
 class CheckpointManager:
     """Rolling checkpoint store (reference CheckpointListener semantics:
-    keep-last-N, save-every-N-iterations; zip format from utils/serializer)."""
+    keep-last-N, save-every-N-iterations; zip format from utils/serializer).
+
+    ``save_async`` overlaps the expensive part (zip/deflate, ~1s for
+    100MB of params) with training: the device→host snapshot happens on
+    the caller's thread (it must — the next step donates those buffers),
+    then a single background writer thread serializes and atomically
+    renames.  The orbax-style pattern, stdlib-only."""
 
     def __init__(self, directory: str, keep_last: int = 3):
         self.directory = directory
         self.keep_last = keep_last
+        self._executor = None
+        self._pending = None
         os.makedirs(directory, exist_ok=True)
 
     def _path(self, step: int) -> str:
@@ -47,6 +84,39 @@ class CheckpointManager:
         os.replace(tmp, path)
         self._prune()
         return path
+
+    def save_async(self, net, step: int):
+        """Snapshot now, write in the background; returns a Future of the
+        final path.  At most one write is in flight — a second call first
+        waits for the previous write (backpressure beats unbounded host
+        copies of the full model)."""
+        from concurrent.futures import ThreadPoolExecutor
+        if self._executor is None:
+            self._executor = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ckpt-writer")
+        if self._pending is not None:
+            self._pending.result()
+        snap = _HostSnapshot(net)
+
+        def write():
+            path = self._path(step)
+            tmp = path + ".tmp"
+            snap.save(tmp)
+            os.replace(tmp, path)
+            self._prune()
+            return path
+
+        self._pending = self._executor.submit(write)
+        return self._pending
+
+    def wait(self) -> None:
+        """Block until any in-flight async write has landed.  The pending
+        slot is cleared even when the write failed — a stale exception
+        must not re-raise forever — but the failure still propagates to
+        THIS caller."""
+        if self._pending is not None:
+            pending, self._pending = self._pending, None
+            pending.result()
 
     def _prune(self) -> None:
         ckpts = self.list_checkpoints()
@@ -69,7 +139,16 @@ class CheckpointManager:
         return ckpts[-1] if ckpts else None
 
     def restore_latest(self, loader: Callable[[str], Any]):
-        """→ (model, step) from the newest checkpoint, or (None, -1)."""
+        """→ (model, step) from the newest checkpoint, or (None, -1).
+        Waits for any in-flight async write first, so the newest state is
+        always restorable; a FAILED async write is logged and skipped —
+        recovery must proceed from the newest checkpoint that did land,
+        not die on the write that didn't."""
+        try:
+            self.wait()
+        except Exception as exc:
+            logger.warning("in-flight async checkpoint write failed (%s) — "
+                           "restoring from the newest on-disk checkpoint", exc)
         latest = self.latest()
         if latest is None:
             return None, -1
@@ -118,7 +197,8 @@ class ElasticTrainer:
                  rebuild_fn: Optional[Callable[[], Any]] = None,
                  loader: Optional[Callable[[str], Any]] = None,
                  sync_every: int = 10,
-                 restart_reset_after: Optional[int] = None):
+                 restart_reset_after: Optional[int] = None,
+                 async_checkpoints: bool = False):
         self.trainer = trainer
         self.ckpt = CheckpointManager(checkpoint_dir, keep_last)
         self.checkpoint_every = max(1, checkpoint_every)
@@ -127,6 +207,7 @@ class ElasticTrainer:
         self.rebuild_fn = rebuild_fn
         self.loader = loader or self._default_loader
         self.sync_every = max(1, sync_every)
+        self.async_checkpoints = async_checkpoints
         self.restarts = 0        # consecutive-failure budget (resets)
         self.total_restarts = 0  # lifetime count, for observability
         self.global_step = 0
@@ -184,7 +265,13 @@ class ElasticTrainer:
                     # checkpoint
                     loss.value()
                 if saving:
-                    self.ckpt.save(self.net, self.global_step)
+                    if self.async_checkpoints:
+                        # zip/deflate overlaps the next training steps;
+                        # the device→host snapshot happens here (the next
+                        # step donates these buffers)
+                        self.ckpt.save_async(self.net, self.global_step)
+                    else:
+                        self.ckpt.save(self.net, self.global_step)
                 self._ok_steps += 1
                 if self._ok_steps >= self.restart_reset_after and self.restarts:
                     logger.info("%d successful steps since last failure — "
@@ -217,6 +304,11 @@ class ElasticTrainer:
         for _ in range(epochs):
             for ds in it:
                 losses.append(self.fit_batch(ds))
-        # final checkpoint so a clean shutdown is always resumable
-        self.ckpt.save(self.net, self.global_step)
+        # final checkpoint so a clean shutdown is always resumable (wait
+        # for any in-flight async write so ordering stays monotonic; skip
+        # the re-serialization when the last step already checkpointed)
+        self.ckpt.wait()
+        latest = self.ckpt.latest()
+        if latest is None or latest[1] != self.global_step:
+            self.ckpt.save(self.net, self.global_step)
         return losses

@@ -77,7 +77,7 @@ def save_model(net, path: str, save_updater: bool = True) -> None:
             "format_version": FORMAT_VERSION,
             "iteration": net.iteration,
             "epoch": net.epoch,
-            "model_class": type(net).__name__,
+            "model_class": getattr(net, "_model_class", type(net).__name__),
         }))
         zf.writestr("params.npz", _npz_bytes(_flatten_tree(net.params)))
         zf.writestr("state.npz", _npz_bytes(_flatten_tree(net.state)))

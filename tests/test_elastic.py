@@ -214,3 +214,80 @@ class TestDistributedHelpers:
         assert fd.is_recoverable(OSError("device lost"))
         assert not fd.is_recoverable(ValueError("shape mismatch"))
         assert not fd.is_recoverable(KeyError("W"))
+
+
+class TestAsyncCheckpoints:
+    def test_save_async_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.parallel.elastic import CheckpointManager
+        net = small_net()
+        cm = CheckpointManager(str(tmp_path))
+        fut = cm.save_async(net, 5)
+        path = fut.result(timeout=60)
+        assert path.endswith("checkpoint_0000000005.zip")
+        model, step = cm.restore_latest(MultiLayerNetwork.load)
+        assert step == 5
+        x = data().features[:4]
+        np.testing.assert_allclose(model.output(x), net.output(x), rtol=1e-5)
+
+    def test_snapshot_isolated_from_later_training(self, tmp_path):
+        """The snapshot is host-side: training (and buffer donation) after
+        save_async must not change what lands on disk."""
+        from deeplearning4j_tpu.parallel.elastic import CheckpointManager
+        net = small_net()
+        ds = data()
+        net.fit_batch(ds)
+        expected = net.output(ds.features[:4])
+        cm = CheckpointManager(str(tmp_path))
+        cm.save_async(net, 1)
+        for _ in range(5):  # donates the snapshotted buffers
+            net.fit_batch(ds)
+        cm.wait()
+        model, step = cm.restore_latest(MultiLayerNetwork.load)
+        assert step == 1
+        np.testing.assert_allclose(model.output(ds.features[:4]), expected,
+                                   rtol=1e-5)
+        # and the live net has moved on
+        assert not np.allclose(net.output(ds.features[:4]), expected)
+
+    def test_elastic_trainer_async_mode(self, tmp_path):
+        et = ElasticTrainer(FlakyTrainer(small_net(), fail_at={4}),
+                            str(tmp_path), checkpoint_every=2,
+                            max_restarts=2, async_checkpoints=True,
+                            sync_every=1)
+        ds = data()
+        for _ in range(8):
+            et.fit_batch(ds)
+        et.ckpt.wait()
+        assert et.ckpt.latest() is not None
+        assert et.total_restarts == 1
+
+    def test_failed_async_write_not_sticky(self, tmp_path):
+        """A failed background write must not poison every later wait():
+        recovery restores from the newest checkpoint that DID land."""
+        from deeplearning4j_tpu.parallel.elastic import CheckpointManager
+        net = small_net()
+        cm = CheckpointManager(str(tmp_path))
+        cm.save(net, 1)  # a good checkpoint on disk
+        fut = cm.save_async(net, 2)
+        fut.result(timeout=60)
+        # sabotage the next write
+        cm._path_orig = cm._path
+        cm._path = lambda step: "/nonexistent-dir/nope.zip"
+        cm.save_async(net, 3)
+        with pytest.raises(Exception):
+            cm.wait()  # this caller sees the failure...
+        cm._path = cm._path_orig
+        # ...but restore proceeds from the newest landed checkpoint
+        model, step = cm.restore_latest(MultiLayerNetwork.load)
+        assert step == 2 and model is not None
+
+    def test_async_meta_records_real_model_class(self, tmp_path):
+        import json
+        import zipfile
+        from deeplearning4j_tpu.parallel.elastic import CheckpointManager
+        net = small_net()
+        cm = CheckpointManager(str(tmp_path))
+        path = cm.save_async(net, 4).result(timeout=60)
+        with zipfile.ZipFile(path) as zf:
+            meta = json.loads(zf.read("meta.json"))
+        assert meta["model_class"] == "MultiLayerNetwork"
