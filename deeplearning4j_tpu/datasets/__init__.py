@@ -15,6 +15,11 @@ from .records import (
     RecordReaderDataSetIterator,
     SequenceRecordReaderDataSetIterator,
 )
+from .normalizers import (
+    ImagePreProcessingScaler,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+)
 from .remote import (
     LocalProvider,
     RemoteDataSetIterator,

@@ -22,9 +22,39 @@ from .dataset import DataSet
 
 
 class DataSetIterator:
-    """Abstract base (reference org.nd4j.linalg.dataset.api.iterator)."""
+    """Abstract base (reference org.nd4j.linalg.dataset.api.iterator).
+
+    ``set_pre_processor`` attaches a normalizer (datasets/normalizers.py)
+    — the reference's ``iterator.setPreProcessor(normalizer)`` hook.  Like
+    the reference, the preprocessor runs inside ``next()`` (every subclass
+    override is auto-wrapped via ``__init_subclass__``), so wrapper
+    iterators that pull batches through an inner iterator's ``next()``
+    (AsyncDataSetIterator's producer thread, MultipleEpochs, ...) see
+    normalized batches too — and async prefetch genuinely overlaps the
+    normalization with device compute."""
 
     batch_size: int = 0
+    pre_processor = None
+
+    def __init_subclass__(cls, **kwargs):
+        super().__init_subclass__(**kwargs)
+        nxt = cls.__dict__.get("next")
+        if nxt is not None and not getattr(nxt, "_applies_pre", False):
+            import functools
+
+            @functools.wraps(nxt)
+            def wrapped(self, *a, **kw):
+                ds = nxt(self, *a, **kw)
+                if self.pre_processor is not None and ds is not None:
+                    ds = self.pre_processor.pre_process(ds)
+                return ds
+
+            wrapped._applies_pre = True
+            cls.next = wrapped
+
+    def set_pre_processor(self, pre_processor) -> "DataSetIterator":
+        self.pre_processor = pre_processor
+        return self
 
     def reset(self) -> None:
         raise NotImplementedError

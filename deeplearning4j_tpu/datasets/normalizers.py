@@ -1,0 +1,297 @@
+"""Data normalizers — fit statistics on an iterator, apply per batch.
+
+Parity targets (nd4j dataset API, used by every reference example):
+``NormalizerStandardize`` (zero-mean/unit-variance, optional label
+normalization), ``NormalizerMinMaxScaler`` (range scaling),
+``ImagePreProcessingScaler`` (pixel [0,255] → [min,max]), the
+``DataSetIterator.setPreProcessor`` hook, ``revert*`` inverses, and
+``NormalizerSerializer`` persistence (a model shipped for inference needs
+its training-time statistics).
+
+TPU inversion: normalizers here are FUNCTIONAL — ``pre_process`` returns
+a new DataSet (the reference mutates INDArrays in place).  Statistics are
+accumulated with a streaming one-pass sum/sum-of-squares in f64, so
+fitting an iterator never materializes the corpus.  Transforms are plain
+numpy on host (they run in the input pipeline, overlapped with device
+compute by AsyncDataSetIterator) — the arrays upload after normalization
+exactly once.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from .dataset import DataSet
+
+
+class _Stats:
+    """Streaming mean/std + min/max accumulator over [N, ...] batches,
+    reduced over all axes except the trailing feature axes pattern used by
+    DL4J: statistics are PER-FEATURE for rank-2 [mb, f], per-feature over
+    (mb, t) for rank-3 [mb, t, f], and per-channel for rank-4 [mb, h, w, c].
+    """
+
+    def __init__(self):
+        self.n = 0
+        self.s1 = None
+        self.s2 = None
+        self.mn = None
+        self.mx = None
+
+    @staticmethod
+    def _axes(arr: np.ndarray):
+        return tuple(range(arr.ndim - 1))
+
+    def update(self, arr: np.ndarray) -> None:
+        a = np.asarray(arr, np.float64)
+        axes = self._axes(a)
+        cnt = int(np.prod([a.shape[i] for i in axes])) if axes else 1
+        s1 = a.sum(axis=axes)
+        s2 = (a * a).sum(axis=axes)
+        mn = a.min(axis=axes)
+        mx = a.max(axis=axes)
+        if self.s1 is None:
+            self.n, self.s1, self.s2, self.mn, self.mx = cnt, s1, s2, mn, mx
+        else:
+            self.n += cnt
+            self.s1 += s1
+            self.s2 += s2
+            self.mn = np.minimum(self.mn, mn)
+            self.mx = np.maximum(self.mx, mx)
+
+    @property
+    def mean(self) -> np.ndarray:
+        return self.s1 / max(self.n, 1)
+
+    @property
+    def std(self) -> np.ndarray:
+        var = self.s2 / max(self.n, 1) - self.mean ** 2
+        return np.sqrt(np.maximum(var, 0.0))
+
+
+class AbstractNormalizer:
+    """Shared fit/pre_process plumbing.  ``fit`` accepts a DataSet or any
+    DataSetIterator; ``pre_process`` returns a NEW DataSet."""
+
+    def __init__(self, fit_labels: bool = False):
+        self.fit_labels = fit_labels
+        self._feat: Optional[_Stats] = None
+        self._lab: Optional[_Stats] = None
+
+    # -- fitting -----------------------------------------------------------
+
+    def fit(self, data) -> "AbstractNormalizer":
+        self._feat, self._lab = _Stats(), _Stats()
+        for ds in self._iterate(data):
+            self._feat.update(ds.features)
+            if self.fit_labels and ds.labels is not None:
+                self._lab.update(ds.labels)
+        if self._feat.s1 is None:
+            raise ValueError("fit() saw no data")
+        self._finalize()
+        return self
+
+    @staticmethod
+    def _iterate(data):
+        if isinstance(data, DataSet):
+            yield data
+            return
+        # statistics must come from RAW data: if the source iterator
+        # already has a normalizer attached (re-fit after more data, or a
+        # second normalizer over the same iterator), suspend it for the
+        # scan — fitting on transformed batches would yield a near-identity
+        # normalizer silently
+        pp = getattr(data, "pre_processor", None)
+        if pp is not None:
+            data.pre_processor = None
+        try:
+            for ds in data:
+                yield ds
+        finally:
+            if pp is not None:
+                data.pre_processor = pp
+
+    def _finalize(self) -> None:
+        pass
+
+    def _check_fitted(self) -> None:
+        if self._feat is None:
+            raise ValueError(f"{type(self).__name__}: fit() before use "
+                             "(or load() saved statistics)")
+
+    # -- application -------------------------------------------------------
+
+    def transform(self, arr: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def revert_features(self, arr: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def transform_labels(self, arr: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def revert_labels(self, arr: np.ndarray) -> np.ndarray:
+        raise NotImplementedError
+
+    def pre_process(self, ds: DataSet) -> DataSet:
+        """DataSet → normalized DataSet (the setPreProcessor hook target)."""
+        self._check_fitted()
+        labels = ds.labels
+        if self.fit_labels and labels is not None:
+            labels = self.transform_labels(np.asarray(labels))
+        return DataSet(self.transform(np.asarray(ds.features)), labels,
+                       ds.features_mask, ds.labels_mask)
+
+    __call__ = pre_process
+
+    def revert(self, ds: DataSet) -> DataSet:
+        self._check_fitted()
+        labels = ds.labels
+        if self.fit_labels and labels is not None:
+            labels = self.revert_labels(np.asarray(labels))
+        return DataSet(self.revert_features(np.asarray(ds.features)), labels,
+                       ds.features_mask, ds.labels_mask)
+
+    # -- persistence (NormalizerSerializer parity) -------------------------
+
+    _SAVE_KEYS = ()
+
+    def save(self, path: str) -> None:
+        self._check_fitted()
+        np.savez(path if path.endswith(".npz") else path + ".npz",
+                 __class__=np.bytes_(type(self).__name__),
+                 fit_labels=np.asarray(self.fit_labels),
+                 **{k: getattr(self, k) for k in self._SAVE_KEYS})
+
+    @classmethod
+    def load(cls, path: str) -> "AbstractNormalizer":
+        with np.load(path if path.endswith(".npz") else path + ".npz") as z:
+            saved_cls = z["__class__"].item().decode()
+            if saved_cls != cls.__name__:
+                raise ValueError(f"{path} holds a {saved_cls}, not {cls.__name__}")
+            # bypass subclass __init__ (signatures differ — e.g.
+            # ImagePreProcessingScaler takes no fit_labels); every field a
+            # transform needs is in _SAVE_KEYS
+            obj = cls.__new__(cls)
+            AbstractNormalizer.__init__(obj, fit_labels=bool(z["fit_labels"]))
+            obj._feat = _Stats()  # mark fitted
+            for k in cls._SAVE_KEYS:
+                setattr(obj, k, z[k])
+        return obj
+
+
+class NormalizerStandardize(AbstractNormalizer):
+    """Zero-mean / unit-variance per feature (reference
+    NormalizerStandardize; rank-3 stats pool over time, rank-4 per channel).
+    """
+
+    _SAVE_KEYS = ("mean", "std", "label_mean", "label_std")
+
+    def __init__(self, fit_labels: bool = False):
+        super().__init__(fit_labels)
+        self.mean = self.std = self.label_mean = self.label_std = None
+
+    def _finalize(self) -> None:
+        self.mean = self._feat.mean
+        self.std = np.maximum(self._feat.std, 1e-8)
+        if self.fit_labels and self._lab.s1 is not None:
+            self.label_mean = self._lab.mean
+            self.label_std = np.maximum(self._lab.std, 1e-8)
+        else:
+            self.label_mean = np.zeros(1)
+            self.label_std = np.ones(1)
+
+    def transform(self, arr):
+        return ((arr - self.mean) / self.std).astype(np.float32)
+
+    def revert_features(self, arr):
+        return (arr * self.std + self.mean).astype(np.float32)
+
+    def transform_labels(self, arr):
+        return ((arr - self.label_mean) / self.label_std).astype(np.float32)
+
+    def revert_labels(self, arr):
+        return (arr * self.label_std + self.label_mean).astype(np.float32)
+
+
+class NormalizerMinMaxScaler(AbstractNormalizer):
+    """Scale features to [min_range, max_range] per feature (reference
+    NormalizerMinMaxScaler, default [0, 1])."""
+
+    _SAVE_KEYS = ("data_min", "data_max", "label_min", "label_max",
+                  "min_range", "max_range")
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0,
+                 fit_labels: bool = False):
+        super().__init__(fit_labels)
+        if max_range <= min_range:
+            raise ValueError(f"max_range {max_range} <= min_range {min_range}")
+        self.min_range = np.float64(min_range)
+        self.max_range = np.float64(max_range)
+        self.data_min = self.data_max = None
+        self.label_min = self.label_max = None
+
+    def _finalize(self) -> None:
+        self.data_min = self._feat.mn
+        self.data_max = self._feat.mx
+        if self.fit_labels and self._lab.s1 is not None:
+            self.label_min, self.label_max = self._lab.mn, self._lab.mx
+        else:
+            self.label_min, self.label_max = np.zeros(1), np.ones(1)
+
+    @staticmethod
+    def _scale(arr, lo, hi, a, b):
+        span = np.maximum(hi - lo, 1e-12)
+        return ((arr - lo) / span * (b - a) + a).astype(np.float32)
+
+    @staticmethod
+    def _unscale(arr, lo, hi, a, b):
+        span = np.maximum(hi - lo, 1e-12)
+        return ((arr - a) / (b - a) * span + lo).astype(np.float32)
+
+    def transform(self, arr):
+        return self._scale(arr, self.data_min, self.data_max,
+                           self.min_range, self.max_range)
+
+    def revert_features(self, arr):
+        return self._unscale(arr, self.data_min, self.data_max,
+                             self.min_range, self.max_range)
+
+    def transform_labels(self, arr):
+        return self._scale(arr, self.label_min, self.label_max,
+                           self.min_range, self.max_range)
+
+    def revert_labels(self, arr):
+        return self._unscale(arr, self.label_min, self.label_max,
+                             self.min_range, self.max_range)
+
+
+class ImagePreProcessingScaler(AbstractNormalizer):
+    """Pixels [0, max_pixel] → [min_range, max_range] (reference
+    ImagePreProcessingScaler; stateless — no fit required)."""
+
+    _SAVE_KEYS = ("min_range", "max_range", "max_pixel")
+
+    def __init__(self, min_range: float = 0.0, max_range: float = 1.0,
+                 max_pixel: float = 255.0):
+        super().__init__(fit_labels=False)
+        self.min_range = np.float64(min_range)
+        self.max_range = np.float64(max_range)
+        self.max_pixel = np.float64(max_pixel)
+        self._feat = _Stats()  # stateless: always "fitted"
+
+    def fit(self, data):  # fit is a no-op (kept for API parity)
+        return self
+
+    def transform(self, arr):
+        return (arr / self.max_pixel
+                * (self.max_range - self.min_range)
+                + self.min_range).astype(np.float32)
+
+    def revert_features(self, arr):
+        return ((arr - self.min_range)
+                / (self.max_range - self.min_range)
+                * self.max_pixel).astype(np.float32)

@@ -1,0 +1,193 @@
+"""Data normalizers (reference NormalizerStandardize /
+NormalizerMinMaxScaler / ImagePreProcessingScaler + setPreProcessor +
+NormalizerSerializer parity)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import (
+    DataSet,
+    ImagePreProcessingScaler,
+    ListDataSetIterator,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+)
+
+
+def _iter(n_batches=4, mb=16, f=5, seed=0, scale=(10.0, 0.1, 3.0, 100.0, 1.0),
+          shift=(5.0, -2.0, 0.0, 50.0, 0.5)):
+    rng = np.random.default_rng(seed)
+    batches = []
+    for _ in range(n_batches):
+        x = (rng.normal(size=(mb, f)) * np.asarray(scale)
+             + np.asarray(shift)).astype(np.float32)
+        y = (rng.normal(size=(mb, 2)) * 7.0 + 3.0).astype(np.float32)
+        batches.append(DataSet(x, y))
+    return ListDataSetIterator(batches)
+
+
+class TestStandardize:
+    def test_fit_transform_zero_mean_unit_std(self):
+        it = _iter()
+        norm = NormalizerStandardize().fit(it)
+        xs = np.concatenate([norm.pre_process(ds).features for ds in it])
+        np.testing.assert_allclose(xs.mean(axis=0), 0.0, atol=1e-4)
+        np.testing.assert_allclose(xs.std(axis=0), 1.0, atol=1e-3)
+
+    def test_revert_roundtrip(self):
+        it = _iter()
+        norm = NormalizerStandardize().fit(it)
+        ds = next(iter(it))
+        back = norm.revert(norm.pre_process(ds))
+        np.testing.assert_allclose(back.features, ds.features, rtol=1e-4,
+                                   atol=1e-4)
+
+    def test_label_normalization(self):
+        it = _iter()
+        norm = NormalizerStandardize(fit_labels=True).fit(it)
+        ys = np.concatenate([norm.pre_process(ds).labels for ds in it])
+        np.testing.assert_allclose(ys.mean(axis=0), 0.0, atol=1e-4)
+        ds = next(iter(it))
+        back = norm.revert(norm.pre_process(ds))
+        np.testing.assert_allclose(back.labels, ds.labels, rtol=1e-4, atol=1e-4)
+
+    def test_rank4_per_channel(self):
+        rng = np.random.default_rng(0)
+        x = (rng.normal(size=(8, 6, 6, 3)) * [1.0, 10.0, 0.2]
+             + [0.0, 5.0, -1.0]).astype(np.float32)
+        norm = NormalizerStandardize().fit(DataSet(x, None))
+        assert norm.mean.shape == (3,)
+        out = norm.pre_process(DataSet(x, None)).features
+        np.testing.assert_allclose(out.reshape(-1, 3).mean(axis=0), 0.0,
+                                   atol=1e-4)
+
+    def test_unfitted_raises(self):
+        with pytest.raises(ValueError, match="fit"):
+            NormalizerStandardize().pre_process(
+                DataSet(np.zeros((2, 3), np.float32), None))
+
+    def test_save_load(self, tmp_path):
+        it = _iter()
+        norm = NormalizerStandardize().fit(it)
+        p = str(tmp_path / "norm.npz")
+        norm.save(p)
+        loaded = NormalizerStandardize.load(p)
+        ds = next(iter(it))
+        np.testing.assert_allclose(loaded.pre_process(ds).features,
+                                   norm.pre_process(ds).features)
+        with pytest.raises(ValueError, match="NormalizerStandardize"):
+            NormalizerMinMaxScaler.load(p)
+
+
+class TestMinMax:
+    def test_range(self):
+        it = _iter()
+        norm = NormalizerMinMaxScaler().fit(it)
+        xs = np.concatenate([norm.pre_process(ds).features for ds in it])
+        assert xs.min() >= -1e-6 and xs.max() <= 1 + 1e-6
+        np.testing.assert_allclose(xs.min(axis=0), 0.0, atol=1e-6)
+        np.testing.assert_allclose(xs.max(axis=0), 1.0, atol=1e-6)
+
+    def test_custom_range_and_revert(self):
+        it = _iter()
+        norm = NormalizerMinMaxScaler(min_range=-1, max_range=1).fit(it)
+        ds = next(iter(it))
+        out = norm.pre_process(ds).features
+        assert out.min() >= -1 - 1e-6 and out.max() <= 1 + 1e-6
+        np.testing.assert_allclose(norm.revert(norm.pre_process(ds)).features,
+                                   ds.features, rtol=1e-4, atol=1e-4)
+
+    def test_bad_range_rejected(self):
+        with pytest.raises(ValueError, match="min_range"):
+            NormalizerMinMaxScaler(min_range=1.0, max_range=0.0)
+
+
+class TestImageScaler:
+    def test_scales_pixels(self):
+        x = np.asarray([[0.0, 127.5, 255.0]], np.float32)
+        s = ImagePreProcessingScaler()
+        np.testing.assert_allclose(
+            s.pre_process(DataSet(x, None)).features, [[0.0, 0.5, 1.0]])
+        np.testing.assert_allclose(
+            s.revert_features(np.asarray([[0.0, 0.5, 1.0]], np.float32)), x)
+
+    def test_no_fit_needed(self):
+        s = ImagePreProcessingScaler(min_range=-1, max_range=1)
+        out = s.pre_process(DataSet(np.full((1, 2), 255.0, np.float32), None))
+        np.testing.assert_allclose(out.features, 1.0)
+
+
+class TestIteratorHook:
+    def test_set_pre_processor_applies_per_batch(self):
+        it = _iter()
+        norm = NormalizerStandardize().fit(it)
+        it.set_pre_processor(norm)
+        xs = np.concatenate([ds.features for ds in it])
+        np.testing.assert_allclose(xs.mean(axis=0), 0.0, atol=1e-4)
+        # still re-iterable, still normalized
+        xs2 = np.concatenate([ds.features for ds in it])
+        np.testing.assert_allclose(xs, xs2)
+
+    def test_training_through_normalized_iterator(self):
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import (
+            MultiLayerNetwork, NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.updaters import Adam
+
+        rng = np.random.default_rng(0)
+        labels = rng.integers(0, 2, 128)
+        # separable only AFTER normalization matters little, but the large
+        # raw scale (1e3) would stall un-normalized training at this lr
+        x = ((labels[:, None] * 2.0 - 1.0) * 1e3
+             + rng.normal(scale=300.0, size=(128, 4))).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[labels]
+        it = ListDataSetIterator(DataSet(x, y).batch_by(32))
+        norm = NormalizerStandardize().fit(it)
+        it.set_pre_processor(norm)
+        conf = (NeuralNetConfiguration.builder()
+                .updater(Adam(lr=0.05))
+                .layer(Dense(n_out=8, activation="relu"))
+                .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        net.fit(it, epochs=20)
+        preds = np.argmax(net.output(norm.transform(x)), axis=1)
+        assert (preds == labels).mean() > 0.95
+
+
+class TestReviewRegressions:
+    def test_image_scaler_save_load(self, tmp_path):
+        s = ImagePreProcessingScaler(min_range=-1, max_range=1)
+        p = str(tmp_path / "img.npz")
+        s.save(p)
+        loaded = ImagePreProcessingScaler.load(p)
+        x = np.asarray([[0.0, 255.0]], np.float32)
+        np.testing.assert_allclose(
+            loaded.pre_process(DataSet(x, None)).features, [[-1.0, 1.0]])
+
+    def test_async_wrapper_applies_pre_processor(self):
+        """setPreProcessor on the inner iterator must reach batches pulled
+        by wrapper iterators' producer threads (reference contract: the
+        preprocessor runs inside next())."""
+        from deeplearning4j_tpu.datasets import AsyncDataSetIterator
+        base = _iter()
+        norm = NormalizerStandardize().fit(base)
+        base.set_pre_processor(norm)
+        xs = np.concatenate(
+            [ds.features for ds in AsyncDataSetIterator(base)])
+        np.testing.assert_allclose(xs.mean(axis=0), 0.0, atol=1e-4)
+
+    def test_fit_suspends_attached_pre_processor(self):
+        """Re-fitting on an iterator that already normalizes must see RAW
+        data — otherwise the refit is a near-identity."""
+        it = _iter()
+        norm = NormalizerStandardize().fit(it)
+        it.set_pre_processor(norm)
+        norm2 = NormalizerStandardize().fit(it)
+        # norm2 fitted on raw data == same statistics as norm
+        np.testing.assert_allclose(norm2.mean, norm.mean, rtol=1e-6)
+        np.testing.assert_allclose(norm2.std, norm.std, rtol=1e-6)
+        assert it.pre_processor is norm  # restored
