@@ -6,6 +6,7 @@ from .iterators import (
     MultipleEpochsIterator,
     EarlyTerminationDataSetIterator,
     SamplingDataSetIterator,
+    KFoldIterator,
 )
 from .records import (
     CSVRecordReader,

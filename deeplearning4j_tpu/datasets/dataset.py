@@ -24,6 +24,14 @@ class DataSet:
     def num_examples(self) -> int:
         return int(self.features.shape[0])
 
+    def get_rows(self, idx) -> "DataSet":
+        """Row-select all four fields by index array/permutation — the one
+        place the 4-field reconstruction lives (shuffle / sampling / k-fold
+        all route through here)."""
+        pick = lambda a: None if a is None else a[idx]
+        return DataSet(self.features[idx], pick(self.labels),
+                       pick(self.features_mask), pick(self.labels_mask))
+
     def split_test_and_train(self, n_train: int) -> tuple["DataSet", "DataSet"]:
         def cut(a, lo, hi):
             return None if a is None else a[lo:hi]
@@ -37,10 +45,7 @@ class DataSet:
 
     def shuffle(self, seed: Optional[int] = None) -> "DataSet":
         rng = np.random.default_rng(seed)
-        perm = rng.permutation(self.num_examples())
-        pick = lambda a: None if a is None else a[perm]
-        return DataSet(self.features[perm], pick(self.labels),
-                       pick(self.features_mask), pick(self.labels_mask))
+        return self.get_rows(rng.permutation(self.num_examples()))
 
     def batch_by(self, batch_size: int) -> List["DataSet"]:
         out = []

@@ -44,7 +44,17 @@ class DataSetIterator:
 
             @functools.wraps(nxt)
             def wrapped(self, *a, **kw):
-                ds = nxt(self, *a, **kw)
+                # reentrancy guard: a subclass next() that delegates via
+                # super().next() hits TWO wraps on the same instance —
+                # only the outermost may apply the preprocessor, or a
+                # fitted normalizer would run twice
+                if getattr(self, "_in_next", False):
+                    return nxt(self, *a, **kw)
+                self._in_next = True
+                try:
+                    ds = nxt(self, *a, **kw)
+                finally:
+                    self._in_next = False
                 if self.pre_processor is not None and ds is not None:
                     ds = self.pre_processor.pre_process(ds)
                 return ds
@@ -263,6 +273,63 @@ class SamplingDataSetIterator(DataSetIterator):
             raise StopIteration
         self._count += 1
         idx = self._rng.integers(0, self._ds.num_examples(), size=self.batch_size)
-        pick = lambda a: None if a is None else a[idx]
-        return DataSet(self._ds.features[idx], pick(self._ds.labels),
-                       pick(self._ds.features_mask), pick(self._ds.labels_mask))
+        return self._ds.get_rows(idx)
+
+
+class KFoldIterator(DataSetIterator):
+    """K-fold cross-validation over a DataSet (reference KFoldIterator):
+    each ``next()`` yields the TRAIN split of the current fold; the
+    held-out fold is available as ``test_fold()`` until the next call.
+
+    >>> kf = KFoldIterator(ds, k=5)
+    >>> for train in kf:
+    ...     net.fit(train); scores.append(net.evaluate(kf.test_fold()))
+    """
+
+    def __init__(self, dataset: DataSet, k: int = 10,
+                 shuffle_seed: Optional[int] = None):
+        n = dataset.num_examples()
+        if not 2 <= k <= n:
+            raise ValueError(f"k must be in [2, num_examples={n}], got {k}")
+        self._ds = dataset.shuffle(shuffle_seed) if shuffle_seed is not None \
+            else dataset
+        self.k = k
+        # reference semantics: n % k remainder goes to the LAST fold
+        base = n // k
+        sizes = [base] * k
+        sizes[-1] += n - base * k
+        self._bounds = np.cumsum([0] + sizes)
+        self._fold = 0
+        self._test: Optional[DataSet] = None
+
+    def reset(self) -> None:
+        self._fold = 0
+        self._test = None
+
+    def has_next(self) -> bool:
+        return self._fold < self.k
+
+    def _take(self, idx) -> DataSet:
+        return self._ds.get_rows(idx)
+
+    def next(self) -> DataSet:
+        if not self.has_next():
+            raise StopIteration
+        lo, hi = self._bounds[self._fold], self._bounds[self._fold + 1]
+        n = self._ds.num_examples()
+        test_idx = np.arange(lo, hi)
+        train_idx = np.concatenate([np.arange(0, lo), np.arange(hi, n)])
+        self._test = self._take(test_idx)
+        self._fold += 1
+        return self._take(train_idx)
+
+    def test_fold(self) -> DataSet:
+        """The held-out fold for the most recent ``next()`` — normalized by
+        the attached pre_processor like the train split (evaluating raw
+        features against a model trained on normalized ones would produce
+        near-chance scores silently)."""
+        if self._test is None:
+            raise ValueError("call next() first")
+        if self.pre_processor is not None:
+            return self.pre_processor.pre_process(self._test)
+        return self._test

@@ -45,14 +45,28 @@ class _Stats:
     def _axes(arr: np.ndarray):
         return tuple(range(arr.ndim - 1))
 
-    def update(self, arr: np.ndarray) -> None:
+    def update(self, arr: np.ndarray, mask: Optional[np.ndarray] = None) -> None:
+        """``mask`` [mb, t] (variable-length sequences, rank-3 data):
+        zero-padded timesteps are EXCLUDED from the statistics — the
+        reference's DistributionStats masked-fit semantics; padding zeros
+        would otherwise pull means toward 0 and lock minima at 0."""
         a = np.asarray(arr, np.float64)
         axes = self._axes(a)
-        cnt = int(np.prod([a.shape[i] for i in axes])) if axes else 1
-        s1 = a.sum(axis=axes)
-        s2 = (a * a).sum(axis=axes)
-        mn = a.min(axis=axes)
-        mx = a.max(axis=axes)
+        if (mask is not None and a.ndim == 3
+                and np.asarray(mask).shape == a.shape[:2]):
+            w = np.asarray(mask, np.float64)[..., None]
+            cnt = int(w.sum())
+            s1 = (a * w).sum(axis=axes)
+            s2 = (a * a * w).sum(axis=axes)
+            live = w != 0
+            mn = np.where(live, a, np.inf).min(axis=axes)
+            mx = np.where(live, a, -np.inf).max(axis=axes)
+        else:
+            cnt = int(np.prod([a.shape[i] for i in axes])) if axes else 1
+            s1 = a.sum(axis=axes)
+            s2 = (a * a).sum(axis=axes)
+            mn = a.min(axis=axes)
+            mx = a.max(axis=axes)
         if self.s1 is None:
             self.n, self.s1, self.s2, self.mn, self.mx = cnt, s1, s2, mn, mx
         else:
@@ -86,9 +100,9 @@ class AbstractNormalizer:
     def fit(self, data) -> "AbstractNormalizer":
         self._feat, self._lab = _Stats(), _Stats()
         for ds in self._iterate(data):
-            self._feat.update(ds.features)
+            self._feat.update(ds.features, ds.features_mask)
             if self.fit_labels and ds.labels is not None:
-                self._lab.update(ds.labels)
+                self._lab.update(ds.labels, ds.labels_mask)
         if self._feat.s1 is None:
             raise ValueError("fit() saw no data")
         self._finalize()
@@ -278,6 +292,8 @@ class ImagePreProcessingScaler(AbstractNormalizer):
     def __init__(self, min_range: float = 0.0, max_range: float = 1.0,
                  max_pixel: float = 255.0):
         super().__init__(fit_labels=False)
+        if max_range <= min_range:
+            raise ValueError(f"max_range {max_range} <= min_range {min_range}")
         self.min_range = np.float64(min_range)
         self.max_range = np.float64(max_range)
         self.max_pixel = np.float64(max_pixel)
