@@ -54,6 +54,7 @@ from ..nn.layers import (
     LayerNorm,
     LossLayer,
     OutputLayer,
+    SeparableConvolution2D,
     SimpleRnn,
     Subsampling1D,
     Subsampling2D,
@@ -268,6 +269,24 @@ def _map_conv2d(cfg: dict) -> Layer:
     ), cfg)
 
 
+def _map_separable_conv2d(cfg: dict) -> Layer:
+    _check_data_format(cfg, cfg.get("name", "separable_conv2d"))
+    if "kernel_size" in cfg:
+        kernel = _pair(cfg["kernel_size"])
+    else:  # Keras 1.x: separate nb_row / nb_col
+        kernel = (int(cfg.get("nb_row", 3)), int(cfg.get("nb_col", 3)))
+    return _common(SeparableConvolution2D(
+        n_out=int(cfg.get("filters", cfg.get("nb_filter", 0))),
+        kernel=kernel,
+        stride=_pair(cfg.get("strides", cfg.get("subsample", (1, 1)))),
+        dilation=_pair(cfg.get("dilation_rate", (1, 1))),
+        convolution_mode=_conv_mode(cfg.get("padding",
+                                            cfg.get("border_mode", "valid"))),
+        depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+        has_bias=bool(cfg.get("use_bias", True)),
+    ), cfg)
+
+
 def _map_conv1d(cfg: dict) -> Layer:
     _check_data_format(cfg, cfg.get("name", "conv1d"))
     return _common(Convolution1D(
@@ -360,6 +379,31 @@ def _map_spatial_dropout(cfg: dict) -> Layer:
     return d
 
 
+def _map_gaussian_noise(cfg: dict) -> Layer:
+    from ..nn.conf.regularizers import GaussianNoise
+    # Keras 1.x used 'sigma'
+    std = float(cfg.get("stddev", cfg.get("sigma", 0.1)))
+    d = DropoutLayer(dropout=GaussianNoise(stddev=std))
+    d.name = cfg.get("name")
+    return d
+
+
+def _map_gaussian_dropout(cfg: dict) -> Layer:
+    from ..nn.conf.regularizers import GaussianDropout
+    # Keras 1.x used 'p'
+    d = DropoutLayer(dropout=GaussianDropout(
+        rate=float(cfg.get("rate", cfg.get("p", 0.5)))))
+    d.name = cfg.get("name")
+    return d
+
+
+def _map_alpha_dropout(cfg: dict) -> Layer:
+    from ..nn.conf.regularizers import AlphaDropout
+    d = DropoutLayer(dropout=AlphaDropout(p=float(cfg.get("rate", 0.5))))
+    d.name = cfg.get("name")
+    return d
+
+
 def _map_lstm(cfg: dict) -> Layer:
     # return_sequences=False is handled by the import loops, which append a
     # LastTimeStep layer / LastTimeStepVertex after this one
@@ -435,6 +479,11 @@ _LAYER_MAP: Dict[str, Callable[[dict], Layer]] = {
         activation=f"elu({float(c.get('alpha', 1.0))})"),
     "Dropout": _map_dropout,
     "SpatialDropout2D": _map_spatial_dropout,
+    "GaussianNoise": _map_gaussian_noise,
+    "GaussianDropout": _map_gaussian_dropout,
+    "AlphaDropout": _map_alpha_dropout,
+    "SeparableConv2D": _map_separable_conv2d,
+    "SeparableConvolution2D": _map_separable_conv2d,
     "LSTM": _map_lstm,
     "SimpleRNN": _map_simple_rnn,
     "Embedding": _map_embedding,
@@ -445,7 +494,8 @@ _LAYER_MAP: Dict[str, Callable[[dict], Layer]] = {
 # structural layers consumed by the importer itself
 _STRUCTURAL = {"InputLayer", "Flatten", "Reshape"}
 
-_RANK4 = {"Conv2D", "Convolution2D", "MaxPooling2D", "AveragePooling2D",
+_RANK4 = {"Conv2D", "Convolution2D", "SeparableConv2D",
+          "SeparableConvolution2D", "MaxPooling2D", "AveragePooling2D",
           "ZeroPadding2D", "UpSampling2D", "SpatialDropout2D"}
 _RANK3 = {"LSTM", "SimpleRNN", "Embedding", "Conv1D", "Convolution1D",
           "MaxPooling1D", "AveragePooling1D"}
@@ -562,6 +612,18 @@ def _set_layer_params(layer: Layer, params: Dict[str, Any], state: Dict[str, Any
             put(params, "W", w["kernel"])          # (in, out) — same layout
         elif "W" in w:
             put(params, "W", w["W"])
+        if layer.has_bias and ("bias" in w or "b" in w):
+            put(params, "b", w.get("bias", w.get("b")))
+    elif isinstance(layer, SeparableConvolution2D):
+        # keras depthwise [kh,kw,in,dm] -> our dW [kh,kw,1,in*dm]: with
+        # feature_group_count=n_in, output group i holds channel i's dm
+        # multipliers — exactly the C-order flatten of keras's (in, dm)
+        if "depthwise_kernel" in w:
+            dk = w["depthwise_kernel"]
+            kh, kw, cin, dm = dk.shape
+            put(params, "dW", dk.reshape(kh, kw, 1, cin * dm))
+        if "pointwise_kernel" in w:
+            put(params, "pW", w["pointwise_kernel"])   # [1,1,in*dm,out] — same
         if layer.has_bias and ("bias" in w or "b" in w):
             put(params, "b", w.get("bias", w.get("b")))
     elif isinstance(layer, (Convolution2D, Convolution1D)):

@@ -488,3 +488,93 @@ class TestImportErrors:
             g.create_dataset("dense_1/kernel:0", data=np.zeros((2, 2), np.float32))
         with pytest.raises(InvalidKerasConfigurationException):
             import_keras_model_and_weights(path)
+
+
+class TestSeparableAndNoiseLayers:
+    def test_separable_conv2d_parity(self, tmp_path):
+        """SeparableConv2D: depthwise+pointwise weights map without
+        transposition; output parity against a numpy reference."""
+        rng = _rng()
+        cin, dm, cout, kh, kw = 3, 2, 5, 3, 3
+        dk = rng.normal(size=(kh, kw, cin, dm)).astype(np.float32)
+        pk = rng.normal(size=(1, 1, cin * dm, cout)).astype(np.float32)
+        b = rng.normal(size=(cout,)).astype(np.float32)
+        cfg = _seq_config([
+            {"class_name": "SeparableConv2D", "config": {
+                "name": "sep_1", "filters": cout, "kernel_size": [kh, kw],
+                "strides": [1, 1], "padding": "valid",
+                "depth_multiplier": dm, "activation": "linear",
+                "use_bias": True, "data_format": "channels_last",
+                "batch_input_shape": [None, 8, 8, cin]}},
+            {"class_name": "Flatten", "config": {"name": "flat"}},
+            {"class_name": "Dense", "config": {
+                "name": "out", "units": 2, "activation": "softmax",
+                "use_bias": False}},
+        ])
+        W = rng.normal(size=(6 * 6 * cout, 2)).astype(np.float32)
+        path = str(tmp_path / "sep.h5")
+        _write_keras_file(path, cfg, {"loss": "categorical_crossentropy"}, {
+            "sep_1": {"sep_1/depthwise_kernel:0": dk,
+                      "sep_1/pointwise_kernel:0": pk,
+                      "sep_1/bias:0": b},
+            "flat": {},
+            "out": {"out/kernel:0": W},
+        })
+        net = import_keras_sequential_model_and_weights(path)
+        x = rng.normal(size=(2, 8, 8, cin)).astype(np.float32)
+
+        # numpy reference: per-channel depthwise then 1x1 pointwise
+        def ref_sep(x):
+            n, H, Wd, _ = x.shape
+            oh, ow = H - kh + 1, Wd - kw + 1
+            depth = np.zeros((n, oh, ow, cin * dm), np.float32)
+            for c in range(cin):
+                for m in range(dm):
+                    for i in range(oh):
+                        for j in range(ow):
+                            patch = x[:, i:i + kh, j:j + kw, c]
+                            depth[:, i, j, c * dm + m] = (
+                                patch * dk[:, :, c, m]).sum(axis=(1, 2))
+            return depth @ pk[0, 0] + b
+
+        got_sep = ref_sep(x).reshape(2, -1) @ W
+        got_sep = np.exp(got_sep - got_sep.max(-1, keepdims=True))
+        got_sep /= got_sep.sum(-1, keepdims=True)
+        np.testing.assert_allclose(net.output(x), got_sep, rtol=2e-4,
+                                   atol=2e-5)
+
+    def test_noise_layers_import_and_are_inference_identity(self, tmp_path):
+        from deeplearning4j_tpu.nn.conf.regularizers import (
+            AlphaDropout, GaussianDropout, GaussianNoise,
+        )
+        rng = _rng()
+        W = rng.normal(size=(4, 3)).astype(np.float32)
+        cfg = _seq_config([
+            {"class_name": "GaussianNoise", "config": {
+                "name": "gn", "stddev": 0.2,
+                "batch_input_shape": [None, 4]}},
+            {"class_name": "GaussianDropout", "config": {
+                "name": "gd", "rate": 0.3}},
+            {"class_name": "AlphaDropout", "config": {"name": "ad",
+                                                      "rate": 0.1}},
+            {"class_name": "Dense", "config": {
+                "name": "out", "units": 3, "activation": "softmax",
+                "use_bias": False}},
+        ])
+        path = str(tmp_path / "noise.h5")
+        _write_keras_file(path, cfg, {"loss": "categorical_crossentropy"}, {
+            "gn": {}, "gd": {}, "ad": {},
+            "out": {"out/kernel:0": W},
+        })
+        net = import_keras_sequential_model_and_weights(path)
+        kinds = [type(l.dropout).__name__ for l in net.conf.layers[:3]]
+        assert kinds == ["GaussianNoise", "GaussianDropout", "AlphaDropout"]
+        assert net.conf.layers[0].dropout.stddev == pytest.approx(0.2)
+        assert net.conf.layers[1].dropout.rate == pytest.approx(0.3)
+        assert net.conf.layers[2].dropout.p == pytest.approx(0.1)
+        # inference: all three are identity
+        x = rng.normal(size=(5, 4)).astype(np.float32)
+        expected = x @ W
+        expected = np.exp(expected - expected.max(-1, keepdims=True))
+        expected /= expected.sum(-1, keepdims=True)
+        np.testing.assert_allclose(net.output(x), expected, rtol=1e-5)
