@@ -101,16 +101,29 @@ def blockwise_update(acc, m, l, q, k, v, scale, bias=None):
     denominator.  Processes the (q, k-block) score tile and returns updated
     (acc, m, l).  Used on-chip by the pallas kernel and across chips by ring
     attention — one math, two transports.
+
+    Matmul operands stay in the INPUT dtype (bf16 inputs → native-rate MXU
+    passes; f32 casts would triple every matmul's MXU time) while both
+    matmuls accumulate in f32 via preferred_element_type and all softmax
+    statistics are f32 — the standard flash precision contract.  ``p`` is
+    cast to v's dtype for the second matmul (identity for f32 inputs, so
+    the f32 parity/gradient-check suites see unchanged numerics).
     """
-    s = jnp.dot(q.astype(jnp.float32), k.astype(jnp.float32).T,
-                preferred_element_type=jnp.float32) * scale   # [T, S_blk]
+    if q.dtype == jnp.float64:
+        # f64 callers (ring-attention grad checks) run the matmuls at f32
+        # with f32 statistics — the historical semantics of this function
+        # (the fused-kernel path excludes f64 entirely, _kernel_eligible)
+        q, k, v = (x.astype(jnp.float32) for x in (q, k, v))
+    nt = (((1,), (1,)), ((), ()))  # contract head_dim of both, no transpose
+    s = jax.lax.dot_general(q, k, nt,
+                            preferred_element_type=jnp.float32) * scale
     if bias is not None:
         s = s + bias
     m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
     p = jnp.exp(s - m_new)                                     # [T, S_blk]
     correction = jnp.exp(m - m_new)
     l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
-    acc_new = acc * correction + jnp.dot(p, v.astype(jnp.float32),
+    acc_new = acc * correction + jnp.dot(p.astype(v.dtype), v,
                                          preferred_element_type=jnp.float32)
     return acc_new, m_new, l_new
 
@@ -194,8 +207,16 @@ def _kernel_eligible(q, block_q: int, block_k: int) -> bool:
     """The kernel targets the TPU memory spaces; run it compiled on tpu,
     interpreted on cpu (tests), and fall back to plain XLA elsewhere (gpu).
     f64 also falls back: the kernel accumulates in f32 VMEM scratch, which
-    would silently degrade float64 gradient checks."""
+    would silently degrade float64 gradient checks.
+
+    CPU + varying-across-mesh operands (inside shard_map) also fall back:
+    jax 0.9's pallas HLO *interpreter* emits invariant slice indices
+    against the varying operand, which shard_map's check_vma rightly
+    rejects — the compiled TPU kernel carries vma through its out_shapes
+    and passes the check, so only the interpreter needs the escape."""
     backend = jax.default_backend()
+    if backend == "cpu" and _vma(q):
+        return False
     return (_HAS_PALLAS and block_q > 0 and block_k > 0
             and backend in ("tpu", "cpu") and q.dtype != jnp.float64)
 
@@ -271,12 +292,15 @@ def _bwd_tile(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, km_ref,
     through blocked score entries (ds hard-zeroed there).  Fully-masked
     rows carry the lse=+LARGE sentinel from the forward, so p — and with
     it every gradient — is exactly 0 for them.
-    Returns (qb, kb, vb, gb, p, ds) as f32."""
-    qb = q_ref[0].astype(jnp.float32)               # [bq, D]
-    kb = k_ref[0].astype(jnp.float32)               # [bk, D]
-    vb = v_ref[0].astype(jnp.float32)
-    gb = g_ref[0].astype(jnp.float32)
-    s = jnp.dot(qb, kb.T, preferred_element_type=jnp.float32) * scale
+    Returns (qb, kb, vb, gb, p, ds); operands keep the input dtype (native
+    MXU rate for bf16 — see blockwise_update), p/ds are f32 stats."""
+    nt = (((1,), (1,)), ((), ()))      # contract head_dim, no transposes
+    qb = q_ref[0]                                   # [bq, D]
+    kb = k_ref[0]                                   # [bk, D]
+    vb = v_ref[0]
+    gb = g_ref[0]
+    s = jax.lax.dot_general(qb, kb, nt,
+                            preferred_element_type=jnp.float32) * scale
     bias = jnp.zeros((block_q, block_k), jnp.float32)
     if causal:
         bias = bias + causal_bias(block_q, block_k,
@@ -285,7 +309,7 @@ def _bwd_tile(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, km_ref,
         bias = bias + jnp.where(km_ref[0, 0] != 0, 0.0,
                                 _NEG_INF).astype(jnp.float32)[None, :]
     p = jnp.exp(s + bias - lse_ref[0, 0][:, None])  # [bq, bk]
-    dp = jnp.dot(gb, vb.T, preferred_element_type=jnp.float32)
+    dp = jax.lax.dot_general(gb, vb, nt, preferred_element_type=jnp.float32)
     ds = p * (dp - delta_ref[0, 0][:, None]) * scale
     ds = ds * (bias > _NEG_INF / 2).astype(jnp.float32)
     return qb, kb, vb, gb, p, ds
@@ -309,8 +333,11 @@ def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         qb, _, _, gb, p, ds = _bwd_tile(
             q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, km_ref, qi, ki,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k)
-        dv_acc[:] += jnp.dot(p.T, gb, preferred_element_type=jnp.float32)
-        dk_acc[:] += jnp.dot(ds.T, qb, preferred_element_type=jnp.float32)
+        ct = (((0,), (0,)), ((), ()))  # contract the q-row dim of both
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(gb.dtype), gb, ct, preferred_element_type=jnp.float32)
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(qb.dtype), qb, ct, preferred_element_type=jnp.float32)
 
     if causal:
         @pl.when(qi * block_q + block_q - 1 >= ki * block_k)
@@ -341,7 +368,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         _, kb, _, _, _, ds = _bwd_tile(
             q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, km_ref, qi, ki,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k)
-        dq_acc[:] += jnp.dot(ds, kb, preferred_element_type=jnp.float32)
+        dq_acc[:] += jnp.dot(ds.astype(kb.dtype), kb,
+                             preferred_element_type=jnp.float32)
 
     if causal:
         @pl.when(qi * block_q + block_q - 1 >= ki * block_k)

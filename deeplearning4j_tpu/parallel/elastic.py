@@ -95,7 +95,10 @@ class CheckpointManager:
             self._executor = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="ckpt-writer")
         if self._pending is not None:
-            self._pending.result()
+            # Clear before result() (mirrors wait()): a failed background
+            # write must raise once, not poison every later save_async.
+            pending, self._pending = self._pending, None
+            pending.result()
         snap = _HostSnapshot(net)
 
         def write():

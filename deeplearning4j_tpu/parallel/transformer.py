@@ -61,7 +61,8 @@ class ShardedTransformerLM:
     def __init__(self, vocab_size: int, n_layers: int, d_model: int,
                  n_heads: int, mesh: Mesh, d_ff: int = 0, max_len: int = 512,
                  n_microbatches: int = 2, seed: int = 0, updater=None,
-                 compute_dtype=None, seq_parallel: str = "ring"):
+                 compute_dtype=None, seq_parallel: str = "ring",
+                 attention_impl: str = "flash"):
         d_ff = d_ff or 4 * d_model
         # normalize to the canonical 4-axis mesh (absent axes = size 1) so
         # specs/collectives can reference every axis unconditionally
@@ -86,6 +87,13 @@ class ShardedTransformerLM:
                 f"but only {n_heads // tp} heads remain after TP — use "
                 "seq_parallel='ring' or raise n_heads")
         self.seq_parallel = seq_parallel
+        if attention_impl not in ("flash", "xla"):
+            raise ValueError(f"attention_impl must be 'flash' or 'xla', "
+                             f"got {attention_impl!r}")
+        # mirrors TransformerBlock.kernel: "flash" = fused pallas kernels;
+        # "xla" = plain einsum attention (only honored when seq=1 — the
+        # multi-device SP paths are built on the blockwise/flash update)
+        self.attention_impl = attention_impl
         if n_layers % mesh.shape.get("pipe", 1):
             raise ValueError(
                 f"n_layers {n_layers} not divisible by pipe={mesh.shape['pipe']}")
@@ -146,7 +154,17 @@ class ShardedTransformerLM:
         blocks = params["blocks"] if cd is None else jax.tree_util.tree_map(
             lambda a: a.astype(cd), params["blocks"])
 
-        if self.seq_parallel == "ulysses":
+        if self.mesh.shape.get("seq", 1) == 1:
+            # degenerate SP: single-device attention — O(T) saved residuals
+            # (o + lse) per layer, where the ring's blockwise-XLA path
+            # would checkpoint full [T,T] probability tiles
+            if self.attention_impl == "xla":
+                from ..ops.attention import mha
+                attn = functools.partial(mha, causal=True)
+            else:
+                from ..ops.attention import flash_mha
+                attn = functools.partial(flash_mha, causal=True)
+        elif self.seq_parallel == "ulysses":
             from .ulysses import ulysses_attention
             attn = functools.partial(ulysses_attention, axis_name="seq",
                                      causal=True)
@@ -158,11 +176,25 @@ class ShardedTransformerLM:
             attention_fn=attn,
             psum_axis="model" if self.mesh.shape.get("model", 1) > 1 else None)
 
-        h = pipeline_apply(
-            lambda p, h: block_fn(p, h), blocks, h, self.mesh,
-            n_microbatches=self.n_microbatches,
-            param_specs=self.block_specs,
-            x_spec=P("data", "seq", None))
+        if self.mesh.shape.get("pipe", 1) == 1 and \
+                self.mesh.shape.get("seq", 1) == 1 and \
+                self.mesh.shape.get("model", 1) == 1:
+            # (model==1 too: block_fn's TP psums need the axis bound, which
+            # only pipeline_apply's shard_map provides)
+            # no pipeline/ring stage structure → unroll the block stack
+            # instead of scanning it: XLA schedules each layer's fusions
+            # independently (no dynamic-update-slice stacking of residuals,
+            # no loop-carried copies — measured ~15% step time on the
+            # single-chip TransformerLM bench, docs/transformer_profile.md)
+            n_layers = jax.tree_util.tree_leaves(blocks)[0].shape[0]
+            for i in range(n_layers):
+                h = block_fn(jax.tree_util.tree_map(lambda a: a[i], blocks), h)
+        else:
+            h = pipeline_apply(
+                lambda p, h: block_fn(p, h), blocks, h, self.mesh,
+                n_microbatches=self.n_microbatches,
+                param_specs=self.block_specs,
+                x_spec=P("data", "seq", None))
         from ..nn.layers.normalization import layer_norm
         h = layer_norm(h, params["lnf_g"].astype(h.dtype),
                        params["lnf_b"].astype(h.dtype))
@@ -170,10 +202,9 @@ class ShardedTransformerLM:
         return h @ head  # [B, T, V] logits
 
     def _loss(self, params, tokens, targets):
+        from ..ops.losses import sparse_softmax_xent
         logits = self._forward(params, tokens)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)
-        return jnp.mean(nll)
+        return sparse_softmax_xent(logits, targets)
 
     # -- training ----------------------------------------------------------
 

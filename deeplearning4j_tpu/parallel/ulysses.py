@@ -81,16 +81,11 @@ def ulysses_self_attention(q: Array, k: Array, v: Array, mesh: Mesh,
         raise ValueError(f"seq len {q.shape[2]} not divisible by seq axis {n}")
     spec = P(None, None, seq_axis, None)
     mspec = P(None, seq_axis)
-    # check_vma=False: the pallas flash kernel's out_shape carries no vma
-    # annotation, which the shard_map varying-across-mesh check rejects;
-    # there are no collective reductions here (all_to_all/all_gather only)
-    # and the parity tests pin the semantics.
     if kmask is None:
         fn = jax.shard_map(
             functools.partial(ulysses_attention, axis_name=seq_axis,
                               causal=causal, scale=scale),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
-            check_vma=False)
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
         return fn(q, k, v)
 
     def body(q, k, v, m):
@@ -98,6 +93,5 @@ def ulysses_self_attention(q: Array, k: Array, v: Array, mesh: Mesh,
                                  scale=scale, kmask=m)
 
     fn = jax.shard_map(body, mesh=mesh,
-                       in_specs=(spec, spec, spec, mspec), out_specs=spec,
-                       check_vma=False)
+                       in_specs=(spec, spec, spec, mspec), out_specs=spec)
     return fn(q, k, v, kmask)

@@ -70,6 +70,52 @@ def _bytes_to_array(data: bytes) -> np.ndarray:
     return np.load(io.BytesIO(data), allow_pickle=False)
 
 
+class _Subscriber:
+    """One subscriber socket behind a bounded frame queue + writer thread.
+
+    Publishers enqueue; a single writer thread owns the socket, so frames
+    from concurrent publishers can never interleave mid-``sendall``, and a
+    slow subscriber back-pressures only its own queue (frames to it drop
+    when full) instead of head-of-line-blocking the other subscribers.
+    """
+
+    def __init__(self, sock: socket.socket, max_queue: int = 256):
+        self.sock = sock
+        self._q: "queue.Queue[Optional[bytes]]" = queue.Queue(max_queue)
+        self.alive = True
+        threading.Thread(target=self._writer, daemon=True).start()
+
+    def offer(self, frame: bytes) -> None:
+        if not self.alive:
+            return
+        try:
+            self._q.put_nowait(frame)
+        except queue.Full:  # slow consumer: drop for it, don't block others
+            pass
+
+    def _writer(self) -> None:
+        while True:
+            frame = self._q.get()
+            if frame is None:
+                break
+            try:
+                _send_frame(self.sock, frame)
+            except OSError:
+                break
+        self.alive = False
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+
+    def close(self) -> None:
+        self.alive = False
+        try:
+            self._q.put_nowait(None)
+        except queue.Full:
+            self.sock.close()  # writer will die on next send
+
+
 class TensorBroker:
     """In-process topic broker (the Kafka cluster's role, one process).
 
@@ -84,7 +130,7 @@ class TensorBroker:
         self.host = host
         self.port = port
         self._srv: Optional[socket.socket] = None
-        self._subs: Dict[str, List[socket.socket]] = {}
+        self._subs: Dict[str, List[_Subscriber]] = {}
         self._lock = threading.Lock()
         self._threads: List[threading.Thread] = []
         self._running = False
@@ -129,7 +175,7 @@ class TensorBroker:
             topic = topic_raw.decode()
             if role == b"S":
                 with self._lock:
-                    self._subs.setdefault(topic, []).append(conn)
+                    self._subs.setdefault(topic, []).append(_Subscriber(conn))
                 return  # frames are pushed by publishers; keep socket open
             while True:  # publisher: relay frames to every subscriber
                 frame = _recv_frame(conn)
@@ -137,13 +183,11 @@ class TensorBroker:
                     return
                 with self._lock:
                     subs = list(self._subs.get(topic, []))
+                    dead = [s for s in subs if not s.alive]
+                    if dead:
+                        self._subs[topic] = [s for s in subs if s.alive]
                 for s in subs:
-                    try:
-                        _send_frame(s, frame)
-                    except OSError:
-                        with self._lock:
-                            if s in self._subs.get(topic, []):
-                                self._subs[topic].remove(s)
+                    s.offer(frame)
         finally:
             if role == b"P":
                 conn.close()
@@ -156,6 +200,7 @@ class TensorBroker:
             for subs in self._subs.values():
                 for s in subs:
                     s.close()
+                    s.sock.close()
             self._subs.clear()
 
 
@@ -246,6 +291,7 @@ class StreamingDataSetIterator(DataSetIterator):
         self.max_batches = max_batches
         self.timeout = timeout
         self._count = 0
+        self._pending_x: Optional[np.ndarray] = None
 
     def reset(self) -> None:
         self._count = 0
@@ -254,8 +300,22 @@ class StreamingDataSetIterator(DataSetIterator):
         return self.max_batches is None or self._count < self.max_batches
 
     def next(self) -> DataSet:
-        x = self._features.next(timeout=self.timeout)
-        y = self._labels.next(timeout=self.timeout)
+        # Iterator-protocol contract (datasets/iterators.py consumers like
+        # AsyncDataSetIterator expect StopIteration, never queue.Empty).
+        # A features frame whose labels frame hasn't arrived yet is stashed
+        # so a later next() pairs it with ITS labels — a labels-side lag
+        # must never skew the x/y pairing for the rest of the stream.
+        try:
+            x = self._pending_x if self._pending_x is not None \
+                else self._features.next(timeout=self.timeout)
+        except queue.Empty:
+            raise StopIteration from None
+        self._pending_x = x
+        try:
+            y = self._labels.next(timeout=self.timeout)
+        except queue.Empty:
+            raise StopIteration from None
+        self._pending_x = None
         if x is None or y is None:
             raise StopIteration
         self._count += 1
@@ -266,7 +326,7 @@ class StreamingDataSetIterator(DataSetIterator):
         while self.has_next():
             try:
                 yield self.next()
-            except (StopIteration, queue.Empty):
+            except StopIteration:
                 return
 
     def close(self) -> None:
