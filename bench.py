@@ -368,6 +368,82 @@ def bench_flash_attention(platform: str):
     return out
 
 
+def bench_transformer_lm(platform: str):
+    """Config 7 (round-4 protocol extension; no DL4J analog — anchor is
+    SURVEY §7-M5): GPT-2-small-class TransformerLM end-to-end training.
+
+    ~163M params (124M non-embedding), L=12 d=768 H=12, T=1024, vocab
+    50304 (128-aligned GPT-2 BPE), bf16 compute, Adam, fused sparse-xent
+    loss — trained through ShardedTransformerLM.fit_batch (the real 4D-
+    parallel train-step path on a 1-axis mesh).  Reports tokens/sec plus
+    TWO MFU figures:
+      - mfu: XLA cost-analysis FLOPs / time / peak (the ResNet protocol)
+      - mfu_model_flops: analytic 6·N_matmul·tokens + 12·L·B·T²·d
+        (the PaLM-convention model-FLOPs count; excludes the embedding
+        gather that 6·N_total would overcount)
+    attention_impl="xla" on this chip: pallas/mosaic matmuls measure ~20×
+    below XLA's on identical shapes here (docs/transformer_profile.md),
+    so the fused flash kernels — correct on real TPUs — lose to plain XLA
+    attention on this tunnel environment.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.parallel import ShardedTransformerLM, build_mesh
+
+    B = 2 if QUICK else 8
+    T = 256 if QUICK else 1024
+    V, L, D, H = 50304, 12, 768, 12
+    if QUICK:
+        L, D, H = 2, 256, 4
+    n_dev = len(jax.devices())
+    mesh = build_mesh({"data": n_dev})
+    lm = ShardedTransformerLM(
+        vocab_size=V, n_layers=L, d_model=D, n_heads=H, mesh=mesh,
+        max_len=T, n_microbatches=1, compute_dtype=jnp.bfloat16,
+        attention_impl="xla" if platform == "tpu" else "flash")
+    rng = np.random.default_rng(0)
+    toks = jax.device_put(jnp.asarray(rng.integers(0, V, (B * n_dev, T)),
+                                      jnp.int32), lm.token_sharding)
+    tgts = jax.device_put(jnp.asarray(np.roll(np.asarray(toks), -1, axis=1),
+                                      jnp.int32), lm.token_sharding)
+
+    def lm_step(_, i):
+        lm.fit_batch(toks, tgts)
+        return lm.params
+
+    _, sec = _steady_state(lm_step, lm.params, steps=(5 if QUICK else 60),
+                           warmup=3)
+    tokens = B * n_dev * T
+    out = {"metric": "transformer_lm_tokens_per_sec",
+           "value": round(tokens / sec, 1), "unit": "tokens/sec",
+           "params_m": round(sum(x.size for x in
+                                 jax.tree_util.tree_leaves(lm.params)) / 1e6, 1),
+           "seq_len": T, "batch": B * n_dev}
+    # analytic model FLOPs: matmul-participating params only (blocks +
+    # head + final LN; embedding/pos gathers do no matmul FLOPs)
+    n_matmul = sum(x.size for k, v in lm.params.items()
+                   if k not in ("embed", "pos")
+                   for x in jax.tree_util.tree_leaves(v))
+    flops_model = 6 * n_matmul * tokens + 12 * L * (B * n_dev) * T * T * D
+    if platform == "tpu":
+        out["mfu_model_flops"] = round(flops_model / sec / TPU_V5E_PEAK_FLOPS, 4)
+        try:
+            args = (lm.params, lm.opt_state, jnp.asarray(0, jnp.int32),
+                    toks, tgts)
+            import jax.sharding
+            with jax.sharding.set_mesh(lm.mesh):
+                ca = lm._jit_step.lower(*args).compile().cost_analysis()
+            if isinstance(ca, list):
+                ca = ca[0]
+            xla_flops = float(ca.get("flops", 0.0))
+            if xla_flops:
+                out["mfu"] = round(xla_flops / sec / TPU_V5E_PEAK_FLOPS, 4)
+        except Exception:
+            pass
+    return out
+
+
 def main() -> None:
     import jax
 
@@ -381,7 +457,8 @@ def main() -> None:
                      ("resnet50", lambda: bench_resnet50(platform)),
                      ("word2vec_lstm", bench_word2vec_lstm),
                      ("sharded_resnet50", lambda: bench_sharded_resnet(platform)),
-                     ("flash_attention", lambda: bench_flash_attention(platform))]:
+                     ("flash_attention", lambda: bench_flash_attention(platform)),
+                     ("transformer_lm", lambda: bench_transformer_lm(platform))]:
         try:
             t0 = time.perf_counter()
             out = fn()

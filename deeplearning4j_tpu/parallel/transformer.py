@@ -90,9 +90,13 @@ class ShardedTransformerLM:
         if attention_impl not in ("flash", "xla"):
             raise ValueError(f"attention_impl must be 'flash' or 'xla', "
                              f"got {attention_impl!r}")
+        if attention_impl == "xla" and mesh.shape.get("seq", 1) > 1:
+            raise ValueError(
+                "attention_impl='xla' requires seq=1 — the sequence-"
+                "parallel paths (ring/ulysses) are built on the blockwise/"
+                "flash update and cannot honor plain einsum attention")
         # mirrors TransformerBlock.kernel: "flash" = fused pallas kernels;
-        # "xla" = plain einsum attention (only honored when seq=1 — the
-        # multi-device SP paths are built on the blockwise/flash update)
+        # "xla" = plain einsum attention on the single-device seq path
         self.attention_impl = attention_impl
         if n_layers % mesh.shape.get("pipe", 1):
             raise ValueError(

@@ -74,9 +74,14 @@ class _Subscriber:
     """One subscriber socket behind a bounded frame queue + writer thread.
 
     Publishers enqueue; a single writer thread owns the socket, so frames
-    from concurrent publishers can never interleave mid-``sendall``, and a
-    slow subscriber back-pressures only its own queue (frames to it drop
-    when full) instead of head-of-line-blocking the other subscribers.
+    from concurrent publishers can never interleave mid-``sendall``.
+    Delivery is LOSSLESS: when a subscriber's queue fills, ``offer``
+    blocks the relaying publisher (the same backpressure the previous
+    direct-``sendall`` design got from TCP) — dropping frames would
+    silently skew zipped-topic consumers like StreamingDataSetIterator's
+    features/labels pairing.  The queue still softens head-of-line
+    blocking: a slow subscriber delays the topic only once it falls
+    ``max_queue`` frames behind, instead of immediately.
     """
 
     def __init__(self, sock: socket.socket, max_queue: int = 256):
@@ -86,12 +91,12 @@ class _Subscriber:
         threading.Thread(target=self._writer, daemon=True).start()
 
     def offer(self, frame: bytes) -> None:
-        if not self.alive:
-            return
-        try:
-            self._q.put_nowait(frame)
-        except queue.Full:  # slow consumer: drop for it, don't block others
-            pass
+        while self.alive:
+            try:
+                self._q.put(frame, timeout=0.1)  # recheck alive while full
+                return
+            except queue.Full:
+                continue
 
     def _writer(self) -> None:
         while True:
