@@ -437,6 +437,7 @@ class ComputationGraph:
         self._it_dev = None        # device-resident iteration counter
         self._it_dev_val = -1
         self._jit_output = None
+        self._jit_score_examples = None
         self._jit_stream = None
         self._stream_carries = None
         self._rng = jax.random.PRNGKey(conf.seed)
@@ -772,6 +773,131 @@ class ComputationGraph:
             return MultiDataSet([ds.features], [ds.labels],
                                 [ds.features_mask], [ds.labels_mask])
         raise TypeError(type(ds))
+
+    def score_examples(self, ds, add_regularization_terms: bool = True) -> np.ndarray:
+        """Per-example scores [N] (reference ComputationGraph.scoreExamples):
+        each output layer's unreduced loss summed per example across
+        outputs; with ``add_regularization_terms`` the network L1/L2 score
+        is added to every example.  For unmasked feed-forward outputs
+        ``mean(score_examples(ds, True)) == score(ds)``; RNN outputs sum
+        over time (mean == t·score there)."""
+        mds = self._to_mds(ds)
+        if self._jit_score_examples is None:
+            def fn(params, state, inputs, labels, masks, lmasks, add_reg):
+                acts, _, mks, _ = self._apply(
+                    params, state, inputs, train=False, rng=None,
+                    masks=masks, stop_before_output_score=True)
+                pe = None
+                for out_name in self.conf.network_outputs:
+                    spec = self._spec(out_name)
+                    layer = spec.vertex.layer
+                    if not hasattr(layer, "score_examples"):
+                        raise ValueError(
+                            f"output vertex '{out_name}' "
+                            f"({type(layer).__name__}) has no score_examples()")
+                    h = acts[spec.inputs[0]]
+                    s = layer.score_examples(params[out_name], state[out_name],
+                                             h, labels[out_name],
+                                             mask=lmasks.get(out_name))
+                    pe = s if pe is None else pe + s
+                reg = jnp.zeros((), pe.dtype)
+                for spec in self.conf.vertices:
+                    if isinstance(spec.vertex, LayerVertex) and params.get(spec.name):
+                        reg = reg + spec.vertex.layer.regularization_score(
+                            params[spec.name]).astype(pe.dtype)
+                return jnp.where(add_reg, pe + reg, pe)
+
+            self._jit_score_examples = jax.jit(fn)
+        inputs = {n: jnp.asarray(f) for n, f in
+                  zip(self.conf.network_inputs, mds.features)}
+        labels = {n: jax.tree_util.tree_map(jnp.asarray, l)
+                  for n, l in zip(self.conf.network_outputs, mds.labels)}
+        masks = {n: (None if m is None else jnp.asarray(m))
+                 for n, m in zip(self.conf.network_inputs, mds.features_masks or
+                                 [None] * len(self.conf.network_inputs))}
+        lmasks = {n: (None if m is None else jnp.asarray(m))
+                  for n, m in zip(self.conf.network_outputs, mds.labels_masks or
+                                  [None] * len(self.conf.network_outputs))}
+        pe = self._jit_score_examples(self.params, self.state, inputs, labels,
+                                      masks, lmasks,
+                                      jnp.asarray(add_regularization_terms))
+        return np.asarray(pe)
+
+    # -- layerwise unsupervised pretraining --------------------------------
+
+    def pretrainable_layers(self) -> List[str]:
+        """Names of LayerVertices with an unsupervised objective (reference
+        Layer.isPretrainLayer())."""
+        return [s.name for s in self.conf.vertices
+                if isinstance(s.vertex, LayerVertex)
+                and (hasattr(s.vertex.layer, "contrastive_divergence")
+                     or hasattr(s.vertex.layer, "reconstruction_score"))]
+
+    def pretrain(self, data, epochs: int = 1) -> Dict[str, List[float]]:
+        """Greedy layerwise unsupervised pretraining over the DAG in
+        topological order (reference ComputationGraph.pretrain:651); labels
+        are ignored.  Returns {vertex_name: losses}."""
+        order = [n for n in self.topo_order if n in set(self.pretrainable_layers())]
+        return {n: self.pretrain_layer(n, data, epochs) for n in order}
+
+    def pretrain_layer(self, name: str, data, epochs: int = 1) -> List[float]:
+        """Unsupervised pretraining of one LayerVertex (reference
+        pretrainLayer(String, MultiDataSetIterator)): the vertex's input is
+        produced by an inference-mode DAG pass (XLA dead-code-eliminates
+        everything downstream of it), then the layer's objective — CD-k /
+        reconstruction / ELBO — runs with the layer's updater in the same
+        jitted program."""
+        spec = self._spec_by_name.get(name)
+        if spec is None or not isinstance(spec.vertex, LayerVertex):
+            raise ValueError(f"'{name}' is not a LayerVertex")
+        layer = spec.vertex.layer
+        is_rbm = hasattr(layer, "cd_gradients")
+        if not is_rbm and not hasattr(layer, "reconstruction_score"):
+            raise ValueError(
+                f"vertex '{name}' ({type(layer).__name__}) has no "
+                "unsupervised objective (RBM / AutoEncoder / VAE)")
+        updater = self._updater_for(layer)
+
+        def step(params, state, opt_v, it, inputs, rng):
+            acts, _, _, _ = self._apply(params, state, inputs, train=False,
+                                        rng=None, masks=None,
+                                        stop_before_output_score=True)
+            src = spec.inputs[0]
+            feat = acts[src] if src in acts else inputs[src]
+            if is_rbm:
+                g, loss = layer.cd_gradients(params[name], feat, rng)
+            else:
+                loss, g = jax.value_and_grad(
+                    lambda p: layer.reconstruction_score(
+                        p, feat, rng=rng, train=True))(params[name])
+            if self.conf.gradient_normalization != GradientNormalization.NONE:
+                g = normalize_gradients(
+                    g, self.conf.gradient_normalization,
+                    self.conf.gradient_normalization_threshold)
+            updates, opt2 = updater.update(g, opt_v, it)
+            p2 = jax.tree_util.tree_map(
+                lambda pp, uu: (pp.astype(jnp.float32) - uu).astype(pp.dtype),
+                params[name], updates)
+            if layer.constraints:
+                p2 = apply_constraints(layer.constraints, p2)
+            return p2, opt2, loss
+
+        jit_step = jax.jit(step, donate_argnums=(2,))
+        losses: List[float] = []
+        it = 0
+        for _ in range(epochs):
+            for ds in self._as_iterator(data):
+                mds = self._to_mds(ds)
+                inputs = {n: jnp.asarray(f) for n, f in
+                          zip(self.conf.network_inputs, mds.features)}
+                self._rng, sub = jax.random.split(self._rng)
+                self.params[name], self.opt_state[name], loss = jit_step(
+                    self.params, self.state, self.opt_state[name],
+                    np.float32(it), inputs, sub)
+                it += 1
+                losses.append(LazyScore(loss))
+        materialize_scores(losses)
+        return losses
 
     def fit_batch(self, ds):
         """One step; returns a :class:`LazyScore` (device-resident loss that

@@ -87,6 +87,17 @@ class OutputLayer(Dense):
             pre = pre + params["b"].astype(x.dtype)
         return get_loss(self.loss)(labels, pre, self.activation or "identity", mask)
 
+    def score_examples(self, params, state, x, labels, *,
+                       mask: Optional[Array] = None) -> Array:
+        """Per-example scores [mb] (reference scoreExamples semantics:
+        loss summed over output features, NOT batch-reduced)."""
+        pre = x @ params["W"].astype(x.dtype)
+        if self.has_bias:
+            pre = pre + params["b"].astype(x.dtype)
+        pe = get_loss(self.loss).per_example(labels, pre,
+                                             self.activation or "identity", mask)
+        return pe.sum(axis=tuple(range(1, pe.ndim)))  # time summed for RNNs
+
 
 @register_layer
 @dataclasses.dataclass
@@ -103,6 +114,12 @@ class LossLayer(Layer):
 
     def score(self, params, state, x, labels, *, mask: Optional[Array] = None) -> Array:
         return get_loss(self.loss)(labels, x, self.activation or "identity", mask)
+
+    def score_examples(self, params, state, x, labels, *,
+                       mask: Optional[Array] = None) -> Array:
+        pe = get_loss(self.loss).per_example(labels, x,
+                                             self.activation or "identity", mask)
+        return pe.sum(axis=tuple(range(1, pe.ndim)))
 
 
 @register_layer
@@ -299,13 +316,15 @@ class RBM(Layer):
         x = self._maybe_dropout(x, train, rng)
         return ForwardOut(self.prop_up(params, x), state, mask)
 
-    def contrastive_divergence(self, params, v0, rng, lr: float = 0.1):
-        """One CD-k update (reference RBM.computeGradientAndScore Gibbs
-        chain).  Returns (new_params, reconstruction_error).  Requires
-        binary (sigmoid) hidden units — Bernoulli sampling needs
+    def cd_gradients(self, params, v0, rng):
+        """CD-k statistics as a GRADIENT dict (minimization convention, so
+        the containers can drive it through the layer's real updater — the
+        reference's RBM also routes its Gibbs statistics through the normal
+        Solver/updater path).  Returns (grads, reconstruction_error).
+        Requires binary (sigmoid) hidden units — Bernoulli sampling needs
         probabilities."""
         if (self.activation or "sigmoid") != "sigmoid":
-            raise ValueError("contrastive_divergence requires activation="
+            raise ValueError("contrastive divergence requires activation="
                              f"'sigmoid' (binary hidden units), got {self.activation!r}")
         k0, key = jax.random.split(rng)
         h_prob = self.prop_up(params, v0)
@@ -320,10 +339,16 @@ class RBM(Layer):
         dW = (v0.T @ h_prob - v_neg.T @ h_neg) / mb
         db = jnp.mean(h_prob - h_neg, axis=0)
         dvb = jnp.mean(v0 - v_neg, axis=0)
-        new = {
-            "W": params["W"] + lr * dW.astype(params["W"].dtype),
-            "b": params["b"] + lr * db.astype(params["b"].dtype),
-            "vb": params["vb"] + lr * dvb.astype(params["vb"].dtype),
-        }
+        grads = {"W": -dW.astype(params["W"].dtype),
+                 "b": -db.astype(params["b"].dtype),
+                 "vb": -dvb.astype(params["vb"].dtype)}
         err = jnp.mean(jnp.sum((v0 - v_neg) ** 2, axis=1))
+        return grads, err
+
+    def contrastive_divergence(self, params, v0, rng, lr=0.1):
+        """One plain-SGD CD-k update (convenience/back-compat form of
+        ``cd_gradients``; ``lr`` may be a traced scalar).  Returns
+        (new_params, reconstruction_error)."""
+        grads, err = self.cd_gradients(params, v0, rng)
+        new = {k: params[k] - lr * grads[k] for k in params}
         return new, err

@@ -374,6 +374,15 @@ class RnnOutputLayer(Layer):
         pre = self._pre(params, x)  # [mb, t, n_out]
         return get_loss(self.loss)(labels, pre, self.activation or "identity", mask)
 
+    def score_examples(self, params, state, x, labels, *,
+                       mask: Optional[Array] = None) -> Array:
+        """[mb] scores: per-timestep loss summed over the sequence
+        (reference scoreExamples on RNN output layers)."""
+        pre = self._pre(params, x)
+        pe = get_loss(self.loss).per_example(labels, pre,
+                                             self.activation or "identity", mask)
+        return pe.sum(axis=tuple(range(1, pe.ndim)))
+
 
 @register_layer
 @dataclasses.dataclass

@@ -81,6 +81,17 @@ class CenterLossOutputLayer(Dense):
         center_term = 0.5 * self.lambda_ * jnp.mean(jnp.sum((x - assigned) ** 2, axis=-1))
         return base + center_term
 
+    def score_examples(self, params, state, x, labels, *,
+                       mask: Optional[Array] = None) -> Array:
+        pre = x @ params["W"].astype(x.dtype)
+        if self.has_bias:
+            pre = pre + params["b"].astype(x.dtype)
+        pe = get_loss(self.loss).per_example(labels, pre,
+                                             self.activation or "identity", mask)
+        centers = state["centers"].astype(x.dtype)
+        assigned = labels @ centers
+        return pe + 0.5 * self.lambda_ * jnp.sum((x - assigned) ** 2, axis=-1)
+
     def update_centers(self, state, x, labels) -> Dict[str, Array]:
         """Moving-average center update (runs outside the gradient path)."""
         centers = state["centers"]

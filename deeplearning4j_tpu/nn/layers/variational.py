@@ -128,3 +128,48 @@ class VariationalAutoencoder(Layer):
         if self.reconstruction == "bernoulli":
             return jax.nn.sigmoid(out)
         return out[:, :self.n_in]
+
+    def _recon_log_lik(self, params, z, x):
+        """log p(x|z) per example under the reconstruction distribution."""
+        out = self.decode(params, z)
+        if self.reconstruction == "bernoulli":
+            ll = -(jnp.maximum(out, 0) - out * x
+                   + jnp.log1p(jnp.exp(-jnp.abs(out))))
+            return jnp.sum(ll, axis=-1)
+        mu, lv = out[:, :self.n_in], out[:, self.n_in:]
+        ll = -0.5 * (lv + jnp.log(2 * jnp.pi) + (x - mu) ** 2 / jnp.exp(lv))
+        return jnp.sum(ll, axis=-1)
+
+    def reconstruction_log_probability(self, params, x, *, rng,
+                                       num_samples: int = 5) -> Array:
+        """Importance-weighted estimate of log p(x) per example [mb]
+        (reference VariationalAutoencoder.reconstructionLogProbability:977):
+
+            log p(x) ≈ log (1/K) Σ_k  p(x|z_k) p(z_k) / q(z_k|x),
+            z_k ~ q(z|x)
+
+        — the IWAE bound (Burda et al. 2015), exact as K → ∞.  Higher is
+        more probable; use as an anomaly/novelty score."""
+        x = x.reshape((x.shape[0], -1))
+        mean, logvar = self.encode(params, x)
+        keys = jax.random.split(rng, num_samples)
+
+        def log_w(k):
+            eps = jax.random.normal(k, mean.shape, mean.dtype)
+            z = mean + jnp.exp(0.5 * logvar) * eps
+            log_pxz = self._recon_log_lik(params, z, x)
+            log_pz = -0.5 * jnp.sum(z ** 2 + jnp.log(2 * jnp.pi), axis=-1)
+            log_qzx = -0.5 * jnp.sum(
+                logvar + jnp.log(2 * jnp.pi) + eps ** 2, axis=-1)
+            return log_pxz + log_pz - log_qzx
+
+        lw = jnp.stack([log_w(k) for k in keys])       # [K, mb]
+        return jax.nn.logsumexp(lw, axis=0) - jnp.log(num_samples)
+
+    def reconstruction_probability(self, params, x, *, rng,
+                                   num_samples: int = 5) -> Array:
+        """exp of reconstruction_log_probability (reference
+        reconstructionProbability) — underflows to 0 for high-dim data;
+        prefer the log form, as the reference javadoc also advises."""
+        return jnp.exp(self.reconstruction_log_probability(
+            params, x, rng=rng, num_samples=num_samples))

@@ -161,6 +161,8 @@ class MultiLayerNetwork:
         self._it_dev_val = -1      # python value _it_dev mirrors
         self._jit_output = None
         self._jit_score = None
+        self._jit_score_examples = None
+        self._jit_recon_logprob: Dict = {}
         self._jit_stream = None
         self._stream_carries = None
         self._rng = jax.random.PRNGKey(conf.seed)
@@ -442,6 +444,84 @@ class MultiLayerNetwork:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
+    # ------------------------------------------------------------------
+    # layerwise unsupervised pretraining
+    # ------------------------------------------------------------------
+
+    def pretrainable_layers(self) -> List[int]:
+        """Indices of layers with an unsupervised objective (reference
+        Layer.isPretrainLayer(): RBM, AutoEncoder, VariationalAutoencoder)."""
+        return [i for i, l in enumerate(self.conf.layers)
+                if hasattr(l, "contrastive_divergence")
+                or hasattr(l, "reconstruction_score")]
+
+    def pretrain(self, data, epochs: int = 1) -> Dict[int, List[float]]:
+        """Greedy layerwise unsupervised pretraining (reference
+        MultiLayerNetwork.pretrain(DataSetIterator):220): each pretrainable
+        layer is trained on features produced by the (already-pretrained)
+        layers below it, in order; supervised layers are skipped.  Labels
+        in the iterator are ignored.  Follow with ``fit`` for the classic
+        pretrain→fine-tune workflow.  Returns {layer_index: losses}."""
+        return {i: self.pretrain_layer(i, data, epochs)
+                for i in self.pretrainable_layers()}
+
+    def pretrain_layer(self, i: int, data, epochs: int = 1) -> List[float]:
+        """Unsupervised pretraining of layer ``i`` only (reference
+        pretrainLayer:243): inputs are featurized through layers [0, i)
+        in inference mode (no dropout — the layer's own corruption/sampling
+        is the only noise source), then the layer's objective — CD-k for
+        RBM, reconstruction loss for AutoEncoder, negative ELBO for VAE —
+        is driven through the layer's REAL updater (schedules, momentum,
+        Adam moments — the reference also routes RBM Gibbs statistics
+        through the normal Solver/updater path).  Featurize + objective +
+        update run as ONE jitted program per batch."""
+        layer = self.conf.layers[i]
+        is_rbm = hasattr(layer, "cd_gradients")
+        if not is_rbm and not hasattr(layer, "reconstruction_score"):
+            raise ValueError(
+                f"layer {i} ({type(layer).__name__}) has no unsupervised "
+                "objective — pretrainable layers: RBM (contrastive "
+                "divergence), AutoEncoder / VariationalAutoencoder "
+                "(reconstruction/ELBO)")
+        updater = self._updater_for(layer)
+
+        def step(params, state, opt_i, it, x, rng):
+            feat, _, _, _, _ = self._apply_layers(
+                params, state, x, train=False, rng=None, mask=None, upto=i)
+            if i in self.conf.preprocessors:
+                feat = self.conf.preprocessors[i].apply(feat)
+            if is_rbm:
+                g, loss = layer.cd_gradients(params[i], feat, rng)
+            else:
+                loss, g = jax.value_and_grad(
+                    lambda p: layer.reconstruction_score(
+                        p, feat, rng=rng, train=True))(params[i])
+            if self.conf.gradient_normalization != GradientNormalization.NONE:
+                g = normalize_gradients(
+                    g, self.conf.gradient_normalization,
+                    self.conf.gradient_normalization_threshold)
+            updates, opt2 = updater.update(g, opt_i, it)
+            p2 = jax.tree_util.tree_map(
+                lambda pp, uu: (pp.astype(jnp.float32) - uu).astype(pp.dtype),
+                params[i], updates)
+            if layer.constraints:
+                p2 = apply_constraints(layer.constraints, p2)
+            return p2, opt2, loss
+
+        jit_step = jax.jit(step, donate_argnums=(2,))
+        losses: List[float] = []
+        it = 0
+        for _ in range(epochs):
+            for ds in self._as_iterator(data):
+                self._rng, sub = jax.random.split(self._rng)
+                self.params[i], self.opt_state[i], loss = jit_step(
+                    self.params, self.state, self.opt_state[i],
+                    np.float32(it), jnp.asarray(ds.features), sub)
+                it += 1
+                losses.append(LazyScore(loss))
+        materialize_scores(losses)
+        return losses
+
     def fit_batch(self, ds: DataSet):
         """One optimization step on one minibatch (reference fit(DataSet)).
 
@@ -671,6 +751,94 @@ class MultiLayerNetwork:
         _, _, _, acts, _ = self._apply_layers(self.params, self.state, jnp.asarray(x),
                                               train=train, rng=None, mask=None)
         return [np.asarray(a) for a in acts]
+
+    def score_examples(self, ds: DataSet,
+                       add_regularization_terms: bool = True) -> np.ndarray:
+        """Per-example scores [N] WITHOUT batch reduction (reference
+        MultiLayerNetwork.scoreExamples:2139,2156).  With
+        ``add_regularization_terms`` the network's L1/L2 score is added to
+        every example (the reference's semantics).  For unmasked
+        feed-forward outputs ``mean(score_examples(ds, True)) ==
+        score(ds)`` exactly; RNN outputs sum the per-timestep loss over the
+        sequence (reference semantics), so there mean == t·score, and
+        per-timestep masks weight examples differently from score()'s
+        present-entry normalization.  Runs as one jitted program."""
+        if self._jit_score_examples is None:
+            def fn(params, state, x, y, m, lm, add_reg):
+                n = len(self.conf.layers)
+                h, _, mask_out, _, _ = self._apply_layers(
+                    params, state, x, train=False, rng=None, mask=m,
+                    upto=n - 1)
+                last = self.conf.layers[n - 1]
+                if (n - 1) in self.conf.preprocessors:
+                    h = self.conf.preprocessors[n - 1].apply(h)
+                if not hasattr(last, "score_examples"):
+                    raise ValueError(
+                        f"last layer {type(last).__name__} has no "
+                        "score_examples(); supported: OutputLayer, "
+                        "LossLayer, RnnOutputLayer, CenterLossOutputLayer")
+                lmask = lm if lm is not None else (
+                    mask_out if y is not None and getattr(y, "ndim", 0) == 3
+                    else None)
+                pe = last.score_examples(params[n - 1], state[n - 1], h, y,
+                                         mask=lmask)
+                reg = jnp.zeros((), pe.dtype)
+                for layer, p in zip(self.conf.layers, params):
+                    if p:
+                        reg = reg + layer.regularization_score(p).astype(pe.dtype)
+                return jnp.where(add_reg, pe + reg, pe)
+
+            self._jit_score_examples = jax.jit(fn, static_argnums=())
+        pe = self._jit_score_examples(
+            self.params, self.state, jnp.asarray(ds.features),
+            None if ds.labels is None else jax.tree_util.tree_map(jnp.asarray, ds.labels),
+            None if ds.features_mask is None else jnp.asarray(ds.features_mask),
+            None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
+            jnp.asarray(add_regularization_terms))
+        return np.asarray(pe)
+
+    def reconstruction_log_probability(self, x, layer_index: Optional[int] = None,
+                                       num_samples: int = 5) -> np.ndarray:
+        """Importance-weighted log p(x) per example from a
+        VariationalAutoencoder layer (reference
+        VariationalAutoencoder.reconstructionLogProbability:977): inputs are
+        featurized through the layers below it, then the layer's IWAE bound
+        runs with ``num_samples`` importance samples.  ``layer_index=None``
+        uses the first VAE layer."""
+        if layer_index is None:
+            layer_index = next(
+                (i for i, l in enumerate(self.conf.layers)
+                 if hasattr(l, "reconstruction_log_probability")), None)
+            if layer_index is None:
+                raise ValueError("no VariationalAutoencoder layer in this network")
+        layer = self.conf.layers[layer_index]
+        if not hasattr(layer, "reconstruction_log_probability"):
+            raise ValueError(f"layer {layer_index} ({type(layer).__name__}) "
+                             "is not a VariationalAutoencoder")
+        self._rng, sub = jax.random.split(self._rng)
+
+        key = (layer_index, num_samples)
+        if self._jit_recon_logprob.get(key) is None:
+            def fn(params, state, xx, rng):
+                feat, _, _, _, _ = self._apply_layers(
+                    params, state, xx, train=False, rng=None, mask=None,
+                    upto=layer_index)
+                if layer_index in self.conf.preprocessors:
+                    feat = self.conf.preprocessors[layer_index].apply(feat)
+                return layer.reconstruction_log_probability(
+                    params[layer_index], feat, rng=rng,
+                    num_samples=num_samples)
+
+            self._jit_recon_logprob[key] = jax.jit(fn)
+        return np.asarray(self._jit_recon_logprob[key](
+            self.params, self.state, jnp.asarray(x), sub))
+
+    def reconstruction_probability(self, x, layer_index: Optional[int] = None,
+                                   num_samples: int = 5) -> np.ndarray:
+        """exp(reconstruction_log_probability) — reference
+        reconstructionProbability; prefer the log form for high-dim data."""
+        return np.exp(self.reconstruction_log_probability(
+            x, layer_index, num_samples))
 
     def score(self, ds: DataSet) -> float:
         """Loss on a DataSet without updating (reference score(DataSet))."""

@@ -1,0 +1,185 @@
+"""Per-example scoring + VAE reconstruction probability (round-4).
+
+Parity targets: MultiLayerNetwork.scoreExamples (reference
+nn/multilayer/MultiLayerNetwork.java:2139,2156), ComputationGraph
+scoreExamples, VariationalAutoencoder.reconstructionLogProbability /
+reconstructionProbability (nn/layers/variational/
+VariationalAutoencoder.java:977) — SURVEY §7 hard-part (f).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.graph import GraphBuilder, ComputationGraph
+from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+from deeplearning4j_tpu.nn.layers.recurrent import LSTM, RnnOutputLayer
+from deeplearning4j_tpu.nn.layers.variational import VariationalAutoencoder
+from deeplearning4j_tpu.nn.multilayer import (
+    MultiLayerNetwork, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.updaters import Adam
+
+
+def _ff_net(l2=0.0):
+    b = (NeuralNetConfiguration.builder().seed(0).updater(Adam(lr=1e-3))
+         .layer(Dense(n_out=16, activation="tanh", l2=l2))
+         .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent", l2=l2))
+         .set_input_type(InputType.feed_forward(8)))
+    net = MultiLayerNetwork(b.build())
+    net.init()
+    return net
+
+
+class TestScoreExamples:
+    def test_mean_equals_batch_score(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 8)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 32)]
+        net = _ff_net()
+        ds = DataSet(x, y)
+        pe = net.score_examples(ds, add_regularization_terms=True)
+        assert pe.shape == (32,)
+        np.testing.assert_allclose(pe.mean(), net.score(ds), rtol=1e-5)
+
+    def test_regularization_term_added_per_example(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(16, 8)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+        net = _ff_net(l2=1e-2)
+        ds = DataSet(x, y)
+        with_reg = net.score_examples(ds, True)
+        without = net.score_examples(ds, False)
+        d = with_reg - without
+        assert d.min() > 0  # a real positive reg term
+        np.testing.assert_allclose(d, d[0], rtol=1e-5)  # same shift every example
+        np.testing.assert_allclose(with_reg.mean(), net.score(ds), rtol=1e-5)
+
+    def test_matches_manual_numpy_nll(self):
+        rng = np.random.default_rng(2)
+        x = rng.normal(size=(8, 8)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+        net = _ff_net()
+        pe = net.score_examples(DataSet(x, y), add_regularization_terms=False)
+        probs = np.asarray(net.output(x))
+        manual = -np.sum(y * np.log(probs + 1e-12), axis=1)
+        np.testing.assert_allclose(pe, manual, rtol=1e-4)
+
+    def test_rnn_outputs_sum_over_time(self):
+        rng = np.random.default_rng(3)
+        x = rng.normal(size=(4, 6, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (4, 6))]
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .layer(LSTM(n_out=12))
+                .layer(RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.recurrent(8)).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        pe = net.score_examples(DataSet(x, y), add_regularization_terms=False)
+        assert pe.shape == (4,)
+        # reference semantics: per-example = loss summed over the sequence
+        # (our score() averages over mb*t, so mean(pe) == t * score)
+        np.testing.assert_allclose(pe.mean(), 6 * net.score(DataSet(x, y)),
+                                   rtol=1e-4)
+
+    def test_graph_score_examples(self):
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=(16, 8)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+        conf = (GraphBuilder().seed(2).updater(Adam(lr=1e-3))
+                .add_inputs("in")
+                .add_layer("d", Dense(n_out=16, activation="tanh"), "in")
+                .add_layer("out", OutputLayer(n_out=4, activation="softmax",
+                                              loss="mcxent"), "d")
+                .set_outputs("out")
+                .set_input_types(**{"in": InputType.feed_forward(8)})
+                .build())
+        g = ComputationGraph(conf)
+        g.init()
+        ds = DataSet(x, y)
+        pe = g.score_examples(ds, add_regularization_terms=True)
+        assert pe.shape == (16,)
+        np.testing.assert_allclose(pe.mean(), g.score(ds), rtol=1e-5)
+
+
+class TestVaeReconstructionProbability:
+    def _vae_net(self, reconstruction="bernoulli"):
+        conf = (NeuralNetConfiguration.builder().seed(5).updater(Adam(lr=1e-3))
+                .layer(VariationalAutoencoder(
+                    n_out=3, encoder_layer_sizes=(8,), decoder_layer_sizes=(8,),
+                    reconstruction=reconstruction, activation="tanh"))
+                .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(5)).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        return net
+
+    def test_log_prob_matches_numpy_reference(self):
+        """IWAE estimator parity against a from-scratch NumPy implementation
+        sharing the same normal draws."""
+        net = self._vae_net()
+        layer = net.conf.layers[0]
+        params = net.params[0]
+        rng = np.random.default_rng(6)
+        x = (rng.random((4, 5)) > 0.5).astype(np.float32)
+        key = jax.random.PRNGKey(42)
+        K = 7
+        got = np.asarray(layer.reconstruction_log_probability(
+            params, jnp.asarray(x), rng=key, num_samples=K))
+
+        # NumPy reference with the SAME eps draws
+        def mlp(ps, a):
+            for p in ps:
+                a = np.tanh(a @ np.asarray(p["W"]) + np.asarray(p["b"]))
+            return a
+        h = mlp(params["enc"], x)
+        mean = h @ np.asarray(params["z_mean"]["W"]) + np.asarray(params["z_mean"]["b"])
+        logvar = h @ np.asarray(params["z_logvar"]["W"]) + np.asarray(params["z_logvar"]["b"])
+        keys = jax.random.split(key, K)
+        lws = []
+        for k in keys:
+            eps = np.asarray(jax.random.normal(k, mean.shape))
+            z = mean + np.exp(0.5 * logvar) * eps
+            d = mlp(params["dec"], z)
+            out = d @ np.asarray(params["out"]["W"]) + np.asarray(params["out"]["b"])
+            log_pxz = np.sum(-(np.maximum(out, 0) - out * x
+                               + np.log1p(np.exp(-np.abs(out)))), axis=-1)
+            log_pz = -0.5 * np.sum(z ** 2 + np.log(2 * np.pi), axis=-1)
+            log_qzx = -0.5 * np.sum(logvar + np.log(2 * np.pi) + eps ** 2, axis=-1)
+            lws.append(log_pxz + log_pz - log_qzx)
+        lws = np.stack(lws)
+        m = lws.max(axis=0)
+        want = m + np.log(np.mean(np.exp(lws - m), axis=0))
+        np.testing.assert_allclose(got, want, rtol=1e-4)
+
+    def test_container_passthrough_and_prob_form(self):
+        net = self._vae_net()
+        rng = np.random.default_rng(7)
+        x = (rng.random((6, 5)) > 0.5).astype(np.float32)
+        lp = net.reconstruction_log_probability(x, num_samples=4)
+        assert lp.shape == (6,)
+        assert np.all(lp < 0)  # log-probability of binary data
+        p = net.reconstruction_probability(x, num_samples=4)
+        assert np.all((p > 0) & (p < 1))
+
+    def test_anomaly_ranking(self):
+        """After fitting the ELBO on structured data, in-distribution
+        examples must outscore garbage — the reference's advertised use."""
+        rng = np.random.default_rng(8)
+        proto = (rng.random(5) > 0.5).astype(np.float32)
+        x_in = np.clip(proto + rng.normal(0, 0.05, (128, 5)), 0, 1).astype(np.float32)
+        net = self._vae_net()
+        net.pretrain_layer(0, DataSet(x_in, None), epochs=200)
+        x_out = (1.0 - proto)[None, :].astype(np.float32)  # inverted pattern
+        lp_in = net.reconstruction_log_probability(x_in[:8], num_samples=16)
+        lp_out = net.reconstruction_log_probability(
+            np.repeat(x_out, 8, 0), num_samples=16)
+        assert lp_in.mean() > lp_out.mean() + 1.0
+
+    def test_non_vae_layer_raises(self):
+        net = _ff_net()
+        with pytest.raises(ValueError, match="VariationalAutoencoder"):
+            net.reconstruction_log_probability(np.zeros((2, 8), np.float32))
