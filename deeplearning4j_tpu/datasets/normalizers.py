@@ -184,6 +184,14 @@ class AbstractNormalizer:
     def load(cls, path: str) -> "AbstractNormalizer":
         with np.load(path if path.endswith(".npz") else path + ".npz") as z:
             saved_cls = z["__class__"].item().decode()
+            if cls is AbstractNormalizer:
+                # polymorphic restore (reference NormalizerSerializer.restore
+                # reads the type header and dispatches)
+                by_name = {c.__name__: c for c in cls.__subclasses__()}
+                if saved_cls not in by_name:
+                    raise ValueError(f"{path} holds unknown normalizer "
+                                     f"{saved_cls}")
+                cls = by_name[saved_cls]
             if saved_cls != cls.__name__:
                 raise ValueError(f"{path} holds a {saved_cls}, not {cls.__name__}")
             # bypass subclass __init__ (signatures differ — e.g.

@@ -837,7 +837,8 @@ class ComputationGraph:
         """Greedy layerwise unsupervised pretraining over the DAG in
         topological order (reference ComputationGraph.pretrain:651); labels
         are ignored.  Returns {vertex_name: losses}."""
-        order = [n for n in self.topo_order if n in set(self.pretrainable_layers())]
+        wanted = set(self.pretrainable_layers())
+        order = [n for n in self.topo_order if n in wanted]
         return {n: self.pretrain_layer(n, data, epochs) for n in order}
 
     def pretrain_layer(self, name: str, data, epochs: int = 1) -> List[float]:

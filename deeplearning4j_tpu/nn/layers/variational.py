@@ -103,14 +103,7 @@ class VariationalAutoencoder(Layer):
         def one_sample(k):
             eps = jax.random.normal(k, mean.shape, mean.dtype)
             z = mean + jnp.exp(0.5 * logvar) * eps
-            out = self.decode(params, z)
-            if self.reconstruction == "bernoulli":
-                # stable BCE from logits
-                ll = -(jnp.maximum(out, 0) - out * x + jnp.log1p(jnp.exp(-jnp.abs(out))))
-                return jnp.sum(ll, axis=-1)
-            mu, lv = out[:, :self.n_in], out[:, self.n_in:]
-            ll = -0.5 * (lv + jnp.log(2 * jnp.pi) + (x - mu) ** 2 / jnp.exp(lv))
-            return jnp.sum(ll, axis=-1)
+            return self._recon_log_lik(params, z, x)
 
         recon_ll = jnp.mean(jnp.stack([one_sample(k) for k in keys]), axis=0)
         kl = -0.5 * jnp.sum(1 + logvar - mean ** 2 - jnp.exp(logvar), axis=-1)

@@ -1,0 +1,114 @@
+"""Generate the checkpoint-format golden fixtures (run ONCE, commit the
+outputs; rerun ONLY for a deliberate, documented format break).
+
+The committed fixtures freeze the round-4 on-disk formats the way the
+reference's regressiontest/RegressionTest080.java freezes DL4J 0.8.0
+model files: tests/test_format_goldens.py loads them and checks pinned
+outputs, so any accidental format change breaks CI.
+
+Usage:  python tests/fixtures/generate_goldens.py
+"""
+
+import json
+import os
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(os.path.dirname(HERE)))
+
+import numpy as np  # noqa: E402
+
+from deeplearning4j_tpu.datasets import DataSet  # noqa: E402
+from deeplearning4j_tpu.datasets.normalizers import NormalizerStandardize  # noqa: E402
+from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: E402
+from deeplearning4j_tpu.nn.graph import ComputationGraph, GraphBuilder  # noqa: E402
+from deeplearning4j_tpu.nn.layers import Dense, OutputLayer  # noqa: E402
+from deeplearning4j_tpu.nn.graph import MergeVertex  # noqa: E402
+from deeplearning4j_tpu.nn.multilayer import (  # noqa: E402
+    MultiLayerNetwork, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.updaters import Adam  # noqa: E402
+from deeplearning4j_tpu.nlp.serializer import write_word_vectors  # noqa: E402
+
+
+def fixed_input(shape, seed=1234):
+    return np.random.default_rng(seed).normal(size=shape).astype(np.float32)
+
+
+def gen_mln():
+    conf = (NeuralNetConfiguration.builder().seed(42).updater(Adam(lr=1e-2))
+            .layer(Dense(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=4, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(8)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    x = fixed_input((16, 8))
+    y = np.eye(4, dtype=np.float32)[np.arange(16) % 4]
+    for _ in range(5):  # real updater state in the checkpoint
+        net.fit_batch(DataSet(x, y))
+    net.save(os.path.join(HERE, "mln_golden.zip"))
+    out = net.output(fixed_input((4, 8), seed=99))
+    np.save(os.path.join(HERE, "mln_golden_output.npy"), out)
+
+
+def gen_cg():
+    conf = (GraphBuilder().seed(7).updater(Adam(lr=1e-2))
+            .add_inputs("a", "b")
+            .add_layer("da", Dense(n_out=8, activation="relu"), "a")
+            .add_layer("db", Dense(n_out=8, activation="relu"), "b")
+            .add_vertex("m", MergeVertex(), "da", "db")
+            .add_layer("out", OutputLayer(n_out=3, activation="softmax",
+                                          loss="mcxent"), "m")
+            .set_outputs("out")
+            .set_input_types(a=InputType.feed_forward(5),
+                             b=InputType.feed_forward(6))
+            .build())
+    g = ComputationGraph(conf)
+    g.init()
+    xa, xb = fixed_input((8, 5)), fixed_input((8, 6), seed=55)
+    y = np.eye(3, dtype=np.float32)[np.arange(8) % 3]
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    for _ in range(3):
+        g.fit_batch(MultiDataSet([xa, xb], [y]))
+    g.save(os.path.join(HERE, "cg_golden.zip"))
+    out = g.output(fixed_input((4, 5), seed=77), fixed_input((4, 6), seed=78))
+    np.save(os.path.join(HERE, "cg_golden_output.npy"), out[0])
+
+
+def gen_w2v():
+    rng = np.random.default_rng(3)
+    vecs = {f"word{i}": rng.normal(size=8).astype(np.float32) for i in range(5)}
+    write_word_vectors(vecs, os.path.join(HERE, "w2v_golden.txt"), binary=False)
+    write_word_vectors(vecs, os.path.join(HERE, "w2v_golden.bin"), binary=True)
+    np.save(os.path.join(HERE, "w2v_golden_vectors.npy"),
+            np.stack([vecs[f"word{i}"] for i in range(5)]))
+
+
+def gen_normalizer():
+    x = fixed_input((64, 6), seed=11)
+    n = NormalizerStandardize()
+    n.fit(DataSet(x, None))
+    n.save(os.path.join(HERE, "normalizer_golden.npz"))
+    out = n.transform(fixed_input((4, 6), seed=12))
+    np.save(os.path.join(HERE, "normalizer_golden_output.npy"), out)
+
+
+if __name__ == "__main__":
+    gen_mln()
+    gen_cg()
+    gen_w2v()
+    gen_normalizer()
+    manifest = {
+        "format_round": 4,
+        "files": sorted(f for f in os.listdir(HERE)
+                        if not f.endswith(".py")),
+        "note": "regenerating these is a FORMAT BREAK — see "
+                "tests/test_format_goldens.py",
+    }
+    with open(os.path.join(HERE, "MANIFEST.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    print("goldens written:", manifest["files"])
