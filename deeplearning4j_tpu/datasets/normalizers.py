@@ -186,8 +186,14 @@ class AbstractNormalizer:
             saved_cls = z["__class__"].item().decode()
             if cls is AbstractNormalizer:
                 # polymorphic restore (reference NormalizerSerializer.restore
-                # reads the type header and dispatches)
-                by_name = {c.__name__: c for c in cls.__subclasses__()}
+                # reads the type header and dispatches); walk the whole
+                # subclass tree so user classes deriving from a concrete
+                # normalizer restore too
+                def walk(c):
+                    for s in c.__subclasses__():
+                        yield s
+                        yield from walk(s)
+                by_name = {c.__name__: c for c in walk(cls)}
                 if saved_cls not in by_name:
                     raise ValueError(f"{path} holds unknown normalizer "
                                      f"{saved_cls}")

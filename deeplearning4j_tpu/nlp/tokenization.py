@@ -19,6 +19,7 @@ TokenizerFactory class-name configuration.
 
 from __future__ import annotations
 
+import math
 import re
 from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
@@ -69,26 +70,83 @@ def _is_cjk(ch: str) -> bool:
 class CJKTokenizerFactory:
     """Segmenter for unspaced CJK text with a user-dictionary hook.
 
-    Within a CJK run, greedy longest-match against ``user_dictionary``
-    takes priority (ChineseTokenizer's lexicon role); unmatched spans fall
-    back to ``mode``:
-      - "bigram": overlapping character bigrams (standard CJK IR baseline;
-        a single leftover char becomes a unigram)
-      - "char": one token per character
+    ``mode`` selects the in-run algorithm:
+      - "lattice" (kuromoji's algorithm class, reference
+        deeplearning4j-nlp-japanese vendored ViterbiBuilder): build a word
+        lattice over the run from dictionary entries + single-char
+        fallback nodes and take the min-cost Viterbi path.  Dictionary
+        words cost ``-log f(w)`` when ``user_dictionary`` is a
+        {word: frequency} mapping (uniform when a plain sequence), so
+        overlapping entries resolve globally — where greedy longest-match
+        commits to 研究生|命, the lattice picks 研究|生命 when the
+        frequencies say so.  Unmatched chars ride fallback nodes whose
+        cost exceeds any dictionary word.
+      - "bigram": greedy longest-match against the dictionary, unmatched
+        spans become overlapping character bigrams (standard CJK IR
+        baseline; a single leftover char becomes a unigram)
+      - "char": greedy longest-match; unmatched spans one char per token
     Non-CJK spans (latin words, digits) tokenize by whitespace with the
     preprocessor applied, so mixed-script corpora work end-to-end.
     """
 
-    def __init__(self, user_dictionary: Optional[Sequence[str]] = None,
+    #: fallback unigram cost — higher than any realistic dictionary word
+    #: (-log f with f normalized over the dictionary stays below ~20)
+    _FALLBACK_COST = 25.0
+
+    def __init__(self, user_dictionary=None,
                  mode: str = "bigram", preprocessor=None):
-        if mode not in ("bigram", "char"):
-            raise ValueError(f"mode must be 'bigram' or 'char', got {mode!r}")
+        if mode not in ("bigram", "char", "lattice"):
+            raise ValueError(
+                f"mode must be 'bigram', 'char' or 'lattice', got {mode!r}")
         self.mode = mode
         self.preprocessor = preprocessor or CommonPreprocessor()
-        self.dictionary = set(user_dictionary or ())
+        if isinstance(user_dictionary, dict):
+            if any(c <= 0 for c in user_dictionary.values()):
+                raise ValueError("user_dictionary frequencies must be > 0")
+            total = float(sum(user_dictionary.values()))
+            # works for raw counts AND probability-valued frequencies —
+            # only the ratios matter to the Viterbi comparison
+            self._costs = {w: -math.log(c / total)
+                           for w, c in user_dictionary.items()}
+        else:
+            # uniform frequencies; mild length bonus keeps longest-match
+            # behavior for non-overlapping text
+            self._costs = {w: 10.0 - 0.01 * len(w)
+                           for w in (user_dictionary or ())}
+        self.dictionary = set(self._costs)
         self._max_word = max((len(w) for w in self.dictionary), default=0)
 
+    def _segment_lattice(self, run: str) -> List[str]:
+        """Min-cost Viterbi path through the word lattice."""
+        n = len(run)
+        best = [math.inf] * (n + 1)
+        back: List[Optional[tuple]] = [None] * (n + 1)
+        best[0] = 0.0
+        for i in range(n):
+            if best[i] == math.inf:
+                continue
+            # fallback single-char edge keeps the lattice connected
+            c = best[i] + self._FALLBACK_COST
+            if c < best[i + 1]:
+                best[i + 1] = c
+                back[i + 1] = (i, run[i])
+            for L in range(1, min(self._max_word, n - i) + 1):
+                w = run[i:i + L]
+                wc = self._costs.get(w)
+                if wc is not None and best[i] + wc < best[i + L]:
+                    best[i + L] = best[i] + wc
+                    back[i + L] = (i, w)
+        out: List[str] = []
+        pos = n
+        while pos > 0:
+            prev, w = back[pos]
+            out.append(w)
+            pos = prev
+        return out[::-1]
+
     def _segment_cjk(self, run: str) -> List[str]:
+        if self.mode == "lattice":
+            return self._segment_lattice(run)
         out: List[str] = []
         i, n = 0, len(run)
         pending_start = 0
@@ -147,6 +205,89 @@ class CJKTokenizerFactory:
         return tokens
 
 
+# ---------------------------------------------------------------------------
+# POS tagging hook (the deeplearning4j-nlp-uima PosUimaTokenizerFactory role)
+# ---------------------------------------------------------------------------
+
+
+class RuleBasedPosTagger:
+    """Dependency-free English POS tagger: closed-class lookup + suffix
+    heuristics (the pluggable default — swap in any ``tag(tokens)``
+    callable for a real model).  Tags follow the Penn treebank names the
+    reference's UIMA annotators emit."""
+
+    _CLOSED = {
+        "the": "DT", "a": "DT", "an": "DT", "this": "DT", "that": "DT",
+        "these": "DT", "those": "DT",
+        "i": "PRP", "you": "PRP", "he": "PRP", "she": "PRP", "it": "PRP",
+        "we": "PRP", "they": "PRP", "me": "PRP", "him": "PRP", "her": "PRP",
+        "them": "PRP", "us": "PRP",
+        "my": "PRP$", "your": "PRP$", "his": "PRP$", "its": "PRP$",
+        "our": "PRP$", "their": "PRP$",
+        "in": "IN", "on": "IN", "at": "IN", "by": "IN", "for": "IN",
+        "with": "IN", "from": "IN", "of": "IN", "to": "TO", "as": "IN",
+        "into": "IN", "over": "IN", "under": "IN",
+        "and": "CC", "or": "CC", "but": "CC", "nor": "CC",
+        "is": "VBZ", "are": "VBP", "was": "VBD", "were": "VBD",
+        "be": "VB", "been": "VBN", "being": "VBG", "am": "VBP",
+        "have": "VBP", "has": "VBZ", "had": "VBD",
+        "do": "VBP", "does": "VBZ", "did": "VBD",
+        "will": "MD", "would": "MD", "can": "MD", "could": "MD",
+        "shall": "MD", "should": "MD", "may": "MD", "might": "MD",
+        "must": "MD",
+        "not": "RB", "very": "RB", "quite": "RB", "too": "RB",
+    }
+
+    def tag(self, tokens: Sequence[str]) -> List[str]:
+        out = []
+        for t in tokens:
+            low = t.lower()
+            if low in self._CLOSED:
+                out.append(self._CLOSED[low])
+            elif low.replace(".", "", 1).replace(",", "").isdigit():
+                out.append("CD")
+            elif low.endswith("ly"):
+                out.append("RB")
+            elif low.endswith("ing") and len(low) > 4:
+                out.append("VBG")
+            elif low.endswith("ed") and len(low) > 3:
+                out.append("VBD")
+            elif low.endswith(("ous", "ful", "ive", "able", "ible", "al",
+                               "ic")) and len(low) > 4:
+                out.append("JJ")
+            elif low.endswith("s") and not low.endswith(("ss", "us", "is")) \
+                    and len(low) > 3:
+                out.append("NNS")
+            elif t[:1].isupper():
+                out.append("NNP")
+            else:
+                out.append("NN")
+        return out
+
+
+class PosFilterTokenizerFactory:
+    """Tokenize with ``base`` then keep only tokens whose POS tag is in
+    ``allowed_tags`` (reference PosUimaTokenizerFactory: tokens outside the
+    allowed set are stripped before vectorization).  ``tagger`` is any
+    object with ``tag(tokens) -> tags`` — rule-based English default."""
+
+    def __init__(self, allowed_tags: Sequence[str], base=None, tagger=None,
+                 preprocessor=None):
+        self.base = base or DefaultTokenizerFactory(preprocessor=preprocessor)
+        self.allowed = set(allowed_tags)
+        self.tagger = tagger or RuleBasedPosTagger()
+
+    def tokenize(self, sentence: str) -> List[str]:
+        tokens = self.base.tokenize(sentence)
+        tags = self.tagger.tag(tokens)
+        return [t for t, g in zip(tokens, tags) if g in self.allowed]
+
+    def tokenize_with_tags(self, sentence: str) -> List[tuple]:
+        """(token, tag) pairs without filtering — the annotation surface."""
+        tokens = self.base.tokenize(sentence)
+        return list(zip(tokens, self.tagger.tag(tokens)))
+
+
 #: name → factory constructor (the reference configures TokenizerFactory
 #: by class name; this registry is the same seam without reflection)
 _TOKENIZER_FACTORIES: Dict[str, Callable[..., object]] = {}
@@ -168,6 +309,7 @@ def get_tokenizer_factory(name: str, **kwargs):
 
 register_tokenizer_factory("default", DefaultTokenizerFactory)
 register_tokenizer_factory("cjk", CJKTokenizerFactory)
+register_tokenizer_factory("pos", PosFilterTokenizerFactory)
 # the language-specific names share the CJK segmenter; a real lexicon
 # arrives via user_dictionary (the vendored-dictionary seam)
 register_tokenizer_factory("chinese", CJKTokenizerFactory)
