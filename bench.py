@@ -229,12 +229,14 @@ def bench_word2vec_lstm():
 
     # word2vec: words/sec — first fit pays jit compilation, second fit on a
     # fresh model hits the jit cache (same batch shapes) = steady state.
-    # Corpus large enough that fixed costs (vocab build, final table
-    # readback) amortize — the metric is steady-state training throughput.
+    # Corpus large enough that fixed costs (vocab build, the ~0.4s final
+    # table readback through the tunnel) amortize — the metric is
+    # steady-state training throughput (round 4: 8K→48K sentences; the
+    # pipeline is host-bound, docs/word2vec_profile.md)
     rng = np.random.default_rng(0)
     vocab = [f"w{i}" for i in range(2000)]
     sentences = [" ".join(rng.choice(vocab, size=20))
-                 for _ in range(100 if QUICK else 8000)]
+                 for _ in range(100 if QUICK else 48000)]
     n_words = sum(len(s.split()) for s in sentences)
 
     def make_w2v():
@@ -242,9 +244,11 @@ def bench_word2vec_lstm():
                         epochs=1, batch_size=4096, subsampling=0)
 
     make_w2v().fit(sentences)  # warmup: vocab + jit compile
-    t0 = time.perf_counter()
-    make_w2v().fit(sentences)
-    w2v_rate = n_words / (time.perf_counter() - t0)
+    w2v_rate = 0.0
+    for _ in range(1 if QUICK else 3):  # best-of-3: tunnel-spike robust,
+        t0 = time.perf_counter()        # same policy as _steady_state
+        make_w2v().fit(sentences)
+        w2v_rate = max(w2v_rate, n_words / (time.perf_counter() - t0))
 
     # char-LSTM: chars/sec through the REAL training path — fit_batch with
     # the model's configured TBPTT(50) chunking (all chunk steps fused into

@@ -402,7 +402,8 @@ class SequenceVectors(WordVectorsBase):
         self.dm = dm
         self.vocab: Optional[VocabCache] = None
         self.syn0: Optional[np.ndarray] = None
-        self.syn1: Optional[np.ndarray] = None
+        self._syn1_pending = None   # device array awaiting lazy readback
+        self._syn1_host: Optional[np.ndarray] = None
         self.label_index: Dict[Hashable, int] = {}
         self._norms = None
         # batches stacked per device dispatch (amortizes remote-TPU dispatch
@@ -412,6 +413,24 @@ class SequenceVectors(WordVectorsBase):
         self._device_batches = 16
 
     # ------------------------------------------------------------------
+
+    @property
+    def syn1(self) -> Optional[np.ndarray]:
+        """Output table as a genuine (mutable) np.ndarray.  The device→host
+        readback is deferred to first access — fit() ends with syn1 still
+        on device because most consumers never touch it (each eager
+        readback costs ~200ms of tunnel latency on the bench chip)."""
+        if self._syn1_host is None and self._syn1_pending is not None:
+            # np.array (not asarray): jax device views are read-only; the
+            # contract is a mutable host table
+            self._syn1_host = np.array(self._syn1_pending)
+            self._syn1_pending = None
+        return self._syn1_host
+
+    @syn1.setter
+    def syn1(self, value) -> None:
+        self._syn1_pending = None
+        self._syn1_host = None if value is None else np.asarray(value)
 
     def _sg_step(self, syn0, syn1, centers, contexts, negatives, valid, lr,
                  chunks=1):
@@ -451,9 +470,10 @@ class SequenceVectors(WordVectorsBase):
 
         idx_corpus: List[np.ndarray] = []
         seq_label_idx: List[Optional[int]] = []
+        index_get = self.vocab.get  # one hash probe per token
         for si, s in enumerate(sequences):
-            ids = np.asarray([self.vocab.index_of(t) for t in s if t in self.vocab],
-                             np.int32)
+            ids = np.asarray([vw.index for vw in map(index_get, s)
+                              if vw is not None], np.int32)
             if len(ids) < 1:
                 continue
             idx_corpus.append(ids)
@@ -736,7 +756,9 @@ class SequenceVectors(WordVectorsBase):
             words_done += N
         drain(final=True)
         self.syn0 = np.asarray(syn0)
-        self.syn1 = np.asarray(syn1)
+        # the syn1 property defers this table's readback to first access
+        self._syn1_pending = syn1
+        self._syn1_host = None
         self._norms = None
         return self
 

@@ -42,6 +42,11 @@ class VocabCache:
     def __contains__(self, word: str) -> bool:
         return word in self._by_word
 
+    def get(self, word: str):
+        """VocabWord for ``word`` or None — the single-probe lookup hot
+        paths use (one hash instead of `in` + `index_of`)."""
+        return self._by_word.get(word)
+
     def __len__(self) -> int:
         return len(self.words)
 

@@ -356,7 +356,7 @@ class Tsne:
                 else self.final_momentum
             Y, vel, gains, kl = _tsne_step(Pj, Y, vel, gains,
                                            jnp.asarray(mom, jnp.float32), self.lr)
-        self.kl_divergence_ = float(kl)
+        self.kl_divergence_ = None if kl is None else float(kl)
         return np.asarray(Y)
 
     def _fit_chunked(self, x: np.ndarray) -> np.ndarray:
@@ -365,6 +365,12 @@ class Tsne:
         n = x.shape[0]
         k = self.knn_k if self.knn_k is not None else int(3 * self.perplexity)
         k = min(k, n - 1)
+        if k < self.perplexity:
+            # the per-row entropy bisection can never reach log(perplexity)
+            # over k neighbors (max entropy = log k): P would silently
+            # degenerate to uniform rows
+            raise ValueError(f"knn_k={k} < perplexity={self.perplexity}: "
+                             "need k >= perplexity (default 3*perplexity)")
         block = min(self.block_size, n)
         xd = jnp.asarray(x)
         # KNN wants LARGE column blocks (the top-k merge per scan step is
@@ -389,5 +395,5 @@ class Tsne:
             Y, vel, gains, kl = _chunked_step_jit(
                 idx, Pj, P_sym, Y, vel, gains, jnp.asarray(mom, jnp.float32),
                 self.lr, block)
-        self.kl_divergence_ = float(kl)
+        self.kl_divergence_ = None if kl is None else float(kl)
         return np.asarray(Y)
