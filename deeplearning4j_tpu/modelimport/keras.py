@@ -45,6 +45,8 @@ from ..nn.layers import (
     BatchNormalization,
     Convolution1D,
     Convolution2D,
+    Cropping2D,
+    Deconvolution2D,
     Dense,
     DropoutLayer,
     EmbeddingSequence,
@@ -59,6 +61,7 @@ from ..nn.layers import (
     Subsampling1D,
     Subsampling2D,
     Upsampling2D,
+    ZeroPadding1D,
     ZeroPadding2D,
 )
 from ..nn.layers.base import Layer
@@ -267,6 +270,51 @@ def _map_conv2d(cfg: dict) -> Layer:
         convolution_mode=_conv_mode(cfg.get("padding", cfg.get("border_mode", "valid"))),
         has_bias=bool(cfg.get("use_bias", True)),
     ), cfg)
+
+
+def _map_conv2d_transpose(cfg: dict) -> Layer:
+    """Keras Conv2DTranspose / 1.x Deconvolution2D → Deconvolution2D.
+    Weight conversion happens in _set_layer_params (keras stores
+    [kh,kw,out,in] and tf.nn.conv2d_transpose spatially flips; our layer
+    runs lax.conv_transpose over an HWIO kernel without flipping)."""
+    _check_data_format(cfg, cfg.get("name", "conv2d_transpose"))
+    op = cfg.get("output_padding")
+    if op is not None and any(int(v) != 0 for v in
+                              (op if isinstance(op, (list, tuple)) else (op,))):
+        raise InvalidKerasConfigurationException(
+            f"Conv2DTranspose '{cfg.get('name')}': output_padding={op} is "
+            "not supported (the imported layer's output shape would "
+            "silently diverge from the source model)")
+    if "kernel_size" in cfg:
+        kernel = _pair(cfg["kernel_size"])
+    else:  # Keras 1.x
+        kernel = (int(cfg.get("nb_row", 3)), int(cfg.get("nb_col", 3)))
+    return _common(Deconvolution2D(
+        n_out=int(cfg.get("filters", cfg.get("nb_filter", 0))),
+        kernel=kernel,
+        stride=_pair(cfg.get("strides", cfg.get("subsample", (1, 1)))),
+        dilation=_pair(cfg.get("dilation_rate", (1, 1))),
+        convolution_mode=_conv_mode(cfg.get("padding", cfg.get("border_mode", "valid"))),
+        has_bias=bool(cfg.get("use_bias", True)),
+    ), cfg)
+
+
+def _map_zeropad1d(cfg: dict) -> Layer:
+    p = cfg.get("padding", 1)
+    pad = (int(p), int(p)) if isinstance(p, int) else (int(p[0]), int(p[1]))
+    return ZeroPadding1D(padding=pad)
+
+
+def _map_cropping2d(cfg: dict) -> Layer:
+    _check_data_format(cfg, cfg.get("name", "cropping2d"))
+    c = cfg.get("cropping", ((0, 0), (0, 0)))
+    if isinstance(c, int):
+        crop = (c, c, c, c)
+    elif isinstance(c[0], (list, tuple)):
+        crop = (int(c[0][0]), int(c[0][1]), int(c[1][0]), int(c[1][1]))
+    else:  # (sym_h, sym_w)
+        crop = (int(c[0]), int(c[0]), int(c[1]), int(c[1]))
+    return Cropping2D(cropping=crop)
 
 
 def _map_separable_conv2d(cfg: dict) -> Layer:
@@ -484,6 +532,10 @@ _LAYER_MAP: Dict[str, Callable[[dict], Layer]] = {
     "AlphaDropout": _map_alpha_dropout,
     "SeparableConv2D": _map_separable_conv2d,
     "SeparableConvolution2D": _map_separable_conv2d,
+    "Conv2DTranspose": _map_conv2d_transpose,
+    "Deconvolution2D": _map_conv2d_transpose,
+    "ZeroPadding1D": _map_zeropad1d,
+    "Cropping2D": _map_cropping2d,
     "LSTM": _map_lstm,
     "SimpleRNN": _map_simple_rnn,
     "Embedding": _map_embedding,
@@ -495,10 +547,11 @@ _LAYER_MAP: Dict[str, Callable[[dict], Layer]] = {
 _STRUCTURAL = {"InputLayer", "Flatten", "Reshape"}
 
 _RANK4 = {"Conv2D", "Convolution2D", "SeparableConv2D",
-          "SeparableConvolution2D", "MaxPooling2D", "AveragePooling2D",
-          "ZeroPadding2D", "UpSampling2D", "SpatialDropout2D"}
+          "SeparableConvolution2D", "Conv2DTranspose", "Deconvolution2D",
+          "MaxPooling2D", "AveragePooling2D",
+          "ZeroPadding2D", "Cropping2D", "UpSampling2D", "SpatialDropout2D"}
 _RANK3 = {"LSTM", "SimpleRNN", "Embedding", "Conv1D", "Convolution1D",
-          "MaxPooling1D", "AveragePooling1D"}
+          "MaxPooling1D", "AveragePooling1D", "ZeroPadding1D"}
 # Dense is rank-preserving in Keras (broadcasts over leading dims)
 _RANK2 = {"GlobalMaxPooling2D", "GlobalAveragePooling2D",
           "GlobalMaxPooling1D", "GlobalAveragePooling1D"}
@@ -624,6 +677,16 @@ def _set_layer_params(layer: Layer, params: Dict[str, Any], state: Dict[str, Any
             put(params, "dW", dk.reshape(kh, kw, 1, cin * dm))
         if "pointwise_kernel" in w:
             put(params, "pW", w["pointwise_kernel"])   # [1,1,in*dm,out] — same
+        if layer.has_bias and ("bias" in w or "b" in w):
+            put(params, "b", w.get("bias", w.get("b")))
+    elif isinstance(layer, Deconvolution2D):
+        # keras Conv2DTranspose kernel [kh,kw,out,in] with tf's implicit
+        # spatial flip → our HWIO [kh,kw,in,out] for plain
+        # lax.conv_transpose: transpose the channel dims AND flip H/W
+        # (verified elementwise against tf.nn.conv2d_transpose —
+        # tests/test_modelimport.py::TestConv2DTranspose)
+        if "kernel" in w:
+            put(params, "W", w["kernel"].transpose(0, 1, 3, 2)[::-1, ::-1])
         if layer.has_bias and ("bias" in w or "b" in w):
             put(params, "b", w.get("bias", w.get("b")))
     elif isinstance(layer, (Convolution2D, Convolution1D)):

@@ -1,0 +1,80 @@
+"""ctypes binding for the C++ skip-gram window generator
+(native/w2v_window.cpp) — same build-on-first-use scheme as
+datasets/native_loader.py; falls back to the numpy pipeline when g++ is
+unavailable.  Pair semantics match the numpy path (position-major
+centers, ascending context offsets, per-center dynamic window,
+sentence-bounded); only the dynamic-window RNG stream differs
+(splitmix64 vs numpy PCG64) — both deterministic per seed.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native", "w2v_window.cpp")
+
+
+def load_window_lib() -> Optional[ctypes.CDLL]:
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB or None
+        build = os.path.join(os.path.dirname(_SRC), "build")
+        os.makedirs(build, exist_ok=True)
+        so = os.path.join(build, "libdl4jtpu_w2v.so")
+        try:
+            if not os.path.exists(so) \
+                    or os.path.getmtime(so) < os.path.getmtime(_SRC):
+                # temp + atomic rename: concurrent builders never expose a
+                # half-linked .so to each other
+                tmp = f"{so}.{os.getpid()}.tmp"
+                subprocess.run(
+                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC,
+                     "-o", tmp],
+                    check=True, capture_output=True, timeout=120)
+                os.replace(tmp, so)
+            lib = ctypes.CDLL(so)  # a corrupt cached .so must also fall back
+        except (subprocess.CalledProcessError, FileNotFoundError,
+                subprocess.TimeoutExpired, OSError) as e:
+            logger.warning("w2v window generator unavailable (%s); "
+                           "using numpy fallback", e)
+            _LIB = False
+            return None
+        lib.dl4j_sg_windows.restype = ctypes.c_int64
+        lib.dl4j_sg_windows.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.c_uint64, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+        _LIB = lib
+        return lib
+
+
+def sg_windows(tokens: np.ndarray, sids: np.ndarray, window: int,
+               seed: int) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
+    """(centers, targets, center_positions) for the block, or None when the
+    native library is unavailable (caller falls back to numpy)."""
+    lib = load_window_lib()
+    if lib is None:
+        return None
+    n = len(tokens)
+    cap = n * 2 * window
+    t = np.ascontiguousarray(tokens, np.int32)
+    s = np.ascontiguousarray(sids, np.int32)
+    centers = np.empty(cap, np.int32)
+    targets = np.empty(cap, np.int32)
+    pos = np.empty(cap, np.int64)
+    k = lib.dl4j_sg_windows(
+        t.ctypes.data, s.ctypes.data, n, window, np.uint64(seed),
+        centers.ctypes.data, targets.ctypes.data, pos.ctypes.data)
+    return centers[:k], targets[:k], pos[:k]

@@ -351,6 +351,53 @@ register_tokenizer_factory("japanese", CJKTokenizerFactory)
 register_tokenizer_factory("korean", CJKTokenizerFactory)
 
 
+class SentenceSegmenter:
+    """Rule-based sentence boundary detection (the deeplearning4j-nlp-uima
+    SentenceAnnotator role, dependency-free): splits on .!?… followed by
+    whitespace + an uppercase/digit/CJK start, protecting common
+    abbreviations and decimal numbers."""
+
+    _ABBREV = {"mr", "mrs", "ms", "dr", "prof", "sr", "jr", "st", "vs",
+               "etc", "e.g", "i.e", "fig", "no", "vol", "inc", "ltd", "co"}
+    # CJK terminators split with NO following whitespace (real CJK prose
+    # has none); latin terminators require it (protects decimals/initials)
+    _BOUNDARY = re.compile(r"(?<=[。！？])\s*|(?<=[.!?…])\s+")
+
+    def segment(self, text: str) -> List[str]:
+        parts = self._BOUNDARY.split(text.strip())
+        out: List[str] = []
+        for p in parts:
+            p = p.strip()
+            if not p:
+                continue
+            if out:
+                prev = out[-1]
+                last_word = prev[:-1].rsplit(None, 1)[-1].lower() if " " in prev \
+                    else prev[:-1].lower()
+                # re-join: abbreviation before the split, or a lowercase
+                # continuation (the boundary regex can't look back far)
+                if (prev.endswith(".") and last_word.rstrip(".") in self._ABBREV) \
+                        or (p[:1].islower()):
+                    out[-1] = prev + " " + p
+                    continue
+            out.append(p)
+        return out
+
+
+class TextSentenceIterator:
+    """Raw-text sentence iterator: SentenceSegmenter over whole documents
+    (reference UimaSentenceIterator's role — feed documents, iterate
+    sentences)."""
+
+    def __init__(self, documents: Iterable[str], segmenter=None):
+        self.documents = documents
+        self.segmenter = segmenter or SentenceSegmenter()
+
+    def __iter__(self) -> Iterable[str]:
+        for doc in self.documents:
+            yield from self.segmenter.segment(doc)
+
+
 class LineSentenceIterator:
     """Sentence-per-line corpus iterator (reference BasicLineIterator)."""
 

@@ -578,3 +578,76 @@ class TestSeparableAndNoiseLayers:
         expected = np.exp(expected - expected.max(-1, keepdims=True))
         expected /= expected.sum(-1, keepdims=True)
         np.testing.assert_allclose(net.output(x), expected, rtol=1e-5)
+
+
+class TestConv2DTranspose:
+    """Round-4 mappers: Conv2DTranspose/Deconvolution2D (tf.nn oracle),
+    ZeroPadding1D, Cropping2D."""
+
+    def test_conv2d_transpose_matches_tensorflow(self, tmp_path):
+        tf = pytest.importorskip("tensorflow")
+        rng = _rng()
+        x = rng.normal(size=(2, 5, 5, 3)).astype(np.float32)
+        wk = rng.normal(size=(3, 3, 4, 3)).astype(np.float32)  # [kh,kw,out,in]
+        b = rng.normal(size=(4,)).astype(np.float32)
+        cfg = _seq_config([
+            {"class_name": "Conv2DTranspose", "config": {
+                "name": "deconv", "filters": 4, "kernel_size": [3, 3],
+                "strides": [2, 2], "padding": "same", "use_bias": True,
+                "activation": "linear", "data_format": "channels_last",
+                "batch_input_shape": [None, 5, 5, 3]}},
+        ])
+        path = str(tmp_path / "m.h5")
+        _write_keras_file(path, cfg, None, {
+            "deconv": {"deconv/kernel:0": wk, "deconv/bias:0": b}})
+        net = import_keras_sequential_model_and_weights(path)
+        got = net.output(x)
+        ref = tf.nn.conv2d_transpose(
+            x, wk, output_shape=(2, 10, 10, 4), strides=(1, 2, 2, 1),
+            padding="SAME").numpy() + b
+        np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-5)
+
+    def test_zeropad1d_and_cropping2d_shapes(self, tmp_path):
+        rng = _rng()
+        cfg = _seq_config([
+            {"class_name": "ZeroPadding1D", "config": {
+                "name": "zp", "padding": [2, 1],
+                "batch_input_shape": [None, 6, 4]}},
+        ])
+        path = str(tmp_path / "zp.h5")
+        _write_keras_file(path, cfg, None, {})
+        net = import_keras_sequential_model_and_weights(path)
+        x = rng.normal(size=(3, 6, 4)).astype(np.float32)
+        y = net.output(x)
+        assert y.shape == (3, 9, 4)
+        np.testing.assert_allclose(y[:, 2:8], x)
+        np.testing.assert_allclose(y[:, :2], 0)
+
+        cfg2 = _seq_config([
+            {"class_name": "Cropping2D", "config": {
+                "name": "cr", "cropping": [[1, 2], [0, 1]],
+                "data_format": "channels_last",
+                "batch_input_shape": [None, 8, 8, 2]}},
+        ])
+        path2 = str(tmp_path / "cr.h5")
+        _write_keras_file(path2, cfg2, None, {})
+        net2 = import_keras_sequential_model_and_weights(path2)
+        x2 = rng.normal(size=(2, 8, 8, 2)).astype(np.float32)
+        y2 = net2.output(x2)
+        assert y2.shape == (2, 5, 7, 2)
+        np.testing.assert_allclose(y2, x2[:, 1:6, 0:7, :])
+
+    def test_output_padding_rejected(self, tmp_path):
+        cfg = _seq_config([
+            {"class_name": "Conv2DTranspose", "config": {
+                "name": "d", "filters": 2, "kernel_size": [3, 3],
+                "strides": [2, 2], "padding": "valid", "output_padding": [1, 1],
+                "use_bias": False, "data_format": "channels_last",
+                "batch_input_shape": [None, 5, 5, 3]}},
+        ])
+        path = str(tmp_path / "op.h5")
+        _write_keras_file(path, cfg, None, {"d": {"d/kernel:0": np.zeros(
+            (3, 3, 2, 3), np.float32)}})
+        with pytest.raises(InvalidKerasConfigurationException,
+                           match="output_padding"):
+            import_keras_sequential_model_and_weights(path)

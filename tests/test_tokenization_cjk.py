@@ -200,3 +200,35 @@ class TestPosTagging:
         vocab = w2v.vocab
         assert all(w in vocab for w in ("dog", "cat", "fence"))
         assert "the" not in vocab and "chased" not in vocab
+
+
+class TestSentenceSegmentation:
+    """Round-4: the UIMA SentenceAnnotator role (reference
+    deeplearning4j-nlp-uima), dependency-free rules."""
+
+    def test_basic_boundaries(self):
+        from deeplearning4j_tpu.nlp.tokenization import SentenceSegmenter
+        s = SentenceSegmenter()
+        assert s.segment("Hello world. How are you? Fine!") == \
+            ["Hello world.", "How are you?", "Fine!"]
+
+    def test_abbreviations_protected(self):
+        from deeplearning4j_tpu.nlp.tokenization import SentenceSegmenter
+        s = SentenceSegmenter()
+        got = s.segment("Dr. Smith arrived. He was late.")
+        assert got == ["Dr. Smith arrived.", "He was late."]
+
+    def test_cjk_terminators(self):
+        from deeplearning4j_tpu.nlp.tokenization import SentenceSegmenter
+        s = SentenceSegmenter()
+        assert s.segment("这是第一句。这是第二句。") == ["这是第一句。", "这是第二句。"]
+
+    def test_text_sentence_iterator_feeds_word2vec(self):
+        from deeplearning4j_tpu.nlp.tokenization import TextSentenceIterator
+        from deeplearning4j_tpu.nlp import Word2Vec
+        docs = ["The dog barked. The cat slept." for _ in range(20)]
+        sents = list(TextSentenceIterator(docs))
+        assert len(sents) == 40
+        w2v = Word2Vec(layer_size=8, min_word_frequency=1, epochs=1)
+        w2v.fit(sents)
+        assert "dog" in w2v.vocab and "cat" in w2v.vocab
