@@ -16,7 +16,6 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
-import subprocess
 import threading
 from typing import Optional
 
@@ -33,32 +32,17 @@ _SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))), "native", "data_loader.cpp")
 
 
-def _build_dir() -> str:
-    d = os.path.join(os.path.dirname(_SRC), "build")
-    os.makedirs(d, exist_ok=True)
-    return d
-
-
 def load_native_lib() -> Optional[ctypes.CDLL]:
     """Compile (once) and dlopen the loader; None if unavailable."""
+    from ..utils.native_build import build_and_load
     global _LIB
     with _LIB_LOCK:
         if _LIB is not None:
             return _LIB or None
-        so = os.path.join(_build_dir(), "libdl4jtpu_data.so")
-        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(_SRC):
-            try:
-                subprocess.run(
-                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC,
-                     "-o", so, "-lpthread"],
-                    check=True, capture_output=True, timeout=120)
-            except (subprocess.CalledProcessError, FileNotFoundError,
-                    subprocess.TimeoutExpired) as e:
-                logger.warning("native data loader build failed (%s); "
-                               "using Python fallback", e)
-                _LIB = False
-                return None
-        lib = ctypes.CDLL(so)
+        lib = build_and_load(_SRC, "libdl4jtpu_data.so", ("-lpthread",))
+        if lib is None:
+            _LIB = False
+            return None
         lib.dl4j_loader_create.restype = ctypes.c_void_p
         lib.dl4j_loader_create.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,

@@ -10,15 +10,13 @@ sentence-bounded); only the dynamic-window RNG stream differs
 from __future__ import annotations
 
 import ctypes
-import logging
 import os
-import subprocess
 import threading
 from typing import Optional, Tuple
 
 import numpy as np
 
-logger = logging.getLogger("deeplearning4j_tpu")
+from ..utils.native_build import build_and_load
 
 _LIB = None
 _LIB_LOCK = threading.Lock()
@@ -31,25 +29,8 @@ def load_window_lib() -> Optional[ctypes.CDLL]:
     with _LIB_LOCK:
         if _LIB is not None:
             return _LIB or None
-        build = os.path.join(os.path.dirname(_SRC), "build")
-        os.makedirs(build, exist_ok=True)
-        so = os.path.join(build, "libdl4jtpu_w2v.so")
-        try:
-            if not os.path.exists(so) \
-                    or os.path.getmtime(so) < os.path.getmtime(_SRC):
-                # temp + atomic rename: concurrent builders never expose a
-                # half-linked .so to each other
-                tmp = f"{so}.{os.getpid()}.tmp"
-                subprocess.run(
-                    ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", _SRC,
-                     "-o", tmp],
-                    check=True, capture_output=True, timeout=120)
-                os.replace(tmp, so)
-            lib = ctypes.CDLL(so)  # a corrupt cached .so must also fall back
-        except (subprocess.CalledProcessError, FileNotFoundError,
-                subprocess.TimeoutExpired, OSError) as e:
-            logger.warning("w2v window generator unavailable (%s); "
-                           "using numpy fallback", e)
+        lib = build_and_load(_SRC, "libdl4jtpu_w2v.so")
+        if lib is None:
             _LIB = False
             return None
         lib.dl4j_sg_windows.restype = ctypes.c_int64

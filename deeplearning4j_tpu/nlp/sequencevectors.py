@@ -378,6 +378,11 @@ class SequenceVectors(WordVectorsBase):
                  train_sequences: bool = False,
                  dm: bool = True):
         self.layer_size = layer_size
+        if window < 1:
+            # validated up front: the numpy path would raise from
+            # rng.integers(1, 1) and the C++ generator would SIGFPE on a
+            # modulo-by-zero — neither is an acceptable failure mode
+            raise ValueError(f"window must be >= 1, got {window}")
         self.window = window
         self.min_word_frequency = min_word_frequency
         self.negative = negative

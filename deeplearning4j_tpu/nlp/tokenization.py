@@ -357,8 +357,12 @@ class SentenceSegmenter:
     whitespace + an uppercase/digit/CJK start, protecting common
     abbreviations and decimal numbers."""
 
-    _ABBREV = {"mr", "mrs", "ms", "dr", "prof", "sr", "jr", "st", "vs",
-               "etc", "e.g", "i.e", "fig", "no", "vol", "inc", "ltd", "co"}
+    # always-protected abbreviations vs ones that are ordinary words at a
+    # genuine sentence end ("she said no.", "the old st."): the latter
+    # only protect when the next sentence starts with a digit ("No. 5")
+    _ABBREV = {"mr", "mrs", "ms", "dr", "prof", "sr", "jr", "vs",
+               "etc", "e.g", "i.e", "inc", "ltd", "co"}
+    _ABBREV_NUM = {"no", "fig", "vol", "st", "p", "pp"}
     # CJK terminators split with NO following whitespace (real CJK prose
     # has none); latin terminators require it (protects decimals/initials)
     _BOUNDARY = re.compile(r"(?<=[。！？])\s*|(?<=[.!?…])\s+")
@@ -376,8 +380,11 @@ class SentenceSegmenter:
                     else prev[:-1].lower()
                 # re-join: abbreviation before the split, or a lowercase
                 # continuation (the boundary regex can't look back far)
-                if (prev.endswith(".") and last_word.rstrip(".") in self._ABBREV) \
-                        or (p[:1].islower()):
+                word = last_word.rstrip(".")
+                abbrev = prev.endswith(".") and (
+                    word in self._ABBREV
+                    or (word in self._ABBREV_NUM and p[:1].isdigit()))
+                if abbrev or p[:1].islower():
                     out[-1] = prev + " " + p
                     continue
             out.append(p)

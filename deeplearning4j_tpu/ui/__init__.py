@@ -13,7 +13,7 @@ deep performance traces.
 
 from .stats import StatsListener
 from .storage import FileStatsStorage, InMemoryStatsStorage, SqliteStatsStorage
-from .render import render_dashboard
+from .render import render_dashboard, render_embedding_html
 from .remote import RemoteStatsRouter
 from .server import UIServer
 from .profiler import profile_trace
@@ -21,5 +21,5 @@ from .profiler import profile_trace
 __all__ = [
     "StatsListener",
     "InMemoryStatsStorage", "FileStatsStorage", "SqliteStatsStorage",
-    "render_dashboard", "RemoteStatsRouter", "UIServer", "profile_trace",
+    "render_dashboard", "render_embedding_html", "RemoteStatsRouter", "UIServer", "profile_trace",
 ]

@@ -143,3 +143,46 @@ def render_dashboard(storage, path: str,
     with open(path, "w") as f:
         f.write(html_text)
     return path
+
+
+def render_embedding_html(coords, labels=None, words: Optional[Sequence[str]] = None,
+                          title: str = "t-SNE embedding",
+                          w: int = 720, h: int = 720) -> str:
+    """Self-contained scatter page for 2-D embeddings — the reference UI's
+    t-SNE viewer (deeplearning4j-play TsneModule: upload coords, render a
+    point cloud).  ``coords`` [N,2]; ``labels`` optional int classes
+    (colors); ``words`` optional hover/annotation strings (first 200 get
+    text annotations, all get <title> hovers)."""
+    import numpy as np
+
+    c = np.asarray(coords, float)
+    if c.ndim != 2 or c.shape[1] != 2:
+        raise ValueError(f"coords must be [N,2], got {c.shape}")
+    n = len(c)
+    x0, y0 = c.min(axis=0)
+    x1, y1 = c.max(axis=0)
+    xr = (x1 - x0) or 1.0
+    yr = (y1 - y0) or 1.0
+    palette = ["#2563eb", "#dc2626", "#059669", "#d97706", "#7c3aed",
+               "#0891b2", "#be185d", "#4d7c0f", "#b45309", "#1e40af"]
+    lab = None if labels is None else np.asarray(labels)
+    pts = []
+    for i in range(n):
+        px = 20 + (c[i, 0] - x0) / xr * (w - 40)
+        py = h - 20 - (c[i, 1] - y0) / yr * (h - 40)
+        color = palette[int(lab[i]) % len(palette)] if lab is not None \
+            else "#2563eb"
+        tip = html.escape(str(words[i])) if words is not None else str(i)
+        pts.append(f'<circle cx="{px:.1f}" cy="{py:.1f}" r="2.5" '
+                   f'fill="{color}" fill-opacity="0.7"><title>{tip}</title>'
+                   f'</circle>')
+        if words is not None and i < 200:
+            pts.append(f'<text x="{px + 3:.1f}" y="{py - 3:.1f}" '
+                       f'font-size="8" fill="#444">{html.escape(str(words[i]))}'
+                       f'</text>')
+    return ("<!doctype html><html><head><meta charset='utf-8'>"
+            f"<title>{html.escape(title)}</title></head><body "
+            "style='font-family:system-ui;margin:16px'>"
+            f"<h2>{html.escape(title)}</h2><p>{n} points</p>"
+            f'<svg width="{w}" height="{h}" style="background:#fafafa;'
+            f'border:1px solid #ddd">{"".join(pts)}</svg></body></html>')

@@ -1,0 +1,43 @@
+"""Shared build-on-first-use loader for the C++ native pieces
+(native/*.cpp — the SURVEY §2.2 native seam).
+
+One hardened implementation for every binding module: mtime-based
+rebuild, atomic temp+rename (concurrent builders never expose a
+half-linked .so), and warn-and-fallback on ANY failure including a
+corrupt cached library (dlopen errors), so callers degrade to their
+pure-Python paths instead of crashing mid-training.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import subprocess
+from typing import Optional, Sequence
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+def build_and_load(src: str, so_name: str,
+                   extra_flags: Sequence[str] = ()) -> Optional[ctypes.CDLL]:
+    """Compile ``src`` (if stale) into native/build/``so_name`` and dlopen
+    it; None on any failure (callers fall back to Python)."""
+    build = os.path.join(os.path.dirname(src), "build")
+    os.makedirs(build, exist_ok=True)
+    so = os.path.join(build, so_name)
+    try:
+        if not os.path.exists(so) \
+                or os.path.getmtime(so) < os.path.getmtime(src):
+            tmp = f"{so}.{os.getpid()}.tmp"
+            subprocess.run(
+                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", src,
+                 "-o", tmp, *extra_flags],
+                check=True, capture_output=True, timeout=120)
+            os.replace(tmp, so)
+        return ctypes.CDLL(so)
+    except (subprocess.CalledProcessError, FileNotFoundError,
+            subprocess.TimeoutExpired, OSError) as e:
+        logger.warning("native library %s unavailable (%s); using Python "
+                       "fallback", so_name, e)
+        return None

@@ -123,3 +123,60 @@ class TestWord2Vec:
                                    w2v.similarity("cat", "dog"), atol=1e-4)
         assert set(static.words_nearest("cat", 3)) == \
             set(w2v.words_nearest("cat", 3))
+
+
+class TestNativeWindowGenerator:
+    """Round-4: the C++ skip-gram pair generator (native/w2v_window.cpp)
+    must emit exactly the pair structure the numpy mask pipeline defines:
+    position-major centers, ascending context offsets, sentence-bounded,
+    self-pair excluded, a contiguous ±b_i span per center."""
+
+    def test_pair_stream_structure_matches_oracle(self):
+        from deeplearning4j_tpu.nlp._native_windows import sg_windows
+        result = sg_windows(
+            np.asarray([5, 6, 7, 8, 9, 1, 2, 3], np.int32),
+            np.asarray([0, 0, 0, 0, 0, 1, 1, 1], np.int32),
+            window=3, seed=42)
+        if result is None:
+            import pytest
+            pytest.skip("native lib unavailable")
+        tokens = np.asarray([5, 6, 7, 8, 9, 1, 2, 3])
+        sids = np.asarray([0, 0, 0, 0, 0, 1, 1, 1])
+        cen, tgt, pos = result
+        assert len(cen) == len(tgt) == len(pos) > 0
+        # position-major order
+        assert (np.diff(pos) >= 0).all()
+        by_center = {}
+        for c, t, p in zip(cen, tgt, pos):
+            assert tokens[p] == c                      # center token matches
+            by_center.setdefault(int(p), []).append((int(t), int(p)))
+        for i, pairs in by_center.items():
+            # recover this center's drawn window from its farthest context,
+            # then demand the span is complete and sentence-bounded
+            ts = [t for t, _ in pairs]
+            js = [j for j in range(len(tokens))
+                  if j != i and sids[j] == sids[i]]
+            radii = [abs(j - i) for j in js if tokens[j] in ts]
+            b = max(radii)
+            assert 1 <= b <= 3
+            want = sorted(int(tokens[j]) for j in js if abs(j - i) <= b)
+            assert sorted(ts) == want, (i, ts, want)
+
+    def test_no_cross_sentence_pairs(self):
+        from deeplearning4j_tpu.nlp._native_windows import sg_windows
+        # two sentences of DISTINCT tokens: any cross-pair is detectable
+        result = sg_windows(
+            np.asarray([10, 11, 12, 20, 21, 22], np.int32),
+            np.asarray([0, 0, 0, 1, 1, 1], np.int32), window=5, seed=7)
+        if result is None:
+            import pytest
+            pytest.skip("native lib unavailable")
+        cen, tgt, _ = result
+        for c, t in zip(cen, tgt):
+            assert (c < 20) == (t < 20), (c, t)
+
+    def test_window_zero_raises_cleanly(self):
+        import pytest
+        from deeplearning4j_tpu.nlp import Word2Vec
+        with pytest.raises(ValueError, match="window"):
+            Word2Vec(layer_size=8, window=0)

@@ -136,3 +136,37 @@ class TestProfiler:
             _ = jnp.ones((8, 8)) @ jnp.ones((8, 8))
         # trace dir may or may not materialize depending on backend; the
         # contract is "never crashes training"
+
+
+class TestEmbeddingViewer:
+    """Round-4: the reference UI's t-SNE viewer role (deeplearning4j-play
+    TsneModule) as a self-contained SVG scatter page."""
+
+    def test_render_embedding_page(self):
+        import numpy as np
+        from deeplearning4j_tpu.ui import render_embedding_html
+        rng = np.random.default_rng(0)
+        coords = rng.normal(size=(50, 2))
+        labels = rng.integers(0, 3, 50)
+        words = [f"w{i}" for i in range(50)]
+        page = render_embedding_html(coords, labels, words, title="demo")
+        assert page.count("<circle") == 50
+        assert "w7" in page and "demo" in page
+        assert "#dc2626" in page  # class-1 color present
+
+    def test_tsne_to_viewer_pipeline(self, tmp_path):
+        import numpy as np
+        from deeplearning4j_tpu.plot import Tsne
+        from deeplearning4j_tpu.ui import render_embedding_html
+        rng = np.random.default_rng(1)
+        x = np.concatenate([rng.normal(m, 0.3, (30, 8)) for m in (-3, 3)]).astype(np.float32)
+        y = Tsne(perplexity=8.0, max_iter=60).fit_transform(x)
+        p = tmp_path / "emb.html"
+        p.write_text(render_embedding_html(y, [0] * 30 + [1] * 30))
+        assert p.stat().st_size > 1000
+
+    def test_bad_shape_raises(self):
+        import numpy as np, pytest
+        from deeplearning4j_tpu.ui import render_embedding_html
+        with pytest.raises(ValueError, match="N,2"):
+            render_embedding_html(np.zeros((5, 3)))
