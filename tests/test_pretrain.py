@@ -156,12 +156,11 @@ class TestStackedPretrainFinetune:
 
 
 class TestGraphPretrain:
-    def test_graph_pretrain_drives_vae_and_ae(self):
-        rng = np.random.default_rng(3)
-        x, y = _blobs(rng, 256)
-        conf = (GraphBuilder().seed(4).updater(Adam(lr=1e-2))
+    @staticmethod
+    def _graph(seed, n_out):
+        conf = (GraphBuilder().seed(seed).updater(Adam(lr=1e-2))
                 .add_inputs("in")
-                .add_layer("ae", AutoEncoder(n_out=32, corruption_level=0.2), "in")
+                .add_layer("ae", AutoEncoder(n_out=n_out, corruption_level=0.2), "in")
                 .add_layer("out", OutputLayer(n_out=8, activation="softmax",
                                               loss="mcxent"), "ae")
                 .set_outputs("out")
@@ -169,6 +168,12 @@ class TestGraphPretrain:
                 .build())
         g = ComputationGraph(conf)
         g.init()
+        return g
+
+    def test_graph_pretrain_drives_vae_and_ae(self):
+        rng = np.random.default_rng(3)
+        x, y = _blobs(rng, 256)
+        g = self._graph(4, 32)
         assert g.pretrainable_layers() == ["ae"]
         stats = g.pretrain(_batches(x, y, 64), epochs=10)
         assert float(stats["ae"][-1]) < 0.6 * float(stats["ae"][0])
@@ -187,3 +192,22 @@ class TestGraphPretrain:
             g.pretrain_layer("nope", [])
         with pytest.raises(ValueError, match="unsupervised"):
             g.pretrain_layer("d", [])
+
+
+class TestGraphPretrainSerde:
+    _graph = TestGraphPretrain._graph
+
+    def test_graph_pretrained_state_round_trips(self, tmp_path):
+        """CG parity with the MLN serde test: pretrained vertex params
+        survive save/load (reference ComputationGraph + ModelSerializer)."""
+        rng = np.random.default_rng(9)
+        x, y = _blobs(rng, 128)
+        g = self._graph(6, 24)
+        g.pretrain(_batches(x, y, 64), epochs=3)
+        p = str(tmp_path / "gpre.zip")
+        g.save(p)
+        g2 = ComputationGraph.load(p)
+        np.testing.assert_allclose(np.asarray(g2.params["ae"]["W"]),
+                                   np.asarray(g.params["ae"]["W"]), rtol=1e-6)
+        np.testing.assert_allclose(g2.output(x[:8])[0], g.output(x[:8])[0],
+                                   rtol=1e-5)
