@@ -195,14 +195,12 @@ class TestGraphPretrain:
 
 
 class TestGraphPretrainSerde:
-    _graph = TestGraphPretrain._graph
-
     def test_graph_pretrained_state_round_trips(self, tmp_path):
         """CG parity with the MLN serde test: pretrained vertex params
         survive save/load (reference ComputationGraph + ModelSerializer)."""
         rng = np.random.default_rng(9)
         x, y = _blobs(rng, 128)
-        g = self._graph(6, 24)
+        g = TestGraphPretrain._graph(6, 24)
         g.pretrain(_batches(x, y, 64), epochs=3)
         p = str(tmp_path / "gpre.zip")
         g.save(p)
