@@ -243,11 +243,18 @@ def bench_word2vec_lstm():
         return Word2Vec(layer_size=128, window=5, min_word_frequency=1,
                         epochs=1, batch_size=4096, subsampling=0)
 
-    make_w2v().fit(sentences)  # warmup: vocab + jit compile
+    warm = make_w2v()
+    warm.fit(sentences)
+    warm.word_vector("w0")  # drain the warmup's async queue before timing
     w2v_rate = 0.0
     for _ in range(1 if QUICK else 3):  # best-of-3: tunnel-spike robust,
         t0 = time.perf_counter()        # same policy as _steady_state
-        make_w2v().fit(sentences)
+        m = make_w2v()
+        m.fit(sentences)
+        # fit() enqueues async and exports tables lazily (framework-wide
+        # device-resident convention) — materialize a vector INSIDE the
+        # window so the metric stays end-to-end (device drain + readback)
+        m.word_vector("w0")
         w2v_rate = max(w2v_rate, n_words / (time.perf_counter() - t0))
 
     # char-LSTM: chars/sec through the REAL training path — fit_batch with

@@ -281,6 +281,37 @@ def _sg_hs_step(syn0, syn1hs, centers, points, codes, code_mask, lr, chunks=1):
     return syn0, syn1hs
 
 
+class _LazyTable:
+    """Descriptor: a device-resident table exported to a MUTABLE host
+    np.ndarray on first access (pending/host attribute pair).  One
+    implementation for syn0/syn1 (and any future table)."""
+
+    def __init__(self, pending_attr: str, host_attr: str,
+                 clears_norms: bool = False):
+        self._pending = pending_attr
+        self._host = host_attr
+        self._clears_norms = clears_norms
+
+    def __get__(self, obj, objtype=None):
+        if obj is None:
+            return self
+        host = getattr(obj, self._host, None)
+        pending = getattr(obj, self._pending, None)
+        if host is None and pending is not None:
+            # np.array (not asarray): jax device views are read-only; the
+            # contract is a mutable host table
+            host = np.array(pending)
+            setattr(obj, self._host, host)
+            setattr(obj, self._pending, None)
+        return host
+
+    def __set__(self, obj, value) -> None:
+        setattr(obj, self._pending, None)
+        setattr(obj, self._host, None if value is None else np.asarray(value))
+        if self._clears_norms:
+            obj._norms = None
+
+
 class WordVectorsBase:
     """Lookup API shared by every embedding model (reference
     models/embeddings/wordvectors/WordVectors.java interface)."""
@@ -406,8 +437,9 @@ class SequenceVectors(WordVectorsBase):
         self.train_sequences = train_sequences
         self.dm = dm
         self.vocab: Optional[VocabCache] = None
-        self.syn0: Optional[np.ndarray] = None
-        self._syn1_pending = None   # device array awaiting lazy readback
+        self._syn0_pending = None   # device arrays awaiting lazy readback
+        self._syn0_host: Optional[np.ndarray] = None
+        self._syn1_pending = None
         self._syn1_host: Optional[np.ndarray] = None
         self.label_index: Dict[Hashable, int] = {}
         self._norms = None
@@ -419,23 +451,12 @@ class SequenceVectors(WordVectorsBase):
 
     # ------------------------------------------------------------------
 
-    @property
-    def syn1(self) -> Optional[np.ndarray]:
-        """Output table as a genuine (mutable) np.ndarray.  The device→host
-        readback is deferred to first access — fit() ends with syn1 still
-        on device because most consumers never touch it (each eager
-        readback costs ~200ms of tunnel latency on the bench chip)."""
-        if self._syn1_host is None and self._syn1_pending is not None:
-            # np.array (not asarray): jax device views are read-only; the
-            # contract is a mutable host table
-            self._syn1_host = np.array(self._syn1_pending)
-            self._syn1_pending = None
-        return self._syn1_host
-
-    @syn1.setter
-    def syn1(self, value) -> None:
-        self._syn1_pending = None
-        self._syn1_host = None if value is None else np.asarray(value)
+    # Tables stay device-resident after fit (the framework-wide
+    # convention — MLN/CG params never eagerly export either) and
+    # materialize as genuine MUTABLE host arrays on first access: each
+    # eager readback costs ~200ms of tunnel latency on the bench chip.
+    syn0 = _LazyTable("_syn0_pending", "_syn0_host", clears_norms=True)
+    syn1 = _LazyTable("_syn1_pending", "_syn1_host")
 
     def _sg_step(self, syn0, syn1, centers, contexts, negatives, valid, lr,
                  chunks=1):
@@ -780,8 +801,10 @@ class SequenceVectors(WordVectorsBase):
                 startpos = cut
             words_done += N
         drain(final=True)
-        self.syn0 = np.asarray(syn0)
-        # the syn1 property defers this table's readback to first access
+        # both tables defer their device→host readback to first access
+        # (the syn0/syn1 properties); training is complete device-side
+        self._syn0_pending = syn0
+        self._syn0_host = None
         self._syn1_pending = syn1
         self._syn1_host = None
         self._norms = None
