@@ -302,7 +302,9 @@ def _map_conv2d_transpose(cfg: dict) -> Layer:
 def _map_zeropad1d(cfg: dict) -> Layer:
     p = cfg.get("padding", 1)
     pad = (int(p), int(p)) if isinstance(p, int) else (int(p[0]), int(p[1]))
-    return ZeroPadding1D(padding=pad)
+    layer = ZeroPadding1D(padding=pad)
+    layer.name = cfg.get("name")
+    return layer
 
 
 def _map_cropping2d(cfg: dict) -> Layer:
@@ -314,7 +316,9 @@ def _map_cropping2d(cfg: dict) -> Layer:
         crop = (int(c[0][0]), int(c[0][1]), int(c[1][0]), int(c[1][1]))
     else:  # (sym_h, sym_w)
         crop = (int(c[0]), int(c[0]), int(c[1]), int(c[1]))
-    return Cropping2D(cropping=crop)
+    layer = Cropping2D(cropping=crop)
+    layer.name = cfg.get("name")
+    return layer
 
 
 def _map_separable_conv2d(cfg: dict) -> Layer:

@@ -45,6 +45,8 @@ def sg_windows(tokens: np.ndarray, sids: np.ndarray, window: int,
                seed: int) -> Optional[Tuple[np.ndarray, np.ndarray, np.ndarray]]:
     """(centers, targets, center_positions) for the block, or None when the
     native library is unavailable (caller falls back to numpy)."""
+    if window < 1:  # the C++ modulo would SIGFPE — fail in Python instead
+        raise ValueError(f"window must be >= 1, got {window}")
     lib = load_window_lib()
     if lib is None:
         return None

@@ -62,8 +62,19 @@ class DefaultTokenizerFactory:
     _CACHE_CAP = 1 << 20
 
     def __init__(self, preprocessor=None):
-        self.preprocessor = preprocessor or CommonPreprocessor()
         self._cache: Dict[str, str] = {}
+        self.preprocessor = preprocessor or CommonPreprocessor()
+
+    @property
+    def preprocessor(self):
+        return self._preprocessor
+
+    @preprocessor.setter
+    def preprocessor(self, value) -> None:
+        # the memo cache holds the OLD preprocessor's outputs — swapping
+        # preprocessors mid-stream must not serve stale results
+        self._preprocessor = value
+        self._cache.clear()
 
     def tokenize(self, sentence: str) -> List[str]:
         if self.preprocessor is None:

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from ...ops.initializers import init_weight
-from ...ops.losses import get_loss
+from ...ops.losses import get_loss, summed_per_example
 from ..conf.inputs import InputType
 from .base import ForwardOut, Layer, register_layer
 
@@ -94,9 +94,7 @@ class OutputLayer(Dense):
         pre = x @ params["W"].astype(x.dtype)
         if self.has_bias:
             pre = pre + params["b"].astype(x.dtype)
-        pe = get_loss(self.loss).per_example(labels, pre,
-                                             self.activation or "identity", mask)
-        return pe.sum(axis=tuple(range(1, pe.ndim)))  # time summed for RNNs
+        return summed_per_example(self.loss, labels, pre, self.activation, mask)
 
 
 @register_layer
@@ -117,9 +115,7 @@ class LossLayer(Layer):
 
     def score_examples(self, params, state, x, labels, *,
                        mask: Optional[Array] = None) -> Array:
-        pe = get_loss(self.loss).per_example(labels, x,
-                                             self.activation or "identity", mask)
-        return pe.sum(axis=tuple(range(1, pe.ndim)))
+        return summed_per_example(self.loss, labels, x, self.activation, mask)
 
 
 @register_layer

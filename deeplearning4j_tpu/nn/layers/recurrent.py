@@ -379,9 +379,8 @@ class RnnOutputLayer(Layer):
         """[mb] scores: per-timestep loss summed over the sequence
         (reference scoreExamples on RNN output layers)."""
         pre = self._pre(params, x)
-        pe = get_loss(self.loss).per_example(labels, pre,
-                                             self.activation or "identity", mask)
-        return pe.sum(axis=tuple(range(1, pe.ndim)))
+        from ...ops.losses import summed_per_example
+        return summed_per_example(self.loss, labels, pre, self.activation, mask)
 
 
 @register_layer

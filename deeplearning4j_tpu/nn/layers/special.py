@@ -86,8 +86,8 @@ class CenterLossOutputLayer(Dense):
         pre = x @ params["W"].astype(x.dtype)
         if self.has_bias:
             pre = pre + params["b"].astype(x.dtype)
-        pe = get_loss(self.loss).per_example(labels, pre,
-                                             self.activation or "identity", mask)
+        from ...ops.losses import summed_per_example
+        pe = summed_per_example(self.loss, labels, pre, self.activation, mask)
         centers = state["centers"].astype(x.dtype)
         assigned = labels @ centers
         return pe + 0.5 * self.lambda_ * jnp.sum((x - assigned) ** 2, axis=-1)

@@ -489,7 +489,13 @@ class MultiLayerNetwork:
             feat, _, _, _, _ = self._apply_layers(
                 params, state, x, train=False, rng=None, mask=None, upto=i)
             if i in self.conf.preprocessors:
-                feat = self.conf.preprocessors[i].apply(feat)
+                pre = self.conf.preprocessors[i]
+                if getattr(pre, "wants_rng", False):
+                    # stochastic preprocessors (BinomialSampling) must draw
+                    # FRESH noise per batch, as in the fit path
+                    feat = pre.apply(feat, rng=jax.random.fold_in(rng, 13))
+                else:
+                    feat = pre.apply(feat)
             if is_rbm:
                 g, loss = layer.cd_gradients(params[i], feat, rng)
             else:

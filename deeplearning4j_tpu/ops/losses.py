@@ -214,6 +214,16 @@ def loss_names() -> list[str]:
     return sorted(_REGISTRY)
 
 
+def summed_per_example(loss_name, labels, preout, activation="identity",
+                       mask=None) -> Array:
+    """[mb] per-example scores: elementwise loss summed over features AND
+    any trailing time axis — the single reference-scoreExamples reduction
+    the output layers' score_examples methods share."""
+    pe = get_loss(loss_name).per_example(labels, preout,
+                                         activation or "identity", mask)
+    return pe.sum(axis=tuple(range(1, pe.ndim)))
+
+
 # ---------------------------------------------------------------------------
 # fused sparse softmax cross-entropy (large-vocab LM loss)
 # ---------------------------------------------------------------------------

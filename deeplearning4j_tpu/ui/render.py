@@ -159,6 +159,11 @@ def render_embedding_html(coords, labels=None, words: Optional[Sequence[str]] = 
     if c.ndim != 2 or c.shape[1] != 2:
         raise ValueError(f"coords must be [N,2], got {c.shape}")
     n = len(c)
+    if words is not None and len(words) != n:
+        raise ValueError(f"{len(words)} words for {n} points")
+    if n == 0:
+        return ("<!doctype html><html><body style='font-family:system-ui'>"
+                f"<h2>{html.escape(title)}</h2><p>0 points</p></body></html>")
     x0, y0 = c.min(axis=0)
     x1, y1 = c.max(axis=0)
     xr = (x1 - x0) or 1.0

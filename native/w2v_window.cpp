@@ -36,6 +36,7 @@ extern "C" {
 int64_t dl4j_sg_windows(const int32_t* tokens, const int32_t* sids,
                         int64_t n, int32_t window, uint64_t seed,
                         int32_t* centers, int32_t* targets, int64_t* pos) {
+  if (window < 1) return 0;  // modulo-by-zero below would SIGFPE
   uint64_t state = seed;
   int64_t k = 0;
   for (int64_t i = 0; i < n; ++i) {
