@@ -307,7 +307,16 @@ class _LazyTable:
 
     def __set__(self, obj, value) -> None:
         setattr(obj, self._pending, None)
-        setattr(obj, self._host, None if value is None else np.asarray(value))
+        if value is None:
+            host = None
+        else:
+            # jax device arrays view as read-only numpy; the contract is a
+            # genuine MUTABLE host table, so copy when the view isn't
+            # writable (writable arrays pass through uncopied)
+            host = np.asarray(value)
+            if not host.flags.writeable:
+                host = np.array(host)
+        setattr(obj, self._host, host)
         if self._clears_norms:
             obj._norms = None
 
@@ -723,7 +732,16 @@ class SequenceVectors(WordVectorsBase):
 
         for epoch_i in range(self.epochs):
             if self.subsampling > 0:
-                keepm = rng.random(len(flat_tokens)) < keep_prob[flat_tokens]
+                # dedicated per-epoch stream (NOT the shared `rng`): the
+                # native window generator skips the numpy dynamic-window
+                # draws, so tying subsampling to `rng` would give epoch≥2
+                # different masks depending on whether g++ was available —
+                # an environment-dependent reproducibility gap.  Only the
+                # window-RNG stream itself may differ between the two
+                # paths (documented in _native_windows.py).
+                sub_rng = np.random.default_rng(np.random.SeedSequence(
+                    [self.seed, 77003, epoch_i]))
+                keepm = sub_rng.random(len(flat_tokens)) < keep_prob[flat_tokens]
                 toks = flat_tokens[keepm]
                 sids = flat_sids[keepm]
                 labs = flat_labs[keepm] if has_labels else None

@@ -796,9 +796,17 @@ class ComputationGraph:
                             f"output vertex '{out_name}' "
                             f"({type(layer).__name__}) has no score_examples()")
                     h = acts[spec.inputs[0]]
+                    # mirror MultiLayerNetwork.score_examples: with no
+                    # explicit label mask, rank-3 (RNN) labels fall back to
+                    # the forward-propagated feature mask of this output's
+                    # input — masked-sequence per-example scores must agree
+                    # between the two containers
+                    lmask = lmasks.get(out_name)
+                    y_out = labels[out_name]
+                    if lmask is None and getattr(y_out, "ndim", 0) == 3:
+                        lmask = mks.get(spec.inputs[0])
                     s = layer.score_examples(params[out_name], state[out_name],
-                                             h, labels[out_name],
-                                             mask=lmasks.get(out_name))
+                                             h, y_out, mask=lmask)
                     pe = s if pe is None else pe + s
                 reg = jnp.zeros((), pe.dtype)
                 for spec in self.conf.vertices:

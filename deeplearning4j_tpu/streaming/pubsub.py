@@ -23,6 +23,7 @@ Frame protocol (all little-endian):
 from __future__ import annotations
 
 import io
+import logging
 import queue
 import socket
 import struct
@@ -33,6 +34,8 @@ import numpy as np
 
 from ..datasets.dataset import DataSet
 from ..datasets.iterators import DataSetIterator
+
+logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<Q")
 _U32 = struct.Struct("<I")
@@ -191,6 +194,14 @@ class TensorBroker:
                     dead = [s for s in subs if not s.alive]
                     if dead:
                         self._subs[topic] = [s for s in subs if s.alive]
+                for d in dead:
+                    # visible trail for lossless-delivery debugging: frames
+                    # queued at subscriber death are discarded, and offer()
+                    # silently skips culled subscribers
+                    pending = d._q.qsize()
+                    logger.info(
+                        "pubsub: culled dead subscriber on topic %r "
+                        "(%d queued frame(s) discarded)", topic, pending)
                 for s in subs:
                     s.offer(frame)
         finally:

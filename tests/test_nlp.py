@@ -124,6 +124,22 @@ class TestWord2Vec:
         assert set(static.words_nearest("cat", 3)) == \
             set(w2v.words_nearest("cat", 3))
 
+    def test_assigned_device_array_stays_mutable(self):
+        """Assigning a read-only array (e.g. a jax device view) to
+        model.syn0 must materialize a MUTABLE host copy — the documented
+        lazy-table contract (round-4 advisor finding)."""
+        import jax.numpy as jnp
+        w2v = Word2Vec(layer_size=8, min_word_frequency=1, epochs=1, seed=0)
+        w2v.fit(["cat dog fish", "dog cat bird"])
+        dev = jnp.asarray(np.ones((len(w2v.vocab), 8), np.float32))
+        w2v.syn0 = dev
+        assert w2v.syn0.flags.writeable
+        w2v.syn0[0, 0] = 42.0  # must not raise
+        # a writable host array passes through uncopied (no perf tax)
+        host = np.zeros((len(w2v.vocab), 8), np.float32)
+        w2v.syn0 = host
+        assert w2v.syn0 is host
+
 
 class TestNativeWindowGenerator:
     """Round-4: the C++ skip-gram pair generator (native/w2v_window.cpp)

@@ -104,6 +104,40 @@ class TestScoreExamples:
         assert pe.shape == (16,)
         np.testing.assert_allclose(pe.mean(), g.score(ds), rtol=1e-5)
 
+    def test_graph_rnn_mask_fallback_matches_explicit(self):
+        """With rank-3 labels and ONLY a feature mask, the graph must fall
+        back to the forward-propagated mask — same as MultiLayerNetwork —
+        so masked-sequence per-example scores agree between containers
+        (round-4 advisor finding, nn/graph.py score_examples)."""
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(4, 6, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (4, 6))]
+        fmask = np.ones((4, 6), np.float32)
+        fmask[0, 3:] = 0.0
+        fmask[2, 5:] = 0.0
+        conf = (GraphBuilder().seed(3).updater(Adam(lr=1e-3))
+                .add_inputs("in")
+                .add_layer("lstm", LSTM(n_out=12), "in")
+                .add_layer("out", RnnOutputLayer(n_out=3, activation="softmax",
+                                                 loss="mcxent"), "lstm")
+                .set_outputs("out")
+                .set_input_types(**{"in": InputType.recurrent(8)})
+                .build())
+        g = ComputationGraph(conf)
+        g.init()
+        # fallback path: feature mask only
+        pe_fallback = g.score_examples(
+            DataSet(x, y, features_mask=fmask), add_regularization_terms=False)
+        # explicit path: the same mask passed as the labels mask
+        pe_explicit = g.score_examples(
+            DataSet(x, y, features_mask=fmask, labels_mask=fmask),
+            add_regularization_terms=False)
+        np.testing.assert_allclose(pe_fallback, pe_explicit, rtol=1e-5)
+        # and the mask is actually applied (masked steps excluded)
+        pe_unmasked = g.score_examples(DataSet(x, y),
+                                       add_regularization_terms=False)
+        assert not np.allclose(pe_fallback, pe_unmasked)
+
 
 class TestVaeReconstructionProbability:
     def _vae_net(self, reconstruction="bernoulli"):
