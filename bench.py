@@ -10,8 +10,15 @@ see _steady_state) and written to ``bench_results.json`` / echoed on
 stderr, including:
   - mfu: model FLOPs utilization from XLA's compiled cost analysis vs the
     chip's peak (TPU v5e bf16 ≈ 197 TFLOP/s)
-  - allreduce_gbps: per-step gradient bytes x step rate — the DP gradient
-    traffic the ICI must carry (BASELINE.md "gradient allreduce GB/s")
+  - matmul_ceiling_tfs / mfu_vs_ceiling: the chip's OWN sustained matmul
+    rate probed in-run, and MFU against it — self-calibrating across the
+    shared tunnel's ±40% tenancy swings (round-5)
+  - allreduce_traffic_gbps_est: per-step gradient bytes x step rate — the
+    DP gradient traffic the ICI must carry (an estimate; the MEASURED
+    psum/ppermute rates are bench_collective's psum_measured_gbps)
+  - delta_vs_prev: round-over-round delta against the latest BENCH_r*.json
+    artifact; any metric down >20% without a BENCH_NOTES.json explanation
+    is flagged on stderr and on the primary line (regression gate)
 
 BASELINE.md: the reference publishes NO numbers; the driver target is
 >=0.8x per-chip of H100+nd4j-cuda on ResNet-50 ≈ 2000 img/s.
@@ -40,6 +47,108 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+_REPO = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_prev_metrics():
+    """Per-metric values from the latest recorded round artifact
+    (BENCH_r*.json): the driver stores the full per-config report in the
+    artifact's stderr tail as '  <metric>: <value> <unit>' lines.  Returns
+    ({metric: value}, artifact_name) — ({}, None) when no artifact exists
+    (round 1)."""
+    import glob
+    import re
+
+    arts = sorted(glob.glob(os.path.join(_REPO, "BENCH_r*.json")))
+    if not arts:
+        return {}, None
+    with open(arts[-1]) as f:
+        art = json.load(f)
+    prev = {}
+    for m in re.finditer(r"^\s{2}(\w+): ([\d.]+) \S+", art.get("tail", ""),
+                         re.MULTILINE):
+        prev[m.group(1)] = float(m.group(2))
+    parsed = art.get("parsed") or {}
+    if parsed.get("metric") and parsed.get("value") is not None:
+        prev.setdefault(parsed["metric"], float(parsed["value"]))
+    return prev, os.path.basename(arts[-1])
+
+
+def _regression_gate(results, primary, platform):
+    """Round-over-round regression gate (round-4 verdict Next #1): every
+    metric carries delta_vs_prev; any drop >20% must be explained by an
+    entry in BENCH_NOTES.json ({metric: note}) or it is flagged LOUDLY on
+    stderr and recorded on the primary stdout line.  Only full TPU runs
+    are gated — the recorded artifacts are full TPU runs, and comparing a
+    CPU/QUICK smoke run against them would flag nothing but the platform."""
+    if QUICK or platform != "tpu":
+        return
+    prev, art = _load_prev_metrics()
+    if not prev:
+        return
+    notes = {}
+    notes_path = os.path.join(_REPO, "BENCH_NOTES.json")
+    if os.path.exists(notes_path):
+        with open(notes_path) as f:
+            notes = json.load(f)
+    unexplained = []
+    for r in results:
+        v, p = r.get("value"), prev.get(r.get("metric", ""))
+        if v is None or not p:
+            continue
+        delta = v / p - 1.0
+        r["delta_vs_prev"] = round(delta, 4)
+        if delta < -0.20:
+            note = notes.get(r["metric"])
+            if note:
+                r["regression_note"] = note
+                log(f"  REGRESSION {r['metric']}: {p} -> {v} "
+                    f"({delta:+.1%} vs {art}) — noted: {note}")
+            else:
+                unexplained.append(r["metric"])
+                log(f"  REGRESSION {r['metric']}: {p} -> {v} "
+                    f"({delta:+.1%} vs {art}) — UNEXPLAINED: add a "
+                    f"measured explanation to BENCH_NOTES.json")
+    primary["vs_prev_round"] = art
+    if unexplained:
+        primary["unexplained_regressions"] = unexplained
+
+
+def probe_matmul_ceiling(chain: int = 24, n: int = 8192) -> float:
+    """The chip's OWN sustained bf16 matmul rate right now, TF/s (best of
+    3 chained-matmul windows).  The spec sheet says 197 TF/s; this shared
+    tunnelled chip sustains 70-130 depending on tenancy (round-4 judge
+    probes), so each bench run self-calibrates: mfu_vs_ceiling = achieved
+    FLOPs / THIS number — stable across tenancy swings, unlike spec-MFU."""
+    import jax
+    import jax.numpy as jnp
+
+    if QUICK:
+        chain, n = 4, 2048
+
+    key = jax.random.PRNGKey(0)
+    w = jax.random.normal(key, (n, n), jnp.bfloat16) * (1.0 / np.sqrt(n))
+
+    @jax.jit
+    def chained(x):
+        def body(y, _):
+            # astype: some backends emit f32 from bf16 matmuls; the carry
+            # must keep its dtype for scan
+            return (y @ w).astype(y.dtype), None
+        y, _ = jax.lax.scan(body, x, None, length=chain)
+        return y
+
+    x = jax.random.normal(key, (n, n), jnp.bfloat16)
+    chained(x)  # compile
+    _sync(chained(x))
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        _sync(chained(x))
+        best = min(best, time.perf_counter() - t0)
+    return 2.0 * n * n * n * chain / best / 1e12
+
+
 def _sync(state) -> None:
     """Force completion via a scalar VALUE readback.  On the axon remote-TPU
     platform jax.block_until_ready returns before execution finishes (it
@@ -52,20 +161,23 @@ def _sync(state) -> None:
     float(jnp.sum(leaf))
 
 
-def _steady_state(step_fn, state, steps=STEPS, warmup=WARMUP):
+def _steady_state(step_fn, state, steps=STEPS, warmup=WARMUP, windows=3):
     """Post-compile steady-state timing: returns (state, sec_per_step).
 
-    Takes the BEST of 3 equal sub-windows (full runs only; QUICK keeps a
-    single window — 5//3-step windows would just measure the sync RTT):
-    this chip is reached through a shared tunnel whose latency spikes can
-    triple the apparent time of sub-millisecond steps (observed: the same
-    MLP config measuring 80K and 249K img/s minutes apart while ResNet-50
-    stayed within 1%) — the fastest clean window is the honest
-    steady-state figure."""
+    Takes the BEST of `windows` equal sub-windows (full runs only; QUICK
+    keeps a single window — 5//3-step windows would just measure the sync
+    RTT): this chip is reached through a shared tunnel whose latency
+    spikes can triple the apparent time of sub-millisecond steps
+    (observed: the same MLP config measuring 80K and 249K img/s minutes
+    apart while ResNet-50 stayed within 1%) — the fastest clean window is
+    the honest steady-state figure.  Sub-10ms-step configs pass
+    windows=5: the round-5 A/B measured 2.3× within-arm spread on them
+    (docs/ROUND5_NOTES.md), so more windows = better odds of one clean
+    one."""
     for i in range(warmup):
         state = step_fn(state, i)
     _sync(state)
-    windows = 1 if QUICK else 3
+    windows = 1 if QUICK else windows
     per = max(1, steps // windows)
     best = float("inf")
     i = warmup
@@ -162,7 +274,7 @@ def bench_mlp_mnist():
     x = jnp.asarray(rng.normal(size=(batch, 784)).astype(np.float32))
     y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)])
     step, state = _net_step(net, x, y)
-    _, sec = _steady_state(step, state)
+    _, sec = _steady_state(step, state, windows=5)
     return {"metric": "mlp_mnist_images_per_sec", "value": round(batch / sec, 2),
             "unit": "images/sec"}
 
@@ -181,7 +293,7 @@ def bench_lenet_cifar():
     x = jnp.asarray(rng.normal(size=(batch, 32, 32, 3)).astype(np.float32))
     y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)])
     step, state = _net_step(net, x, y)
-    _, sec = _steady_state(step, state)
+    _, sec = _steady_state(step, state, windows=5)
     return {"metric": "lenet_cifar10_images_per_sec",
             "value": round(batch / sec, 2), "unit": "images/sec"}
 
@@ -211,9 +323,17 @@ def bench_resnet50(platform: str):
     flops = _flops_per_step(net, x, y)
     if flops and platform == "tpu":
         out["mfu"] = round(flops / sec / TPU_V5E_PEAK_FLOPS, 4)
+        # self-calibrating MFU (round-4 verdict Next #3): the ceiling is
+        # probed IN this run, so the figure is comparable across tenancy
+        ceiling = probe_matmul_ceiling()
+        out["matmul_ceiling_tfs"] = round(ceiling, 1)
+        out["mfu_vs_ceiling"] = round(flops / sec / (ceiling * 1e12), 4)
     # DP gradient traffic this step rate would put on the ICI (ring
-    # allreduce moves ~2x param bytes per step per chip)
-    out["allreduce_gbps"] = round(2 * _param_bytes(net) / sec / 1e9, 3)
+    # allreduce moves ~2x param bytes per step per chip) — an ESTIMATE
+    # derived from step rate, not a measured collective (see
+    # bench_collective for the measured rate)
+    out["allreduce_traffic_gbps_est"] = round(
+        2 * _param_bytes(net) / sec / 1e9, 3)
     return out
 
 
@@ -273,7 +393,8 @@ def bench_word2vec_lstm():
     def rnn_step(_, i):
         net.fit_batch(dss[i % len(dss)])
         return net.params
-    _, sec = _steady_state(rnn_step, net.params, steps=(5 if QUICK else 100))
+    _, sec = _steady_state(rnn_step, net.params, steps=(5 if QUICK else 100),
+                           windows=5)
     return [
         {"metric": "word2vec_words_per_sec", "value": round(w2v_rate, 1),
          "unit": "words/sec"},
@@ -324,7 +445,69 @@ def bench_sharded_resnet(platform: str):
     return {"metric": "sharded_resnet50_images_per_sec",
             "value": round(batch / sec, 2), "unit": "images/sec",
             "n_devices": n_dev,
-            "allreduce_gbps": round(grad_bytes / sec / 1e9, 3)}
+            "allreduce_traffic_gbps_est": round(grad_bytes / sec / 1e9, 3)}
+
+
+def bench_collective():
+    """Config 8: MEASURED collective rates (round-4 verdict Next #7 — the
+    derived allreduce_traffic_gbps_est is a traffic estimate, this is the
+    measured thing).  psum of a ResNet-50-sized gradient pytree over the
+    local mesh's data axis, plus a ppermute ring pass of the same bytes.
+    On the 1-chip bench box the psum degenerates to identity and the
+    ppermute to a device-local copy — so the reported rate is the chip's
+    collective-dispatch + HBM floor, labeled with n_devices so nobody
+    reads it as a multi-chip ICI figure; on a real slice the same code
+    measures the ICI.  Shape-correctness on ≥2 devices is covered on the
+    virtual 8-CPU mesh (tests/test_parallel.py)."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from deeplearning4j_tpu.parallel import build_mesh
+
+    n_dev = len(jax.devices())
+    mesh = build_mesh({"data": n_dev})
+    # ResNet-50-sized gradient pytree: 25.6M f32 params ≈ 102 MB, split
+    # into realistic per-layer leaves (conv1, fc, 3x3 bottleneck convs)
+    sizes = [7 * 7 * 3 * 64, 2048 * 1000, 2048]
+    while sum(sizes) + 512 * 512 * 9 <= 25_600_000:
+        sizes.append(512 * 512 * 9)
+    sizes.append(25_600_000 - sum(sizes))
+    key = jax.random.PRNGKey(0)
+    tree = [jax.random.normal(key, (s,), jnp.float32) for s in sizes]
+    nbytes = sum(4 * s for s in sizes)
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(P(),),
+                       out_specs=P(), check_vma=False)
+    def allreduce(t):
+        return [jax.lax.psum(a, "data") for a in t]
+
+    @functools.partial(jax.shard_map, mesh=mesh, in_specs=(P(),),
+                       out_specs=P(), check_vma=False)
+    def ring_pass(t):
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        return [jax.lax.ppermute(a, "data", perm) for a in t]
+
+    def timeit(f, n=3 if QUICK else 10):
+        jf = jax.jit(f)
+        _sync(jf(tree))
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            r = None
+            for _ in range(n):
+                r = jf(tree)
+            _sync(r)
+            best = min(best, (time.perf_counter() - t0) / n)
+        return best
+
+    sec_psum, sec_perm = timeit(allreduce), timeit(ring_pass)
+    return {"metric": "psum_measured_gbps",
+            "value": round(nbytes / sec_psum / 1e9, 2), "unit": "GB/s",
+            "n_devices": n_dev, "payload_mb": round(nbytes / 1e6, 1),
+            "ppermute_measured_gbps": round(nbytes / sec_perm / 1e9, 2)}
 
 
 def bench_flash_attention(platform: str):
@@ -439,6 +622,13 @@ def bench_transformer_lm(platform: str):
     flops_model = 6 * n_matmul * tokens + 12 * L * (B * n_dev) * T * T * D
     if platform == "tpu":
         out["mfu_model_flops"] = round(flops_model / sec / TPU_V5E_PEAK_FLOPS, 4)
+        # probed again here (not reused from the resnet config): the two
+        # configs run minutes apart and the tunnel's tenancy drifts on
+        # that scale — each MFU must calibrate against ITS OWN window
+        ceiling = probe_matmul_ceiling()
+        out["matmul_ceiling_tfs"] = round(ceiling, 1)
+        out["mfu_model_vs_ceiling"] = round(
+            flops_model / sec / (ceiling * 1e12), 4)
         try:
             args = (lm.params, lm.opt_state, jnp.asarray(0, jnp.int32),
                     toks, tgts)
@@ -450,6 +640,8 @@ def bench_transformer_lm(platform: str):
             xla_flops = float(ca.get("flops", 0.0))
             if xla_flops:
                 out["mfu"] = round(xla_flops / sec / TPU_V5E_PEAK_FLOPS, 4)
+                out["mfu_vs_ceiling"] = round(
+                    xla_flops / sec / (ceiling * 1e12), 4)
         except Exception:
             pass
     return out
@@ -469,7 +661,8 @@ def main() -> None:
                      ("word2vec_lstm", bench_word2vec_lstm),
                      ("sharded_resnet50", lambda: bench_sharded_resnet(platform)),
                      ("flash_attention", lambda: bench_flash_attention(platform)),
-                     ("transformer_lm", lambda: bench_transformer_lm(platform))]:
+                     ("transformer_lm", lambda: bench_transformer_lm(platform)),
+                     ("collective", bench_collective)]:
         try:
             t0 = time.perf_counter()
             out = fn()
@@ -483,13 +676,13 @@ def main() -> None:
         except Exception as e:  # one config failing must not kill the others
             log(f"  {name} FAILED: {type(e).__name__}: {e}")
             results.append({"metric": name, "error": f"{type(e).__name__}: {e}"})
-    with open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                           "bench_results.json"), "w") as f:
-        json.dump({"platform": platform, "quick": QUICK,
-                   "results": results}, f, indent=2)
     if primary is None:  # driver contract: exactly one stdout JSON line
         primary = {"metric": "resnet50_train_images_per_sec_per_chip",
                    "value": 0.0, "unit": "images/sec", "vs_baseline": 0.0}
+    _regression_gate(results, primary, platform)
+    with open(os.path.join(_REPO, "bench_results.json"), "w") as f:
+        json.dump({"platform": platform, "quick": QUICK,
+                   "results": results}, f, indent=2)
     print(json.dumps(primary))
 
 

@@ -1,0 +1,56 @@
+"""Dump compiled-step diagnostics for the LeNet bench config: cost
+analysis (flops/bytes), memory analysis, and an HLO op histogram.
+Usage: python hlo_probe.py <tree> <tag>
+"""
+import collections
+import json
+import re
+import sys
+
+tree, tag = sys.argv[1], sys.argv[2]
+sys.path.insert(0, tree)
+
+import numpy as np
+import jax.numpy as jnp
+import jax.random as jrandom
+
+from deeplearning4j_tpu.models import LeNet
+from deeplearning4j_tpu.nn.updaters import Nesterovs
+
+batch = 256
+net = LeNet(height=32, width=32, channels=3, num_classes=10,
+            updater=Nesterovs(lr=0.01, momentum=0.9))
+rng = np.random.default_rng(0)
+x = jnp.asarray(rng.normal(size=(batch, 32, 32, 3)).astype(np.float32))
+y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)])
+if net._jit_step is None:
+    net._jit_step = net._make_step()
+args = (net.params, net.state, net.opt_state, jnp.asarray(0, jnp.int32),
+        x, y, jrandom.PRNGKey(0), None, None)
+lowered = net._jit_step.lower(*args)
+compiled = lowered.compile()
+out = {"tag": tag}
+try:
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0]
+    out["flops"] = ca.get("flops")
+    out["bytes"] = ca.get("bytes accessed")
+except Exception as e:
+    out["cost_err"] = str(e)
+try:
+    ma = compiled.memory_analysis()
+    out["temp_mb"] = round(ma.temp_size_in_bytes / 1e6, 2)
+    out["output_mb"] = round(ma.output_size_in_bytes / 1e6, 2)
+except Exception as e:
+    out["mem_err"] = str(e)
+hlo = compiled.as_text()
+ops = collections.Counter(re.findall(r"= \w+\[?[^ ]* (\w+)\(", hlo))
+out["n_hlo_lines"] = hlo.count("\n")
+out["fusions"] = ops.get("fusion", 0)
+out["convs"] = ops.get("convolution", 0)
+out["copies"] = ops.get("copy", 0) + ops.get("copy-start", 0)
+out["top_ops"] = dict(ops.most_common(12))
+print(json.dumps(out))
+with open(f"/tmp/ab_hlo_{tag}.txt", "w") as f:
+    f.write(hlo)

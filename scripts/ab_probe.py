@@ -1,0 +1,115 @@
+"""Tenancy-controlled A/B probe: one bench config, one source tree, on the
+real chip.  Usage: python probe.py <tree_path> <config> [tag]
+
+Timing protocol is IDENTICAL for every arm (best-of-3 33-step windows,
+value-readback sync — bench.py's round-3+ protocol) and lives HERE, so the
+r2/r3 trees are measured with the same method as HEAD; only the library
+code differs.  Prints one JSON line.
+"""
+import json
+import sys
+import time
+
+tree, config = sys.argv[1], sys.argv[2]
+tag = sys.argv[3] if len(sys.argv) > 3 else tree
+sys.path.insert(0, tree)
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import jax.random as jrandom
+
+WARMUP, WINDOWS, PER = 10, 3, 33
+
+
+def sync(state):
+    leaf = jax.tree_util.tree_leaves(state)[0]
+    float(jnp.sum(leaf))
+
+
+def steady(step_fn, state):
+    for i in range(WARMUP):
+        state = step_fn(state, i)
+    sync(state)
+    best = float("inf")
+    i = WARMUP
+    for _ in range(WINDOWS):
+        t0 = time.perf_counter()
+        for _ in range(PER):
+            state = step_fn(state, i)
+            i += 1
+        sync(state)
+        best = min(best, (time.perf_counter() - t0) / PER)
+    return best
+
+
+def net_step(net, x, y):
+    if net._jit_step is None:
+        net._jit_step = net._make_step()
+
+    def step(state, i):
+        params, st, opt = state
+        params, st, opt, loss = net._jit_step(
+            params, st, opt, jnp.asarray(i, jnp.int32), x, y,
+            jrandom.PRNGKey(i), None, None)
+        return (params, st, opt)
+
+    return step, (net.params, net.state, net.opt_state)
+
+
+rng = np.random.default_rng(0)
+
+if config == "lenet":
+    from deeplearning4j_tpu.models import LeNet
+    from deeplearning4j_tpu.nn.updaters import Nesterovs
+    batch = 256
+    net = LeNet(height=32, width=32, channels=3, num_classes=10,
+                updater=Nesterovs(lr=0.01, momentum=0.9))
+    x = jnp.asarray(rng.normal(size=(batch, 32, 32, 3)).astype(np.float32))
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)])
+    step, state = net_step(net, x, y)
+    sec = steady(step, state)
+    out = {"config": "lenet", "images_per_sec": round(batch / sec, 1)}
+elif config == "mlp":
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import (
+        MultiLayerNetwork, NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.updaters import Nesterovs
+    batch = 512
+    conf = (NeuralNetConfiguration.builder()
+            .updater(Nesterovs(lr=0.1, momentum=0.9))
+            .layer(Dense(n_out=512, activation="relu"))
+            .layer(Dense(n_out=256, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(784)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    x = jnp.asarray(rng.normal(size=(batch, 784)).astype(np.float32))
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)])
+    step, state = net_step(net, x, y)
+    sec = steady(step, state)
+    out = {"config": "mlp", "images_per_sec": round(batch / sec, 1)}
+elif config == "charrnn":
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.models import TextGenerationLSTM
+    from deeplearning4j_tpu.nn.updaters import Adam
+    batch, T, vocab_sz = 64, 100, 96
+    net = TextGenerationLSTM(vocab_size=vocab_sz, updater=Adam(lr=1e-3))
+    dss = [DataSet(rng.integers(0, vocab_sz, (batch, T)).astype(np.int32),
+                   rng.integers(0, vocab_sz, (batch, T)).astype(np.int32))
+           for _ in range(20)]
+
+    def rnn_step(_, i):
+        net.fit_batch(dss[i % len(dss)])
+        return net.params
+
+    sec = steady(rnn_step, net.params)
+    out = {"config": "charrnn", "chars_per_sec": round(batch * T / sec, 1)}
+else:
+    raise SystemExit(f"unknown config {config}")
+
+out["tag"] = tag
+out["platform"] = jax.devices()[0].platform
+out["t"] = round(time.time(), 1)
+print(json.dumps(out), flush=True)

@@ -1,0 +1,98 @@
+"""Bench-harness machinery added for round 5: the regression gate, the
+matmul ceiling probe, and the measured collective microbench.
+
+These test the MECHANISM on CPU (the numbers themselves are produced on
+the chip by the driver run); the gate must parse real recorded artifacts,
+attach per-metric deltas, and demand notes for >20% drops.
+"""
+
+import importlib.util
+import json
+import os
+
+import jax
+import numpy as np
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def bench():
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(_REPO, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestRegressionGate:
+    def test_parses_latest_artifact(self, bench):
+        prev, art = bench._load_prev_metrics()
+        assert art is not None and art.startswith("BENCH_r")
+        # every per-config line of the recorded tail must be recovered
+        assert "resnet50_train_images_per_sec_per_chip" in prev
+        assert prev["resnet50_train_images_per_sec_per_chip"] > 0
+
+    def test_deltas_and_unexplained_flagging(self, bench, monkeypatch):
+        monkeypatch.setattr(bench, "QUICK", False)
+        monkeypatch.setattr(bench, "_load_prev_metrics",
+                            lambda: ({"m_ok": 100.0, "m_drop": 100.0}, "BENCH_rX.json"))
+        results = [{"metric": "m_ok", "value": 95.0},
+                   {"metric": "m_drop", "value": 50.0},
+                   {"metric": "m_new", "value": 1.0}]
+        primary = {"metric": "m_ok", "value": 95.0}
+        bench._regression_gate(results, primary, "tpu")
+        assert results[0]["delta_vs_prev"] == pytest.approx(-0.05)
+        assert results[1]["delta_vs_prev"] == pytest.approx(-0.5)
+        assert "delta_vs_prev" not in results[2]  # no prior → no delta
+        assert primary["unexplained_regressions"] == ["m_drop"]
+
+    def test_note_satisfies_gate(self, bench, monkeypatch, tmp_path):
+        monkeypatch.setattr(bench, "QUICK", False)
+        monkeypatch.setattr(bench, "_load_prev_metrics",
+                            lambda: ({"m_drop": 100.0}, "BENCH_rX.json"))
+        notes = tmp_path / "BENCH_NOTES.json"
+        notes.write_text(json.dumps({"m_drop": "tenancy A/B, see notes"}))
+        monkeypatch.setattr(bench, "_REPO", str(tmp_path))
+        results = [{"metric": "m_drop", "value": 50.0}]
+        primary = {}
+        bench._regression_gate(results, primary, "tpu")
+        assert results[0]["regression_note"] == "tenancy A/B, see notes"
+        assert "unexplained_regressions" not in primary
+
+    def test_gate_skips_non_tpu_and_quick(self, bench, monkeypatch):
+        results = [{"metric": "m", "value": 1.0}]
+        primary = {}
+        bench._regression_gate(results, primary, "cpu")
+        monkeypatch.setattr(bench, "QUICK", True)
+        bench._regression_gate(results, primary, "tpu")
+        assert "delta_vs_prev" not in results[0]
+        assert "vs_prev_round" not in primary
+
+    def test_repo_notes_file_is_valid_json_if_present(self):
+        p = os.path.join(_REPO, "BENCH_NOTES.json")
+        if os.path.exists(p):
+            with open(p) as f:
+                notes = json.load(f)
+            assert isinstance(notes, dict)
+            assert all(isinstance(v, str) and v for v in notes.values())
+
+
+class TestCeilingProbe:
+    def test_probe_returns_positive_tfs(self, bench, monkeypatch):
+        monkeypatch.setattr(bench, "QUICK", True)  # tiny shapes on CPU
+        tfs = bench.probe_matmul_ceiling()
+        assert tfs > 0
+
+
+class TestCollectiveMicrobench:
+    def test_multi_device_psum_shapes_and_rate(self, bench):
+        # conftest pins 8 virtual CPU devices: the SAME code the chip
+        # bench runs must produce correct collective results at n>1
+        assert len(jax.devices()) >= 2
+        out = bench.bench_collective()
+        assert out["metric"] == "psum_measured_gbps"
+        assert out["value"] > 0 and out["ppermute_measured_gbps"] > 0
+        assert out["n_devices"] == len(jax.devices())
+        assert out["payload_mb"] == pytest.approx(102.4, rel=0.01)
