@@ -129,11 +129,22 @@ class CJKTokenizerFactory:
       - "char": greedy longest-match; unmatched spans one char per token
     Non-CJK spans (latin words, digits) tokenize by whitespace with the
     preprocessor applied, so mixed-script corpora work end-to-end.
+
+    Dictionary entries may carry a POS tag — value ``(frequency, tag)``
+    instead of a bare frequency — and ``tokenize_with_tags`` /
+    ``tag`` expose them per token (the kuromoji lexicon's POS column,
+    reference deeplearning4j-nlp-japanese).  The factory then plugs into
+    ``PosFilterTokenizerFactory`` as BOTH base and tagger for
+    POS-filtered CJK vectorization.
     """
 
     #: fallback unigram cost — higher than any realistic dictionary word
     #: (-log f with f normalized over the dictionary stays below ~20)
     _FALLBACK_COST = 25.0
+
+    #: tag emitted for tokens with no dictionary POS (fallback chars,
+    #: bigrams, unknown words) — kuromoji's unknown-word analog
+    UNKNOWN_TAG = "X"
 
     def __init__(self, user_dictionary=None,
                  mode: str = "bigram", preprocessor=None):
@@ -142,14 +153,31 @@ class CJKTokenizerFactory:
                 f"mode must be 'bigram', 'char' or 'lattice', got {mode!r}")
         self.mode = mode
         self.preprocessor = preprocessor or CommonPreprocessor()
+        # dictionary values: frequency, OR (frequency, pos_tag) — the
+        # morphological surface the reference's kuromoji dictionaries
+        # carry (deeplearning4j-nlp-japanese vendored lexicon rows hold
+        # POS/base-form columns next to the cost); tags are opaque strings
+        # (名詞/助詞 for a Japanese lexicon, NN/JJ for an English one)
+        self._pos: Dict[str, str] = {}
         if isinstance(user_dictionary, dict):
-            if any(c <= 0 for c in user_dictionary.values()):
+            freqs = {}
+            for w, v in user_dictionary.items():
+                if isinstance(v, (tuple, list)):
+                    if len(v) != 2:
+                        raise ValueError(
+                            f"dictionary entry {w!r}: expected frequency or "
+                            f"(frequency, pos_tag), got {v!r}")
+                    freqs[w] = v[0]
+                    self._pos[w] = str(v[1])
+                else:
+                    freqs[w] = v
+            if any(c <= 0 for c in freqs.values()):
                 raise ValueError("user_dictionary frequencies must be > 0")
-            total = float(sum(user_dictionary.values()))
+            total = float(sum(freqs.values()))
             # works for raw counts AND probability-valued frequencies —
             # only the ratios matter to the Viterbi comparison
             self._costs = {w: -math.log(c / total)
-                           for w, c in user_dictionary.items()}
+                           for w, c in freqs.items()}
         else:
             # uniform frequencies; mild length bonus keeps longest-match
             # behavior for non-overlapping text
@@ -157,6 +185,7 @@ class CJKTokenizerFactory:
                            for w in (user_dictionary or ())}
         self.dictionary = set(self._costs)
         self._max_word = max((len(w) for w in self.dictionary), default=0)
+        self._latin_tagger = None  # lazy RuleBasedPosTagger for mixed text
 
     def _segment_lattice(self, run: str) -> List[str]:
         """Min-cost Viterbi path through the word lattice."""
@@ -245,6 +274,33 @@ class CJKTokenizerFactory:
                 i += 1
         flush_non_cjk()
         return tokens
+
+    def tag(self, tokens: Sequence[str]) -> List[str]:
+        """POS tags for already-segmented tokens: dictionary entries carry
+        their lexicon tag (kuromoji's per-token POS surface), unknown CJK
+        tokens get UNKNOWN_TAG, and latin tokens in mixed-script text fall
+        through to the rule-based English tagger.  This signature makes
+        the factory directly usable as PosFilterTokenizerFactory's
+        ``tagger`` (with itself as ``base``)."""
+        out = []
+        for t in tokens:
+            tag = self._pos.get(t)
+            if tag is not None:
+                out.append(tag)
+            elif t and _is_cjk(t[0]):
+                out.append(self.UNKNOWN_TAG)
+            else:
+                if self._latin_tagger is None:
+                    self._latin_tagger = RuleBasedPosTagger()
+                out.append(self._latin_tagger.tag([t])[0])
+        return out
+
+    def tokenize_with_tags(self, sentence: str) -> List[tuple]:
+        """(token, pos_tag) pairs — the lattice/segmenter output annotated
+        with the dictionary's POS column (reference kuromoji
+        Token.getPartOfSpeechLevel1)."""
+        toks = self.tokenize(sentence)
+        return list(zip(toks, self.tag(toks)))
 
 
 # ---------------------------------------------------------------------------

@@ -232,6 +232,25 @@ class MultiLayerNetwork:
     def num_params(self) -> int:
         return sum(int(np.prod(x.shape)) for p in self.params for x in jax.tree_util.tree_leaves(p))
 
+    def summary(self) -> str:
+        """Layer table: name, output shape, param count (reference
+        MultiLayerNetwork.summary():3702)."""
+        if not self.params:
+            raise ValueError("call init() before summary()")
+        rows = [("idx", "layer", "out", "params")]
+        for i, (layer, p) in enumerate(zip(self.conf.layers, self.params)):
+            n = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(p))
+            # the layer's OWN output type — input_types[i+1] would show the
+            # next layer's post-preprocessor input instead (e.g. a conv
+            # layer reporting the flattened CnnToFeedForward shape)
+            out = layer.output_type(self.input_types[i])
+            rows.append((str(i), type(layer).__name__, str(out), f"{n:,}"))
+        widths = [max(len(r[c]) for r in rows) for c in range(4)]
+        lines = ["  ".join(v.ljust(w) for v, w in zip(r, widths)) for r in rows]
+        lines.insert(1, "-" * len(lines[0]))
+        lines.append(f"total params: {self.num_params():,}")
+        return "\n".join(lines)
+
     # ------------------------------------------------------------------
     # pure forward / loss
     # ------------------------------------------------------------------

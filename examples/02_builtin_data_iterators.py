@@ -1,0 +1,48 @@
+"""Tutorial 2 — Built-in data iterators + normalizers.
+
+Mirrors the reference's ``02. Built-in Data Iterators``: the canonical
+dataset iterators (MNIST here), mask-aware normalizers, and the async
+prefetch wrapper.  In a zero-egress environment the fetchers fall back to
+deterministic class-dependent surrogates with the real shapes/classes —
+drop the canonical files under ``$DL4J_TPU_DATA`` to train on real data.
+"""
+from _common import banner  # noqa: F401
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets import (
+    AsyncDataSetIterator, NormalizerStandardize,
+)
+from deeplearning4j_tpu.datasets.fetchers import (
+    IrisDataSetIterator, MnistDataSetIterator,
+)
+
+banner("MNIST iterator")
+it = MnistDataSetIterator(batch_size=128, train=True)
+first = next(iter(it))
+print(f"features {first.features.shape}, labels {first.labels.shape}")
+assert first.features.shape == (128, 28, 28, 1)
+assert first.labels.shape == (128, 10)
+
+banner("NormalizerStandardize (fit on the iterator, then preprocess)")
+norm = NormalizerStandardize()
+norm.fit(it)
+it.reset()
+it.set_pre_processor(norm)
+batch = next(iter(it))
+flat = np.asarray(batch.features).reshape(len(batch.features), -1)
+print(f"after standardize: mean {flat.mean():+.3f}, std {flat.std():.3f}")
+assert abs(flat.mean()) < 0.15
+
+banner("Async prefetch wrapper")
+it.reset()
+async_it = AsyncDataSetIterator(it, prefetch=4)
+n = sum(1 for _ in async_it)
+print(f"prefetched {n} batches in the background")
+assert n > 0
+
+banner("Iris (embedded, 150 rows)")
+iris = next(iter(IrisDataSetIterator()))
+print(f"iris {iris.features.shape} -> {iris.labels.shape}")
+assert iris.features.shape == (150, 4)
+print("OK")

@@ -1,0 +1,58 @@
+"""Tutorial 5 — Autoencoder anomaly detection using reconstruction error.
+
+Mirrors the reference's ``05. Basic Autoencoder — Anomaly Detection Using
+Reconstruction Error``: train a bottleneck autoencoder on "normal" data
+only, then score everything by per-example reconstruction error — the
+anomalies reconstruct poorly and rank at the top.
+
+The per-example score comes from ``score_examples`` (reference
+``MultiLayerNetwork.scoreExamples``) — unreduced loss per row, one jitted
+program.
+"""
+from _common import banner  # noqa: F401
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import (
+    MultiLayerNetwork, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.updaters import Adam
+
+rng = np.random.default_rng(0)
+D = 16
+# "normal" data lives on a 3-D linear manifold in 16-D space
+basis = rng.normal(size=(3, D)).astype(np.float32)
+normal = (rng.normal(size=(1024, 3)).astype(np.float32) @ basis)
+anomalies = rng.normal(size=(32, D)).astype(np.float32) * 3.0
+
+banner("Train a 16->8->3->8->16 autoencoder on normal data only")
+conf = (NeuralNetConfiguration.builder()
+        .seed(42)
+        .updater(Adam(lr=1e-2))
+        .layer(Dense(n_out=8, activation="tanh"))
+        .layer(Dense(n_out=3, activation="identity"))   # bottleneck
+        .layer(Dense(n_out=8, activation="tanh"))
+        .layer(OutputLayer(n_out=D, activation="identity", loss="mse"))
+        .set_input_type(InputType.feed_forward(D))
+        .build())
+net = MultiLayerNetwork(conf)
+net.init()
+train = DataSet(normal, normal)  # reconstruction target = input
+for i in range(300):
+    loss = float(net.fit_batch(train))
+print(f"final reconstruction loss: {loss:.4f}")
+
+banner("Rank everything by per-example reconstruction error")
+mixed = np.concatenate([normal[:96], anomalies])
+scores = net.score_examples(DataSet(mixed, mixed),
+                            add_regularization_terms=False)
+order = np.argsort(scores)[::-1]  # worst reconstruction first
+top = set(order[:32].tolist())
+true_anoms = set(range(96, 128))
+hits = len(top & true_anoms)
+print(f"top-32 worst reconstructions contain {hits}/32 true anomalies")
+assert hits >= 30
+print("OK")

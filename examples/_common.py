@@ -1,0 +1,22 @@
+"""Shared example bootstrap.
+
+Every example is runnable standalone (``python examples/01_....py``) on
+whatever accelerator JAX finds; CI runs them on CPU by setting
+``DL4J_TPU_EXAMPLES_CPU=1`` (the in-script config update is needed because
+the axon TPU plugin ignores the JAX_PLATFORMS env var).
+"""
+
+import os
+import sys
+
+if os.environ.get("DL4J_TPU_EXAMPLES_CPU") == "1":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+# make `python examples/xx.py` work from a source checkout
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def banner(title: str) -> None:
+    print(f"\n=== {title} ===")

@@ -232,3 +232,76 @@ class TestSentenceSegmentation:
         w2v = Word2Vec(layer_size=8, min_word_frequency=1, epochs=1)
         w2v.fit(sents)
         assert "dog" in w2v.vocab and "cat" in w2v.vocab
+
+
+class TestCJKPosThroughLattice:
+    """Round-5: dictionary entries carry a POS tag; the lattice emits
+    (token, tag) pairs; PosFilterTokenizerFactory composes with the CJK
+    factory as base AND tagger (reference kuromoji per-token POS,
+    deeplearning4j-nlp-japanese)."""
+
+    DICT = {"研究": (100, "名詞"), "生命": (80, "名詞"), "する": (200, "動詞"),
+            "を": (500, "助詞"), "猫": (50, "名詞"), "犬": (50, "名詞"),
+            "食べる": (40, "動詞"), "の": (600, "助詞")}
+
+    def _factory(self):
+        from deeplearning4j_tpu.nlp.tokenization import CJKTokenizerFactory
+        return CJKTokenizerFactory(user_dictionary=self.DICT, mode="lattice")
+
+    def test_lattice_emits_token_tag_pairs(self):
+        f = self._factory()
+        got = f.tokenize_with_tags("研究を生命する")
+        assert got == [("研究", "名詞"), ("を", "助詞"), ("生命", "名詞"),
+                       ("する", "動詞")]
+
+    def test_unknown_cjk_and_latin_tokens(self):
+        from deeplearning4j_tpu.nlp.tokenization import (
+            CasePreservingPreprocessor, CJKTokenizerFactory,
+        )
+        f = CJKTokenizerFactory(user_dictionary=self.DICT, mode="lattice",
+                                preprocessor=CasePreservingPreprocessor())
+        got = dict(f.tokenize_with_tags("猫が JAX"))
+        assert got["猫"] == "名詞"
+        assert got["が"] == f.UNKNOWN_TAG   # not in the dictionary
+        assert got["JAX"] == "NNP"          # latin falls through to rules
+
+    def test_plain_frequencies_still_work(self):
+        from deeplearning4j_tpu.nlp.tokenization import CJKTokenizerFactory
+        f = CJKTokenizerFactory(user_dictionary={"研究": 100, "生命": 80},
+                                mode="lattice")
+        assert f.tokenize("研究生命") == ["研究", "生命"]
+        assert f.tag(["研究"]) == [f.UNKNOWN_TAG]  # no POS column given
+
+    def test_bad_entry_shape_rejected(self):
+        from deeplearning4j_tpu.nlp.tokenization import CJKTokenizerFactory
+        with pytest.raises(ValueError, match="frequency"):
+            CJKTokenizerFactory(user_dictionary={"研究": (1, "名詞", "extra")})
+
+    def test_pos_filter_composes_with_cjk_factory(self):
+        from deeplearning4j_tpu.nlp.tokenization import PosFilterTokenizerFactory
+        cjk = self._factory()
+        nouns_only = PosFilterTokenizerFactory(
+            allowed_tags=["名詞"], base=cjk, tagger=cjk)
+        assert nouns_only.tokenize("研究を生命する") == ["研究", "生命"]
+
+    def test_pos_filtered_cjk_word2vec(self):
+        """End-to-end: unspaced CJK corpus → lattice + POS filter → w2v
+        vocabulary contains ONLY the allowed-tag (noun) tokens."""
+        from deeplearning4j_tpu.nlp.tokenization import PosFilterTokenizerFactory
+        rng = np.random.default_rng(0)
+        nouns = ["研究", "生命", "猫", "犬"]
+        fillers = ["を", "の", "する", "食べる"]
+        sentences = []
+        for _ in range(200):
+            words = []
+            for _ in range(6):
+                words.append(str(rng.choice(nouns)))
+                words.append(str(rng.choice(fillers)))
+            sentences.append("".join(words))
+        cjk = self._factory()
+        w2v = Word2Vec(layer_size=16, window=3, min_word_frequency=2,
+                       epochs=2, batch_size=128, seed=1, subsampling=0,
+                       tokenizer_factory=PosFilterTokenizerFactory(
+                           allowed_tags=["名詞"], base=cjk, tagger=cjk))
+        w2v.fit(sentences)
+        assert {w.word for w in w2v.vocab.words} == set(nouns)
