@@ -590,12 +590,18 @@ def bench_transformer_lm(platform: str):
     V, L, D, H = 50304, 12, 768, 12
     if QUICK:
         L, D, H = 2, 256, 4
+    from deeplearning4j_tpu.nn.updaters import Adam
+
     n_dev = len(jax.devices())
     mesh = build_mesh({"data": n_dev})
     lm = ShardedTransformerLM(
         vocab_size=V, n_layers=L, d_model=D, n_heads=H, mesh=mesh,
         max_len=T, n_microbatches=1, compute_dtype=jnp.bfloat16,
-        attention_impl="xla" if platform == "tpu" else "flash")
+        attention_impl="xla" if platform == "tpu" else "flash",
+        # bf16 Adam moments: measured −2.1% step time on this config
+        # (docs/transformer_profile.md round-5 lever table); loss-curve
+        # parity quantified in tests/test_updaters_bf16.py
+        updater=Adam(lr=3e-4, moment_dtype="bfloat16"))
     rng = np.random.default_rng(0)
     toks = jax.device_put(jnp.asarray(rng.integers(0, V, (B * n_dev, T)),
                                       jnp.int32), lm.token_sharding)

@@ -155,6 +155,7 @@ class MultiLayerNetwork:
         self.listeners: List[Any] = []
         self.input_types: List[InputType] = []
         self._jit_step = None
+        self._jit_multi_step = None
         self._jit_step_tbptt = None
         self._jit_step_tbptt_scan = None
         self._it_dev = None        # device-resident iteration counter
@@ -572,6 +573,83 @@ class MultiLayerNetwork:
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, score)
         return score
+
+    def _make_multi_step(self):
+        """k optimizer steps fused into ONE dispatch via lax.scan over
+        stacked batches (round-4 verdict Next #5: the transformer profile
+        measured a 12.6% device-IDLE bucket from per-step dispatch gaps on
+        the tunnelled chip; chaining k steps amortizes the gap to 1/k).
+        Update math and iteration counters match k fit_batch calls
+        exactly (bit-for-bit without dropout/noise); the rng STREAM
+        differs — one base split fanned to k keys here vs k sequential
+        splits there — so stochastic (dropout/weight-noise) runs are
+        reproducible within each path but not across the two."""
+        def multi(params, state, opt_state, it0, xs, ys, rng, masks, lmasks):
+            n = xs.shape[0]
+            keys = jax.random.split(rng, n)
+            its = it0 + jnp.arange(n, dtype=jnp.int32)
+
+            def body(carry, inp):
+                params, state, opt = carry
+                x, y, k, it, m, lm = inp
+
+                def loss_fn(p):
+                    loss, new_state = self._loss(p, state, x, y, train=True,
+                                                 rng=k, mask=m, label_mask=lm)
+                    return loss, new_state
+
+                (loss, new_state), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True)(params)
+                new_params, new_opt = self._apply_updates(
+                    grads, params, opt, it.astype(jnp.float32))
+                return (new_params, new_state, new_opt), loss
+
+            (params, state, opt_state), losses = jax.lax.scan(
+                body, (params, state, opt_state),
+                (xs, ys, keys, its, masks, lmasks))
+            return params, state, opt_state, losses
+
+        return jax.jit(multi, donate_argnums=(0, 1, 2))
+
+    def fit_batches(self, batches):
+        """k optimizer steps in ONE device dispatch (lax.scan) over a list
+        of same-shaped DataSets.  Per-step listeners fire after the fused
+        dispatch with that step's device-resident loss.  TBPTT configs and
+        stateful listeners fall back to per-batch fit_batch calls (their
+        semantics need params on host mid-run).  Returns [k] LazyScores."""
+        batches = list(batches)
+        if not batches:
+            return []
+        if self.conf.backprop_type == "tbptt" or any(
+                getattr(l, "requires_model_state", False)
+                for l in self.listeners):
+            return [self.fit_batch(ds) for ds in batches]
+        if self._jit_multi_step is None:
+            self._jit_multi_step = self._make_multi_step()
+
+        def stack(get):
+            vals = [get(ds) for ds in batches]
+            if any(v is None for v in vals):
+                if not all(v is None for v in vals):
+                    raise ValueError("fit_batches needs uniform masks: "
+                                     "all batches or none")
+                return None
+            return jax.tree_util.tree_map(
+                lambda *leaves: jnp.stack([jnp.asarray(a) for a in leaves]),
+                *vals)
+
+        self._rng, sub = jax.random.split(self._rng)
+        n = len(batches)
+        self.params, self.state, self.opt_state, losses = self._jit_multi_step(
+            self.params, self.state, self.opt_state, self._iter_scalar(n),
+            stack(lambda d: d.features), stack(lambda d: d.labels), sub,
+            stack(lambda d: d.features_mask), stack(lambda d: d.labels_mask))
+        self.iteration += n
+        scores = [LazyScore(losses[i]) for i in range(n)]
+        for i, score in enumerate(scores):
+            for lst in self.listeners:
+                lst.iteration_done(self, self.iteration - n + i + 1, score)
+        return scores
 
     def _fit_batch_tbptt(self, ds: DataSet) -> float:
         """Truncated BPTT: slice the time axis into tbptt_length chunks,

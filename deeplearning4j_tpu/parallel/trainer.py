@@ -140,6 +140,13 @@ class ShardedTrainer:
         with jax.sharding.set_mesh(self.mesh):
             return self.net.fit_batch(self.shard_dataset(ds))
 
+    def fit_batches(self, batches) -> List[float]:
+        """k steps in ONE dispatch (the container's scanned multi-step),
+        each batch data-sharded on the mesh.  Returns [k] LazyScores."""
+        with jax.sharding.set_mesh(self.mesh):
+            return self.net.fit_batches(
+                [self.shard_dataset(ds) for ds in batches])
+
     def fit(self, data, epochs: int = 1) -> List[float]:
         losses = []
         it = self.net._as_iterator(data)

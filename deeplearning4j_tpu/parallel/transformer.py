@@ -136,6 +136,7 @@ class ShardedTransformerLM:
         self.opt_state = jax.device_put(opt, self._opt_shardings(opt, shardings))
         self.token_sharding = NamedSharding(mesh, P("data", "seq"))
         self._jit_step = None
+        self._jit_multi_step = None
         self._jit_logits = None
 
     def _opt_shardings(self, opt, param_shardings):
@@ -236,6 +237,49 @@ class ShardedTransformerLM:
         self.iteration += 1
         from ..optimize.score import LazyScore
         return LazyScore(loss)
+
+    def _build_multi_step(self):
+        """k train steps fused into one dispatch via lax.scan (round-4
+        verdict Next #5: the profile's 12.6% device-IDLE bucket is the
+        per-step dispatch gap through the tunnel; k-chaining amortizes it
+        to 1/k).  Identical math to k fit_batch calls — sequential
+        optimizer steps, per-step iteration counter."""
+        updater = self.updater
+
+        def multi(params, opt_state, it0, toks, tgts):
+            its = it0 + jnp.arange(toks.shape[0], dtype=jnp.int32)
+
+            def body(carry, inp):
+                params, opt = carry
+                tok, tgt, it = inp
+                loss, grads = jax.value_and_grad(self._loss)(params, tok, tgt)
+                updates, opt = updater.update(grads, opt, it)
+                params = jax.tree_util.tree_map(
+                    lambda p, u: (p - u.astype(p.dtype)), params, updates)
+                return (params, opt), loss
+
+            (params, opt_state), losses = jax.lax.scan(
+                body, (params, opt_state), (toks, tgts, its))
+            return params, opt_state, losses
+
+        return jax.jit(multi, donate_argnums=(0, 1))
+
+    def fit_batches(self, tokens: np.ndarray, targets: np.ndarray):
+        """k steps in ONE dispatch: ``tokens``/``targets`` are [k, B, T]
+        (k stacked minibatches).  Returns [k] LazyScores."""
+        if self._jit_multi_step is None:
+            self._jit_multi_step = self._build_multi_step()
+        stacked = NamedSharding(self.mesh, P(None, "data", "seq"))
+        tokens = jax.device_put(jnp.asarray(tokens, jnp.int32), stacked)
+        targets = jax.device_put(jnp.asarray(targets, jnp.int32), stacked)
+        k = tokens.shape[0]
+        with jax.sharding.set_mesh(self.mesh):
+            self.params, self.opt_state, losses = self._jit_multi_step(
+                self.params, self.opt_state,
+                jnp.asarray(self.iteration, jnp.int32), tokens, targets)
+        self.iteration += k
+        from ..optimize.score import LazyScore
+        return [LazyScore(losses[i]) for i in range(k)]
 
     def logits(self, tokens: np.ndarray) -> Array:
         if self._jit_logits is None:

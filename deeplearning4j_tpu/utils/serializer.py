@@ -24,6 +24,7 @@ from typing import Dict, List
 
 import jax
 import jax.numpy as jnp
+import ml_dtypes
 import numpy as np
 
 FORMAT_VERSION = 1
@@ -40,7 +41,14 @@ def _flatten_tree(tree, prefix="") -> Dict[str, np.ndarray]:
     elif tree is None:
         pass
     else:
-        out[prefix[:-1]] = np.asarray(tree)
+        a = np.asarray(tree)
+        if a.dtype == ml_dtypes.bfloat16:
+            # np.savez round-trips bf16 as an opaque void dtype — store
+            # the raw bits as uint16 with the dtype in the entry name
+            # (Adam moment_dtype state, reduced-precision checkpoints)
+            out[prefix[:-1] + "#bfloat16"] = a.view(np.uint16)
+        else:
+            out[prefix[:-1]] = a
     return out
 
 
@@ -65,9 +73,11 @@ def _unflatten_into(template, flat: Dict[str, np.ndarray], prefix=""):
     if template is None:
         return None
     key = prefix[:-1]
-    if key not in flat:
-        raise KeyError(f"checkpoint missing parameter '{key}'")
-    return jnp.asarray(flat[key])
+    if key in flat:
+        return jnp.asarray(flat[key])
+    if key + "#bfloat16" in flat:
+        return jnp.asarray(flat[key + "#bfloat16"].view(ml_dtypes.bfloat16))
+    raise KeyError(f"checkpoint missing parameter '{key}'")
 
 
 def save_model(net, path: str, save_updater: bool = True) -> None:

@@ -1,0 +1,139 @@
+"""Measure the two round-5 levers on the GPT-2-small TransformerLM bench
+config (and ResNet-50 chaining): bf16 Adam moments and fit_batches(k)
+multi-step chaining.  Interleaved arms, best-of-3 windows, value-readback
+sync — bench.py's protocol.  Usage: python scripts/lever_probe.py [tfm|resnet]
+"""
+import json
+import sys
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, "/root/repo")
+
+
+def sync(x):
+    leaf = jax.tree_util.tree_leaves(x)[0]
+    float(jnp.sum(leaf))
+
+
+def run_tfm():
+    from deeplearning4j_tpu.parallel import ShardedTransformerLM, build_mesh
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    B, T, V, L, D, H = 8, 1024, 50304, 12, 768, 12
+    mesh = build_mesh({"data": 1})
+    rng = np.random.default_rng(0)
+    toks1 = rng.integers(0, V, (B, T))
+    tgts1 = np.roll(toks1, -1, axis=1)
+    K = 8
+    toksk = np.stack([toks1] * K)
+    tgtsk = np.stack([tgts1] * K)
+
+    def make(moment_dtype):
+        return ShardedTransformerLM(
+            vocab_size=V, n_layers=L, d_model=D, n_heads=H, mesh=mesh,
+            max_len=T, n_microbatches=1, compute_dtype=jnp.bfloat16,
+            attention_impl="xla",
+            updater=Adam(lr=3e-4, moment_dtype=moment_dtype))
+
+    def time_single(lm, steps=24):
+        for _ in range(3):
+            lm.fit_batch(toks1, tgts1)
+        sync(lm.params)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                lm.fit_batch(toks1, tgts1)
+            sync(lm.params)
+            best = min(best, (time.perf_counter() - t0) / steps)
+        return best
+
+    def time_chained(lm, calls=3):
+        lm.fit_batches(toksk, tgtsk)
+        sync(lm.params)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(calls):
+                lm.fit_batches(toksk, tgtsk)
+            sync(lm.params)
+            best = min(best, (time.perf_counter() - t0) / (calls * K))
+        return best
+
+    out = {}
+    arms = [
+        ("baseline", lambda: time_single(make(None))),
+        ("bf16_moments", lambda: time_single(make("bfloat16"))),
+        ("chain_k8", lambda: time_chained(make(None))),
+        ("bf16+chain_k8", lambda: time_chained(make("bfloat16"))),
+    ]
+    for name, fn in arms:
+        sec = fn()
+        out[name] = {"ms_per_step": round(sec * 1e3, 2),
+                     "tokens_per_sec": round(B * T / sec, 1)}
+        print(name, out[name], flush=True)
+    print(json.dumps(out))
+
+
+def run_resnet():
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.models import ResNet50
+    from deeplearning4j_tpu.nn.updaters import Nesterovs
+
+    batch, size = 128, 224
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(batch, size, size, 3)).astype(np.float32)
+    y = np.eye(1000, dtype=np.float32)[rng.integers(0, 1000, batch)]
+    K = 4
+
+    def make():
+        net = ResNet50(height=size, width=size, channels=3, num_classes=1000,
+                       updater=Nesterovs(lr=0.1, momentum=0.9))
+        net.conf.compute_dtype = "bfloat16"
+        return net
+
+    ds1 = DataSet(jnp.asarray(x), jnp.asarray(y))
+    dsk = [ds1] * K
+
+    def time_single(net, steps=16):
+        for _ in range(3):
+            net.fit_batch(ds1)
+        sync(net.params)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                net.fit_batch(ds1)
+            sync(net.params)
+            best = min(best, (time.perf_counter() - t0) / steps)
+        return best
+
+    def time_chained(net, calls=4):
+        net.fit_batches(dsk)
+        sync(net.params)
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(calls):
+                net.fit_batches(dsk)
+            sync(net.params)
+            best = min(best, (time.perf_counter() - t0) / (calls * K))
+        return best
+
+    out = {}
+    for name, fn in [("fit_batch_loop", lambda: time_single(make())),
+                     ("chain_k4", lambda: time_chained(make()))]:
+        sec = fn()
+        out[name] = {"ms_per_step": round(sec * 1e3, 2),
+                     "images_per_sec": round(batch / sec, 1)}
+        print(name, out[name], flush=True)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    (run_resnet if (len(sys.argv) > 1 and sys.argv[1] == "resnet")
+     else run_tfm)()

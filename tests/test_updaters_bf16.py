@@ -1,0 +1,117 @@
+"""Opt-in reduced-precision optimizer state (round-4 verdict Next #4).
+
+``Adam(moment_dtype="bfloat16")`` halves the m/v HBM footprint+traffic —
+the dominant optimizer cost on the TransformerLM bench (~3.9 GB/step,
+docs/transformer_profile.md).  These tests pin the semantics: state is
+really stored narrow, update math stays f32, and the loss-curve
+divergence vs f32 moments is small and quantified.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import (
+    MultiLayerNetwork, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.updaters import Adam
+
+
+def _net(moment_dtype=None, seed=0):
+    conf = (NeuralNetConfiguration.builder().seed(seed)
+            .updater(Adam(lr=1e-3, moment_dtype=moment_dtype))
+            .layer(Dense(n_out=64, activation="relu"))
+            .layer(OutputLayer(n_out=10, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(784)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def _mnist_batch(seed=0, n=256):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 784)).astype(np.float32)
+    y = np.eye(10, dtype=np.float32)[rng.integers(0, 10, n)]
+    return DataSet(x, y)
+
+
+class TestBf16Moments:
+    def test_state_is_stored_narrow(self):
+        net = _net(moment_dtype="bfloat16")
+        ds = _mnist_batch()
+        net.fit_batch(ds)
+        for sub in ("m", "v"):
+            for leaf in jax.tree_util.tree_leaves(
+                    [s[sub] for s in net.opt_state if s]):
+                assert leaf.dtype == jnp.bfloat16
+
+    def test_default_stays_f32(self):
+        net = _net()
+        net.fit_batch(_mnist_batch())
+        for leaf in jax.tree_util.tree_leaves(
+                [s["m"] for s in net.opt_state if s]):
+            assert leaf.dtype == jnp.float32
+
+    def test_loss_curve_divergence_quantified(self):
+        """The parity number: 80 MNIST-MLP steps, per-step |Δloss|/loss
+        between f32 and bf16 moments stays under 2% and the final losses
+        agree within 5% — moment rounding is noise, not drift."""
+        f32, bf16 = _net(), _net(moment_dtype="bfloat16")
+        ds = _mnist_batch()
+        l32, l16 = [], []
+        for _ in range(80):
+            l32.append(float(f32.fit_batch(ds)))
+            l16.append(float(bf16.fit_batch(ds)))
+        l32, l16 = np.asarray(l32), np.asarray(l16)
+        rel = np.abs(l32 - l16) / np.maximum(l32, 1e-8)
+        assert rel.mean() < 0.02, f"mean rel divergence {rel.mean():.4f}"
+        assert abs(l32[-1] - l16[-1]) / l32[-1] < 0.05
+        assert l16[-1] < 0.5 * l16[0]  # and it actually trains
+
+    def test_charrnn_tbptt_path(self):
+        """The scanned-TBPTT step carries opt state through lax.scan —
+        narrow moments must survive the scan carry."""
+        from deeplearning4j_tpu.models import TextGenerationLSTM
+        rng = np.random.default_rng(0)
+        net = TextGenerationLSTM(vocab_size=32,
+                                 updater=Adam(lr=1e-3,
+                                              moment_dtype="bfloat16"))
+        ds = DataSet(rng.integers(0, 32, (8, 100)).astype(np.int32),
+                     rng.integers(0, 32, (8, 100)).astype(np.int32))
+        first = float(net.fit_batch(ds))
+        for _ in range(5):
+            last = float(net.fit_batch(ds))
+        assert np.isfinite(last) and last < first
+
+    def test_sharded_transformer_flag(self):
+        """ShardedTransformerLM with bf16 moments: the opt-state tree
+        inherits the params' shardings and trains downhill."""
+        from deeplearning4j_tpu.parallel import ShardedTransformerLM, build_mesh
+        n = min(4, len(jax.devices()))
+        mesh = build_mesh({"data": n}, devices=jax.devices()[:n])
+        lm = ShardedTransformerLM(vocab_size=64, n_layers=2, d_model=32,
+                                  n_heads=4, mesh=mesh, max_len=16, seed=0,
+                                  updater=Adam(lr=3e-3,
+                                               moment_dtype="bfloat16"))
+        for leaf in jax.tree_util.tree_leaves(lm.opt_state):
+            assert leaf.dtype == jnp.bfloat16
+        rng = np.random.default_rng(0)
+        toks = rng.integers(0, 64, (2 * n, 16))
+        tgts = np.roll(toks, -1, axis=1)
+        first = float(lm.fit_batch(toks, tgts))
+        for _ in range(10):
+            last = float(lm.fit_batch(toks, tgts))
+        assert last < first
+
+    def test_serde_round_trip(self, tmp_path):
+        net = _net(moment_dtype="bfloat16")
+        net.fit_batch(_mnist_batch())
+        p = str(tmp_path / "m.zip")
+        net.save(p)
+        restored = MultiLayerNetwork.load(p)
+        upd = restored.conf.updater
+        assert jnp.dtype(upd.moment_dtype) == jnp.bfloat16
