@@ -10,11 +10,13 @@ the MXU, one XLA program per step, no lock-free mutation needed.
 
 from .tokenization import (
     AggregatingSentenceIterator,
+    BaseFormTokenizerFactory,
     CJKTokenizerFactory,
     CollectionSentenceIterator,
     CommonPreprocessor,
     DefaultTokenizerFactory,
     LineSentenceIterator,
+    PosFilterTokenizerFactory,
     get_tokenizer_factory,
     register_tokenizer_factory,
 )

@@ -153,22 +153,29 @@ class CJKTokenizerFactory:
                 f"mode must be 'bigram', 'char' or 'lattice', got {mode!r}")
         self.mode = mode
         self.preprocessor = preprocessor or CommonPreprocessor()
-        # dictionary values: frequency, OR (frequency, pos_tag) — the
-        # morphological surface the reference's kuromoji dictionaries
-        # carry (deeplearning4j-nlp-japanese vendored lexicon rows hold
-        # POS/base-form columns next to the cost); tags are opaque strings
-        # (名詞/助詞 for a Japanese lexicon, NN/JJ for an English one)
+        # dictionary values: frequency, (frequency, pos_tag), or
+        # (frequency, pos_tag, base_form) — the morphological surfaces the
+        # reference's kuromoji dictionaries carry
+        # (deeplearning4j-nlp-japanese vendored lexicon rows hold POS and
+        # base-form columns next to the cost); tags are opaque strings
+        # (名詞/動詞 for a Japanese lexicon, NN/JJ for an English one) and
+        # base_form is the lemma a conjugated surface reduces to
+        # (食べた → 食べる, kuromoji Token.getBaseForm)
         self._pos: Dict[str, str] = {}
+        self._base: Dict[str, str] = {}
         if isinstance(user_dictionary, dict):
             freqs = {}
             for w, v in user_dictionary.items():
                 if isinstance(v, (tuple, list)):
-                    if len(v) != 2:
+                    if len(v) not in (2, 3):
                         raise ValueError(
-                            f"dictionary entry {w!r}: expected frequency or "
-                            f"(frequency, pos_tag), got {v!r}")
+                            f"dictionary entry {w!r}: expected frequency, "
+                            f"(frequency, pos_tag) or (frequency, pos_tag, "
+                            f"base_form), got {v!r}")
                     freqs[w] = v[0]
                     self._pos[w] = str(v[1])
+                    if len(v) == 3:
+                        self._base[w] = str(v[2])
                 else:
                     freqs[w] = v
             if any(c <= 0 for c in freqs.values()):
@@ -302,6 +309,18 @@ class CJKTokenizerFactory:
         toks = self.tokenize(sentence)
         return list(zip(toks, self.tag(toks)))
 
+    def base_form(self, token: str) -> str:
+        """The dictionary lemma for a surface form, or the surface itself
+        (reference kuromoji Token.getBaseForm: conjugated 食べた → 食べる)."""
+        return self._base.get(token, token)
+
+    def tokenize_with_morphology(self, sentence: str) -> List[tuple]:
+        """(surface, pos_tag, base_form) triples — the full per-token
+        morphological surface of the reference's Japanese analyzer."""
+        toks = self.tokenize(sentence)
+        return [(t, g, self.base_form(t))
+                for t, g in zip(toks, self.tag(toks))]
+
 
 # ---------------------------------------------------------------------------
 # POS tagging hook (the deeplearning4j-nlp-uima PosUimaTokenizerFactory role)
@@ -389,6 +408,24 @@ class PosFilterTokenizerFactory:
         return list(zip(tokens, self.tagger.tag(tokens)))
 
 
+class BaseFormTokenizerFactory:
+    """Tokenize with ``base`` then replace each surface form by its
+    dictionary lemma (reference kuromoji BaseFormFilter behavior: train
+    vectors on 食べる regardless of which conjugation appeared).  ``base``
+    is any factory with a ``base_form(token)`` method — the CJK factory
+    with (frequency, pos_tag, base_form) dictionary entries."""
+
+    def __init__(self, base):
+        if not hasattr(base, "base_form"):
+            raise ValueError("base factory must expose base_form(token) — "
+                             "use CJKTokenizerFactory with (frequency, "
+                             "pos_tag, base_form) dictionary entries")
+        self.base = base
+
+    def tokenize(self, sentence: str) -> List[str]:
+        return [self.base.base_form(t) for t in self.base.tokenize(sentence)]
+
+
 #: name → factory constructor (the reference configures TokenizerFactory
 #: by class name; this registry is the same seam without reflection)
 _TOKENIZER_FACTORIES: Dict[str, Callable[..., object]] = {}
@@ -411,6 +448,7 @@ def get_tokenizer_factory(name: str, **kwargs):
 register_tokenizer_factory("default", DefaultTokenizerFactory)
 register_tokenizer_factory("cjk", CJKTokenizerFactory)
 register_tokenizer_factory("pos", PosFilterTokenizerFactory)
+register_tokenizer_factory("baseform", BaseFormTokenizerFactory)
 # the language-specific names share the CJK segmenter; a real lexicon
 # arrives via user_dictionary (the vendored-dictionary seam)
 register_tokenizer_factory("chinese", CJKTokenizerFactory)

@@ -78,6 +78,17 @@ class NetworkMemoryReport:
         return "\n".join(lines)
 
 
+def _updater_state_bytes(updater, pcount: int, param_elem_bytes: int) -> int:
+    """Optimizer-state footprint: copies × per-element size.  Narrow
+    moment storage (Adam moment_dtype="bfloat16") halves the per-element
+    size — the report must price what is actually allocated."""
+    md = getattr(updater, "moment_dtype", None)
+    if md is not None:
+        import jax.numpy as jnp
+        param_elem_bytes = jnp.dtype(md).itemsize
+    return pcount * param_elem_bytes * _updater_copies(updater)
+
+
 def _updater_copies(updater) -> int:
     """Optimizer-state copies of the params (Adam/AdaMax/Nadam/AMSGrad → 2,
     momentum-family/AdaGrad/RmsProp → 1, Sgd/NoOp → 0)."""
@@ -126,7 +137,7 @@ def memory_report(net, minibatch: int = 32) -> NetworkMemoryReport:
                             else type(spec.vertex).__name__),
                 param_count=pcount,
                 param_bytes=pcount * pbytes,
-                updater_state_bytes=pcount * pbytes * _updater_copies(upd),
+                updater_state_bytes=_updater_state_bytes(upd, pcount, pbytes),
                 activation_elements_per_example=act_elems,
                 activation_bytes_per_example=act_elems * abytes,
             ))
@@ -147,7 +158,7 @@ def memory_report(net, minibatch: int = 32) -> NetworkMemoryReport:
             layer_type=type(layer).__name__,
             param_count=pcount,
             param_bytes=pcount * pbytes,
-            updater_state_bytes=pcount * pbytes * _updater_copies(upd),
+            updater_state_bytes=_updater_state_bytes(upd, pcount, pbytes),
             activation_elements_per_example=act_elems,
             activation_bytes_per_example=act_elems * abytes,
         ))

@@ -533,6 +533,27 @@ class ComputationGraph:
                    for p in self.params.values()
                    for x in jax.tree_util.tree_leaves(p))
 
+    def summary(self) -> str:
+        """Vertex table in topological order: name, type, inputs, param
+        count (reference ComputationGraph.summary():3967)."""
+        if not self.params:
+            raise ValueError("call init() before summary()")
+        rows = [("vertex", "type", "inputs", "params")]
+        for name in self.topo_order:
+            spec = self._spec(name)
+            v = spec.vertex
+            tname = (type(v.layer).__name__ if isinstance(v, LayerVertex)
+                     else type(v).__name__)
+            n = sum(int(np.prod(x.shape)) for x in
+                    jax.tree_util.tree_leaves(self.params.get(name, {})))
+            rows.append((name, tname, ",".join(spec.inputs) or "-", f"{n:,}"))
+        widths = [max(len(r[c]) for r in rows) for c in range(4)]
+        lines = ["  ".join(val.ljust(w) for val, w in zip(r, widths))
+                 for r in rows]
+        lines.insert(1, "-" * len(lines[0]))
+        lines.append(f"total params: {self.num_params():,}")
+        return "\n".join(lines)
+
     # -- pure forward / loss ------------------------------------------------
 
     def _apply(self, params, state, inputs: Dict[str, Array], *, train: bool, rng,

@@ -63,6 +63,7 @@ cg_conf = (GraphBuilder()
            .build())
 cg = ComputationGraph(cg_conf)
 cg.init()
+print(cg.summary())
 losses = [float(cg.fit_batch(ds)) for _ in range(40)]
 print(f"loss {losses[0]:.3f} -> {losses[-1]:.3f}")
 assert losses[-1] < 0.5 * losses[0]

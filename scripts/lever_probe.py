@@ -4,6 +4,7 @@ multi-step chaining.  Interleaved arms, best-of-3 windows, value-readback
 sync — bench.py's protocol.  Usage: python scripts/lever_probe.py [tfm|resnet]
 """
 import json
+import os
 import sys
 import time
 
@@ -11,7 +12,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-sys.path.insert(0, "/root/repo")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def sync(x):

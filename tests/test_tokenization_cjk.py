@@ -275,7 +275,8 @@ class TestCJKPosThroughLattice:
     def test_bad_entry_shape_rejected(self):
         from deeplearning4j_tpu.nlp.tokenization import CJKTokenizerFactory
         with pytest.raises(ValueError, match="frequency"):
-            CJKTokenizerFactory(user_dictionary={"研究": (1, "名詞", "extra")})
+            CJKTokenizerFactory(
+                user_dictionary={"研究": (1, "名詞", "研究", "extra")})
 
     def test_pos_filter_composes_with_cjk_factory(self):
         from deeplearning4j_tpu.nlp.tokenization import PosFilterTokenizerFactory
@@ -305,3 +306,62 @@ class TestCJKPosThroughLattice:
                            allowed_tags=["名詞"], base=cjk, tagger=cjk))
         w2v.fit(sentences)
         assert {w.word for w in w2v.vocab.words} == set(nouns)
+
+
+class TestBaseFormsThroughLattice:
+    """Round-5: dictionary entries optionally carry a base form (lemma) —
+    the second kuromoji per-token surface (Token.getBaseForm); conjugated
+    surfaces reduce to their lemma for vectorization."""
+
+    DICT = {"食べた": (30, "動詞", "食べる"), "食べる": (40, "動詞"),
+            "猫": (50, "名詞"), "が": (500, "助詞"), "を": (500, "助詞"),
+            "魚": (40, "名詞")}
+
+    def _factory(self):
+        from deeplearning4j_tpu.nlp.tokenization import CJKTokenizerFactory
+        return CJKTokenizerFactory(user_dictionary=self.DICT, mode="lattice")
+
+    def test_morphology_triples(self):
+        f = self._factory()
+        got = f.tokenize_with_morphology("猫が魚を食べた")
+        assert got == [("猫", "名詞", "猫"), ("が", "助詞", "が"),
+                       ("魚", "名詞", "魚"), ("を", "助詞", "を"),
+                       ("食べた", "動詞", "食べる")]
+
+    def test_base_form_factory_emits_lemmas(self):
+        from deeplearning4j_tpu.nlp.tokenization import (
+            BaseFormTokenizerFactory,
+        )
+        f = BaseFormTokenizerFactory(self._factory())
+        assert f.tokenize("魚を食べた") == ["魚", "を", "食べる"]
+
+    def test_base_form_factory_requires_capable_base(self):
+        from deeplearning4j_tpu.nlp.tokenization import (
+            BaseFormTokenizerFactory, DefaultTokenizerFactory,
+        )
+        with pytest.raises(ValueError, match="base_form"):
+            BaseFormTokenizerFactory(DefaultTokenizerFactory())
+
+    def test_registry_name(self):
+        from deeplearning4j_tpu.nlp.tokenization import get_tokenizer_factory
+        f = get_tokenizer_factory("baseform", base=self._factory())
+        assert f.tokenize("食べた") == ["食べる"]
+
+    def test_lemmatized_word2vec_merges_conjugations(self):
+        """w2v trained through the base-form filter has ONE vocab entry
+        for the lemma regardless of which conjugation appeared."""
+        from deeplearning4j_tpu.nlp.tokenization import (
+            BaseFormTokenizerFactory,
+        )
+        rng = np.random.default_rng(0)
+        sentences = []
+        for _ in range(100):
+            verb = "食べた" if rng.integers(0, 2) else "食べる"
+            sentences.append("猫が魚を" + verb)
+        w2v = Word2Vec(layer_size=8, window=2, min_word_frequency=2,
+                       epochs=1, seed=1, subsampling=0,
+                       tokenizer_factory=BaseFormTokenizerFactory(
+                           self._factory()))
+        w2v.fit(sentences)
+        assert w2v.has_word("食べる")
+        assert not w2v.has_word("食べた")
