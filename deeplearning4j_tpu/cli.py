@@ -6,6 +6,8 @@ configs).  Usage:
 
     python -m deeplearning4j_tpu train --zoo lenet --data mnist \\
         --epochs 2 --batch-size 128 --output model.zip --dashboard out.html
+    python -m deeplearning4j_tpu train --zoo lenet --data mnist \\
+        --mesh data=4,model=2 ...   # sharded (ParallelWrapperMain role)
     python -m deeplearning4j_tpu train --config conf.json --data data.npz ...
     python -m deeplearning4j_tpu evaluate --model model.zip --data mnist
     python -m deeplearning4j_tpu predict --model model.zip --input x.npz \\
@@ -101,14 +103,62 @@ def _load_model(path: str):
     return load_model(path)
 
 
+def _parse_mesh(spec: str) -> dict:
+    """'data=4,model=2' → {"data": 4, "model": 2} (-1 = infer).  Resolves
+    -1 against the visible device count and guarantees a 'data' axis
+    (ShardedTrainer's batch sharding names it), so every failure mode
+    here is a clean one-line CLI error, not a jax traceback."""
+    axes = {}
+    for part in spec.split(","):
+        name, _, size = part.partition("=")
+        name = name.strip()
+        try:
+            axes[name] = int(size)
+        except ValueError:
+            size = ""  # fall through to the shared message
+        if not name or not size:
+            raise SystemExit(
+                f"bad --mesh {spec!r}: expected name=size[,name=size...] "
+                "with integer sizes, e.g. 'data=8' or 'data=4,model=2'")
+    axes.setdefault("data", 1)
+    if list(axes.values()).count(-1) > 1:
+        raise SystemExit(f"bad --mesh {spec!r}: at most one -1 (infer) axis")
+    if -1 in axes.values():
+        import jax
+
+        known = 1
+        for s in axes.values():
+            if s != -1:
+                known *= s
+        n = jax.device_count()
+        if known == 0 or n % known:
+            raise SystemExit(f"bad --mesh {spec!r}: cannot infer -1 axis "
+                             f"from {n} device(s)")
+        axes = {k: (n // known if s == -1 else s) for k, s in axes.items()}
+    return axes
+
+
 def cmd_train(args) -> int:
     from .datasets import DataSet, ListDataSetIterator
     from .optimize import ScoreIterationListener
 
     net = _build_model(args)
     xs, ys = _load_data(args.data, train=True, num_classes=_num_classes_of(net))
-    it = ListDataSetIterator(DataSet(xs, ys).shuffle(args.seed)
-                             .batch_by(args.batch_size))
+    batches = DataSet(xs, ys).shuffle(args.seed).batch_by(args.batch_size)
+    mesh_axes = _parse_mesh(args.mesh) if args.mesh else None
+    if mesh_axes:
+        # XLA needs static shapes divisible by the data axis — drop the
+        # ragged tail batch instead of erroring mid-epoch
+        dp = mesh_axes["data"]
+        if args.batch_size % dp:
+            raise SystemExit(f"--batch-size {args.batch_size} not divisible "
+                             f"by mesh data axis {dp}")
+        batches = [b for b in batches if len(b.features) == args.batch_size]
+        if not batches:
+            raise SystemExit(
+                f"dataset ({len(xs)} samples) has no full batch of "
+                f"{args.batch_size}; lower --batch-size for --mesh training")
+    it = ListDataSetIterator(batches)
     listeners = [ScoreIterationListener(args.print_every)]
     storage = None
     if args.dashboard:
@@ -117,7 +167,26 @@ def cmd_train(args) -> int:
         storage = InMemoryStatsStorage()
         listeners.append(StatsListener(storage, session_id="cli_train"))
     net.set_listeners(*listeners)
-    losses = net.fit(it, epochs=args.epochs)
+    trainer = None
+    if mesh_axes:
+        # the reference's ParallelWrapperMain role (parallelism/main/
+        # ParallelWrapperMain.java: CLI multi-device training): place the
+        # model on a named mesh, train through the sharded step
+        import jax
+
+        from .parallel import ShardedTrainer, build_mesh
+
+        total = 1
+        for s in mesh_axes.values():
+            total *= s
+        if total > jax.device_count():
+            raise SystemExit(f"--mesh {args.mesh!r} needs {total} device(s), "
+                             f"found {jax.device_count()}")
+        mesh = build_mesh(mesh_axes, devices=jax.devices()[:total])
+        trainer = ShardedTrainer(net, mesh)
+        print(f"mesh: {dict(mesh.shape)} over {mesh.devices.size} device(s)")
+    losses = (trainer.fit(it, epochs=args.epochs) if trainer
+              else net.fit(it, epochs=args.epochs))
     print(f"trained {args.epochs} epoch(s), {len(losses)} iterations, "
           f"final loss {losses[-1]:.5f}")
     if args.dashboard:
@@ -178,6 +247,9 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--print-every", type=int, default=10)
     t.add_argument("--output", help="checkpoint zip to write")
     t.add_argument("--dashboard", help="HTML training report to write")
+    t.add_argument("--mesh", help="train sharded over a named device mesh, "
+                   "e.g. 'data=8' or 'data=4,model=2' (the reference's "
+                   "ParallelWrapperMain role)")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("evaluate", help="evaluate a saved model")

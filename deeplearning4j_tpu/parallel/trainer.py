@@ -159,6 +159,11 @@ class ShardedTrainer:
             materialize_scores(losses[synced:])
             synced = len(losses)
             self.net.epoch += 1
+            # epoch-level listener callbacks (dashboard epoch markers,
+            # epoch-cadence checkpoints) must not disappear in mesh mode
+            for lst in self.net.listeners:
+                if hasattr(lst, "epoch_done"):
+                    lst.epoch_done(self.net, self.net.epoch)
         return losses
 
     def output(self, x, **kw):

@@ -1,6 +1,7 @@
 """CLI: train → save → evaluate → predict → summary round trip."""
 
 import json
+import re
 import os
 import subprocess
 import sys
@@ -89,3 +90,74 @@ class TestCli:
                             "--help"], capture_output=True, text=True,
                            cwd="/root/repo", timeout=120)
         assert r.returncode == 0 and "train" in r.stdout
+
+
+class TestMeshTraining:
+    """--mesh: CLI sharded training (the reference ParallelWrapperMain
+    role, parallelism/main/ParallelWrapperMain.java)."""
+
+    def test_train_over_mesh(self, tmp_path, blob_npz, conf_json, capsys):
+        model = str(tmp_path / "mesh_model.zip")
+        rc = main(["train", "--config", conf_json, "--data", blob_npz,
+                   "--epochs", "2", "--batch-size", "32", "--seed", "7",
+                   "--mesh", "data=8", "--output", model])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "mesh: {'data': 8}" in out
+        assert os.path.exists(model)
+        rc = main(["evaluate", "--model", model, "--data", blob_npz])
+        assert rc == 0
+        out = capsys.readouterr().out
+        m = re.search(r"[Aa]ccuracy:?\s+([0-9.]+)", out)
+        assert m, out
+        assert float(m.group(1)) > 0.9
+
+    def test_batch_not_divisible_rejected(self, blob_npz, conf_json):
+        with pytest.raises(SystemExit, match="not divisible"):
+            main(["train", "--config", conf_json, "--data", blob_npz,
+                  "--batch-size", "30", "--mesh", "data=8"])
+
+    def test_bad_mesh_spec_rejected(self, blob_npz, conf_json):
+        for bad in ("whatever", "data=four", "data="):
+            with pytest.raises(SystemExit, match="bad --mesh"):
+                main(["train", "--config", conf_json, "--data", blob_npz,
+                      "--batch-size", "32", "--mesh", bad])
+
+    def test_model_only_mesh_gets_data_axis(self, blob_npz, conf_json,
+                                            capsys):
+        """'model=2' must not crash ShardedTrainer: a data axis of size 1
+        is implied (the batch sharding names it)."""
+        rc = main(["train", "--config", conf_json, "--data", blob_npz,
+                   "--epochs", "1", "--batch-size", "32",
+                   "--mesh", "model=2"])
+        assert rc == 0
+        assert "'model': 2" in capsys.readouterr().out
+
+    def test_infer_axis_resolved_before_divisibility_check(self, blob_npz,
+                                                           conf_json):
+        """-1 resolves against the device count (8 here) BEFORE the
+        batch-divisibility preflight, so the mid-epoch shard error the
+        check exists to prevent cannot slip through."""
+        with pytest.raises(SystemExit, match="not divisible"):
+            main(["train", "--config", conf_json, "--data", blob_npz,
+                  "--batch-size", "30", "--mesh", "data=-1"])
+
+    def test_tiny_dataset_clear_error(self, tmp_path, conf_json):
+        xs = np.zeros((20, 6), np.float32)
+        ys = np.zeros(20, np.int64)
+        data = str(tmp_path / "tiny.npz")
+        np.savez(data, x=xs, y=ys)
+        with pytest.raises(SystemExit, match="no full batch"):
+            main(["train", "--config", conf_json, "--data", data,
+                  "--batch-size", "32", "--mesh", "data=8"])
+
+    def test_epoch_done_fires_in_mesh_mode(self, blob_npz, conf_json,
+                                           tmp_path):
+        """Dashboard/epoch listeners must not silently disappear when
+        training routes through ShardedTrainer."""
+        dash = str(tmp_path / "mesh_dash.html")
+        rc = main(["train", "--config", conf_json, "--data", blob_npz,
+                   "--epochs", "2", "--batch-size", "32",
+                   "--mesh", "data=8", "--dashboard", dash])
+        assert rc == 0
+        assert os.path.exists(dash)
