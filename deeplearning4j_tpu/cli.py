@@ -116,10 +116,12 @@ def _parse_mesh(spec: str) -> dict:
             axes[name] = int(size)
         except ValueError:
             size = ""  # fall through to the shared message
-        if not name or not size:
+        if not name or not size or axes.get(name) == 0 or (
+                axes.get(name, 0) < -1):
             raise SystemExit(
                 f"bad --mesh {spec!r}: expected name=size[,name=size...] "
-                "with integer sizes, e.g. 'data=8' or 'data=4,model=2'")
+                "with positive integer sizes (or one -1 to infer), "
+                "e.g. 'data=8' or 'data=4,model=2'")
     axes.setdefault("data", 1)
     if list(axes.values()).count(-1) > 1:
         raise SystemExit(f"bad --mesh {spec!r}: at most one -1 (infer) axis")
@@ -153,11 +155,18 @@ def cmd_train(args) -> int:
         if args.batch_size % dp:
             raise SystemExit(f"--batch-size {args.batch_size} not divisible "
                              f"by mesh data axis {dp}")
-        batches = [b for b in batches if len(b.features) == args.batch_size]
-        if not batches:
+        full = [b for b in batches if len(b.features) == args.batch_size]
+        dropped = sum(len(b.features) for b in batches) - \
+            sum(len(b.features) for b in full)
+        if not full:
             raise SystemExit(
                 f"dataset ({len(xs)} samples) has no full batch of "
                 f"{args.batch_size}; lower --batch-size for --mesh training")
+        if dropped:
+            print(f"mesh training drops the ragged tail: {dropped} of "
+                  f"{len(xs)} samples not in a full batch of "
+                  f"{args.batch_size}")
+        batches = full
     it = ListDataSetIterator(batches)
     listeners = [ScoreIterationListener(args.print_every)]
     storage = None

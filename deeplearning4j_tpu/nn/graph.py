@@ -1178,11 +1178,19 @@ class ComputationGraph:
         for _ in range(epochs):
             for ds in it:
                 losses.append(self.fit_batch(ds))
-            # one batched transfer per epoch frees the per-step buffers
-            materialize_scores(losses[synced:])
-            synced = len(losses)
-            self.epoch += 1
+            synced = self._end_epoch(losses, synced)
         return losses
+
+    def _end_epoch(self, losses, synced: int) -> int:
+        """Shared epoch epilogue (see MultiLayerNetwork._end_epoch):
+        batched score materialization, epoch bump, epoch_done listeners —
+        the graph container previously skipped the listener callbacks."""
+        materialize_scores(losses[synced:])
+        self.epoch += 1
+        for lst in self.listeners:
+            if hasattr(lst, "epoch_done"):
+                lst.epoch_done(self, self.epoch)
+        return len(losses)
 
     @staticmethod
     def _as_iterator(data):

@@ -812,16 +812,22 @@ class MultiLayerNetwork:
         for _ in range(epochs):
             for ds in it:
                 losses.append(self.fit_batch(ds))
-            # materialize the epoch's scores: ONE device transfer per epoch
-            # — keeps the intra-epoch loop async while freeing the
-            # per-step 0-d device buffers (they'd otherwise pin memory)
-            materialize_scores(losses[synced:])
-            synced = len(losses)
-            self.epoch += 1
-            for lst in self.listeners:
-                if hasattr(lst, "epoch_done"):
-                    lst.epoch_done(self, self.epoch)
+            synced = self._end_epoch(losses, synced)
         return losses
+
+    def _end_epoch(self, losses, synced: int) -> int:
+        """Epoch epilogue shared by fit() and ShardedTrainer.fit — ONE
+        place, so epoch semantics can't diverge between plain and mesh
+        training: materialize the epoch's scores in one batched device
+        transfer (keeps the intra-epoch loop async while freeing the
+        per-step 0-d buffers), bump the counter, fire epoch_done
+        listeners.  Returns the new synced watermark."""
+        materialize_scores(losses[synced:])
+        self.epoch += 1
+        for lst in self.listeners:
+            if hasattr(lst, "epoch_done"):
+                lst.epoch_done(self, self.epoch)
+        return len(losses)
 
     @staticmethod
     def _as_iterator(data) -> DataSetIterator:

@@ -259,6 +259,39 @@ class Nadam(Adam):
 
 @register_config
 @dataclasses.dataclass
+class AMSGrad(Adam):
+    """AMSGrad (Reddi et al. 2018) — Adam with a monotone max on the
+    second moment (upstream ND4J learning/config/AmsGrad.java; the
+    reference's updater family resolves through nd4j).  State: m, v, and
+    the running max v_hat."""
+
+    def init_state(self, params):
+        return {"m": self._moments_like(params),
+                "v": self._moments_like(params),
+                "vhat": self._moments_like(params)}
+
+    def update(self, grads, state, it):
+        lr = self.lr_at(it)
+        t = it.astype(jnp.float32) + 1.0
+        bc1 = 1.0 - jnp.power(self.beta1, t)
+        bc2 = 1.0 - jnp.power(self.beta2, t)
+
+        def upd(g, m, v, vh):
+            g = g.astype(jnp.float32)
+            m_new = self.beta1 * m.astype(jnp.float32) + (1 - self.beta1) * g
+            v_new = self.beta2 * v.astype(jnp.float32) + (1 - self.beta2) * g * g
+            vh_new = jnp.maximum(vh.astype(jnp.float32), v_new)
+            step = lr * (m_new / bc1) / (jnp.sqrt(vh_new / bc2) + self.eps)
+            return (step, m_new.astype(m.dtype), v_new.astype(v.dtype),
+                    vh_new.astype(vh.dtype))
+
+        updates, new_m, new_v, new_vh = _tree_update(
+            upd, grads, state["m"], state["v"], state["vhat"])
+        return updates, {"m": new_m, "v": new_v, "vhat": new_vh}
+
+
+@register_config
+@dataclasses.dataclass
 class AdaGrad(Updater):
     lr: Any = 1e-1
     eps: float = 1e-6

@@ -154,16 +154,9 @@ class ShardedTrainer:
         for _ in range(epochs):
             for ds in it:
                 losses.append(self.fit_batch(ds))
-            # one batched transfer per epoch frees the per-step buffers
-            from ..optimize.score import materialize_scores
-            materialize_scores(losses[synced:])
-            synced = len(losses)
-            self.net.epoch += 1
-            # epoch-level listener callbacks (dashboard epoch markers,
-            # epoch-cadence checkpoints) must not disappear in mesh mode
-            for lst in self.net.listeners:
-                if hasattr(lst, "epoch_done"):
-                    lst.epoch_done(self.net, self.net.epoch)
+            # the container's own epoch epilogue — mesh mode must not
+            # diverge from plain training (scores, counter, epoch_done)
+            synced = self.net._end_epoch(losses, synced)
         return losses
 
     def output(self, x, **kw):

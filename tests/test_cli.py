@@ -118,7 +118,8 @@ class TestMeshTraining:
                   "--batch-size", "30", "--mesh", "data=8"])
 
     def test_bad_mesh_spec_rejected(self, blob_npz, conf_json):
-        for bad in ("whatever", "data=four", "data="):
+        for bad in ("whatever", "data=four", "data=", "data=0", "data=-2",
+                    "model=0"):
             with pytest.raises(SystemExit, match="bad --mesh"):
                 main(["train", "--config", conf_json, "--data", blob_npz,
                       "--batch-size", "32", "--mesh", bad])
@@ -161,3 +162,17 @@ class TestMeshTraining:
                    "--mesh", "data=8", "--dashboard", dash])
         assert rc == 0
         assert os.path.exists(dash)
+
+    def test_ragged_tail_drop_is_announced(self, tmp_path, conf_json,
+                                           capsys):
+        xs = np.concatenate([np.full((50, 6), -2, np.float32),
+                             np.full((50, 6), 2, np.float32)])
+        ys = np.concatenate([np.zeros(50, np.int64), np.ones(50, np.int64)])
+        data = str(tmp_path / "odd.npz")
+        np.savez(data, x=xs, y=ys)
+        rc = main(["train", "--config", conf_json, "--data", data,
+                   "--epochs", "1", "--batch-size", "32", "--mesh",
+                   "data=8"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "drops the ragged tail: 4 of 100 samples" in out

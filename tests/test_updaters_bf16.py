@@ -115,3 +115,55 @@ class TestBf16Moments:
         restored = MultiLayerNetwork.load(p)
         upd = restored.conf.updater
         assert jnp.dtype(upd.moment_dtype) == jnp.bfloat16
+
+
+class TestAMSGrad:
+    def test_trains_and_vhat_monotone(self):
+        from deeplearning4j_tpu.nn.updaters import AMSGrad
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .updater(AMSGrad(lr=1e-2))
+                .layer(Dense(n_out=16, activation="tanh"))
+                .layer(OutputLayer(n_out=10, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(784)).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        ds = _mnist_batch()
+        first = float(net.fit_batch(ds))
+        vh1 = np.array(net.opt_state[0]["vhat"]["W"])
+        for _ in range(20):
+            last = float(net.fit_batch(ds))
+        vh2 = np.array(net.opt_state[0]["vhat"]["W"])
+        assert last < first
+        assert (vh2 >= vh1 - 1e-12).all()  # v_hat never decreases
+
+    def test_bf16_moments_supported(self):
+        from deeplearning4j_tpu.nn.updaters import AMSGrad
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .updater(AMSGrad(lr=1e-2, moment_dtype="bfloat16"))
+                .layer(OutputLayer(n_out=10, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(784)).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        scores = net.fit_batches([_mnist_batch()] * 4)
+        assert all(np.isfinite(float(s)) for s in scores)
+        assert net.opt_state[0]["vhat"]["W"].dtype == jnp.bfloat16
+
+    def test_serde_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.nn.updaters import AMSGrad
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .updater(AMSGrad(lr=1e-2))
+                .layer(OutputLayer(n_out=10, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(784)).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        net.fit_batch(_mnist_batch())
+        p = str(tmp_path / "ams.zip")
+        net.save(p)
+        restored = MultiLayerNetwork.load(p)
+        assert type(restored.conf.updater).__name__ == "AMSGrad"
+        np.testing.assert_allclose(
+            np.asarray(restored.opt_state[0]["vhat"]["W"]),
+            np.asarray(net.opt_state[0]["vhat"]["W"]), rtol=1e-6)
