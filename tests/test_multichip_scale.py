@@ -1,10 +1,13 @@
-"""16/32-virtual-device 4-axis parallelism evidence (round-4).
+"""16/32/64-virtual-device 4-axis parallelism evidence (round-4, extended
+round-5).
 
 The conftest pins this process to 8 virtual CPU devices, so the ≥16-device
 meshes run in a subprocess with its own XLA_FLAGS — the same mechanism the
 driver's dryrun uses.  Covers what no 8-device mesh can: DP composed with
-TP, SP and PP simultaneously (every axis ≥ 2), plus an elastic 16→8
-shrink-and-continue (round-3 verdict Weak #5 / Next #6).
+TP, SP and PP simultaneously (every axis ≥ 2, up to a 4-stage pipeline at
+64 devices), plus elastic resize in BOTH directions (16→8 shrink, 8→16
+grow) with params AND optimizer state migrated across meshes
+(round-3 verdict Weak #5 / Next #6).
 """
 
 import os
@@ -38,7 +41,7 @@ assert l1 < l0, (l0, l1)  # two steps on one batch must reduce the loss
 print("OK", l0, l1)
 """
 
-_SHRINK = """
+_RESIZE = """
 import jax
 jax.config.update("jax_platforms", "cpu")
 import sys
@@ -46,25 +49,36 @@ sys.path.insert(0, {repo!r})
 import numpy as np
 from deeplearning4j_tpu.parallel import ShardedTransformerLM, build_mesh
 
+# elastic resize {src_n}->{dst_n} devices: train, checkpoint params AND
+# optimizer state to host, rebuild on the new mesh, restore both, keep
+# training downhill — the slice-reconfiguration story in both directions
 devs = jax.devices()
-mesh16 = build_mesh({{"data": 2, "model": 2, "seq": 2, "pipe": 2}},
-                    devices=devs[:16])
-lm16 = ShardedTransformerLM(vocab_size=64, n_layers=4, d_model=32, n_heads=4,
-                            mesh=mesh16, max_len=16, seed=0)
+
+def make(axes, n):
+    mesh = build_mesh(axes, devices=devs[:n])
+    return ShardedTransformerLM(vocab_size=64, n_layers=4, d_model=32,
+                                n_heads=4, mesh=mesh, max_len=16, seed=0)
+
+src = make({src_axes!r}, {src_n})
 rng = np.random.default_rng(0)
 toks = rng.integers(0, 64, (8, 16))
 tgts = np.roll(toks, -1, axis=1)
-losses = [float(lm16.fit_batch(toks, tgts)) for _ in range(3)]
-host = jax.tree_util.tree_map(np.asarray, lm16.params)
-mesh8 = build_mesh({{"data": 2, "model": 2, "seq": 2, "pipe": 1}},
-                   devices=devs[:8])
-lm8 = ShardedTransformerLM(vocab_size=64, n_layers=4, d_model=32, n_heads=4,
-                           mesh=mesh8, max_len=16, seed=0)
-lm8.params = jax.device_put(
-    host, jax.tree_util.tree_map(lambda s: s.sharding, lm8.params))
-after = [float(lm8.fit_batch(toks, tgts)) for _ in range(2)]
+losses = [float(src.fit_batch(toks, tgts)) for _ in range(3)]
+host_params = jax.tree_util.tree_map(np.asarray, src.params)
+host_opt = jax.tree_util.tree_map(np.asarray, src.opt_state)
+dst = make({dst_axes!r}, {dst_n})
+dst.params = jax.device_put(
+    host_params, jax.tree_util.tree_map(lambda s: s.sharding, dst.params))
+dst.opt_state = jax.device_put(
+    host_opt, jax.tree_util.tree_map(lambda s: s.sharding, dst.opt_state))
+dst.iteration = src.iteration
+after = [float(dst.fit_batch(toks, tgts)) for _ in range(2)]
 assert all(np.isfinite(v) for v in losses + after)
 assert after[-1] < losses[0], (losses, after)  # training CONTINUED downhill
+# restored Adam moments are live, not zeros
+m0 = np.abs(np.asarray(
+    jax.tree_util.tree_leaves(host_opt)[0], dtype=np.float32)).max()
+assert m0 > 0, "source optimizer state was all zeros?"
 print("OK", losses, after)
 """
 
@@ -82,10 +96,21 @@ def _run(code, n_devices, timeout=900):
 @pytest.mark.parametrize("total,axes", [
     (16, {"data": 2, "model": 2, "seq": 2, "pipe": 2}),
     (32, {"data": 4, "model": 2, "seq": 2, "pipe": 2}),
+    (64, {"data": 4, "model": 2, "seq": 2, "pipe": 4}),
 ])
 def test_transformer_lm_all_axes_geq_2(total, axes):
     _run(_SCRIPT.format(repo=_REPO, total=total, axes=axes), total)
 
 
+_AXES_8 = {"data": 2, "model": 2, "seq": 2, "pipe": 1}
+_AXES_16 = {"data": 2, "model": 2, "seq": 2, "pipe": 2}
+
+
 def test_elastic_shrink_16_to_8_continues_training():
-    _run(_SHRINK.format(repo=_REPO), 16)
+    _run(_RESIZE.format(repo=_REPO, src_axes=_AXES_16, src_n=16,
+                        dst_axes=_AXES_8, dst_n=8), 16)
+
+
+def test_elastic_grow_8_to_16_continues_training():
+    _run(_RESIZE.format(repo=_REPO, src_axes=_AXES_8, src_n=8,
+                        dst_axes=_AXES_16, dst_n=16), 16)
