@@ -39,6 +39,18 @@ class PretrainedType:
     MNIST = "mnist"
     CIFAR10 = "cifar10"
     VGGFACE = "vggface"
+    IRIS = "iris"
+
+
+#: weights shipped IN the package (trained on real embedded data — no
+#: egress required): (model_name, pretrained_type) → (relative path under
+#: models/weights/, adler32 checksum).  Hosted large-model artifacts are
+#: an at-release task; this registry is the same seam they will use.
+#: iris_mlp: 4→16tanh→8tanh→3softmax trained on Fisher's Iris (the 150
+#: embedded rows, raw un-normalized features), 98.7% train accuracy.
+BUILTIN_WEIGHTS = {
+    ("iris_mlp", PretrainedType.IRIS): ("iris_mlp_iris.zip", 1686618174),
+}
 
 
 def cached_path(model_name: str, pretrained_type: str = PretrainedType.IMAGENET,
@@ -65,18 +77,40 @@ def init_pretrained(model_name: str,
                     local_file: Optional[str] = None):
     """Load a pretrained model (reference ZooModel.initPretrained:40-81).
 
-    Resolution order: explicit ``local_file``, then the cache.  When
-    ``expected_checksum`` is given and the cached file mismatches, it is
-    evicted and a clear error raised (the reference's corrupt-download
+    Resolution order: explicit ``local_file``, then the cache, then the
+    package's BUILTIN_WEIGHTS (checksum always enforced for builtins).
+    When ``expected_checksum`` is given and the cached file mismatches, it
+    is evicted and a clear error raised (the reference's corrupt-download
     retry, minus the download)."""
     from ..utils.serializer import load_model
 
     path = local_file or cached_path(model_name, pretrained_type, cache_dir)
     if not os.path.exists(path):
+        if local_file is not None:
+            # an explicitly-passed file must never silently fall through
+            # to different weights (e.g. a typoed fine-tune path loading
+            # the packaged artifact instead)
+            raise FileNotFoundError(f"local_file not found: {local_file}")
+        builtin = BUILTIN_WEIGHTS.get((model_name, pretrained_type))
+        if builtin is not None:
+            rel, want = builtin
+            path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "weights", rel)
+            got = checksum(path)
+            if got != want:
+                raise IOError(f"builtin weights {rel} corrupt: adler32 "
+                              f"{got} != {want}")
+            # the caller's explicit pin applies on EVERY resolution path
+            if expected_checksum is not None and got != expected_checksum:
+                raise IOError(
+                    f"checksum mismatch for builtin {rel}: expected "
+                    f"{expected_checksum}, got {got}")
+            return load_model(path)
         raise FileNotFoundError(
             f"no pretrained weights for '{model_name}' ({pretrained_type}) at "
             f"{path} — place the checkpoint zip there or pass local_file=/"
-            "install_weights(). (This build is zero-egress: no download URLs.)")
+            "install_weights(). (This build is zero-egress: no download URLs; "
+            f"builtins available: {sorted(BUILTIN_WEIGHTS)})")
     if expected_checksum is not None:
         got = checksum(path)
         if got != expected_checksum:

@@ -81,3 +81,59 @@ class TestZooTraining:
         }
         loss = net.fit_batch(DataSet(x, labels))
         assert np.isfinite(loss)
+
+
+class TestBuiltinPretrained:
+    """Round-5: a REAL shipped pretrained artifact — init_pretrained works
+    out of the box (reference ZooModel.initPretrained:40-81), trained on
+    the embedded public-domain Iris rows, checksum-enforced."""
+
+    def test_iris_mlp_loads_and_classifies(self, tmp_path):
+        from deeplearning4j_tpu.datasets import DataSet
+        from deeplearning4j_tpu.datasets.fetchers import load_iris
+        from deeplearning4j_tpu.models import PretrainedType, init_pretrained
+        # empty cache_dir: ambient ~/.deeplearning4j_tpu state must not
+        # shadow the builtin under test
+        net = init_pretrained("iris_mlp", PretrainedType.IRIS,
+                              cache_dir=str(tmp_path))
+        xs, ys = load_iris()
+        ds = DataSet(xs.astype(np.float32),
+                     np.eye(3, dtype=np.float32)[ys])
+        assert net.evaluate(ds).accuracy() > 0.97
+
+    def test_builtin_checksum_enforced(self, monkeypatch, tmp_path):
+        from deeplearning4j_tpu.models import pretrained as pt
+        monkeypatch.setitem(pt.BUILTIN_WEIGHTS,
+                            ("iris_mlp", "iris"),
+                            ("iris_mlp_iris.zip", 12345))
+        with pytest.raises(IOError, match="corrupt"):
+            pt.init_pretrained("iris_mlp", "iris", cache_dir=str(tmp_path))
+
+    def test_caller_pin_enforced_on_builtin_path(self, tmp_path):
+        from deeplearning4j_tpu.models import pretrained as pt
+        with pytest.raises(IOError, match="checksum mismatch"):
+            pt.init_pretrained("iris_mlp", "iris", expected_checksum=999,
+                               cache_dir=str(tmp_path))
+
+    def test_missing_local_file_never_falls_through(self, tmp_path):
+        from deeplearning4j_tpu.models import pretrained as pt
+        with pytest.raises(FileNotFoundError, match="local_file"):
+            pt.init_pretrained("iris_mlp", "iris",
+                               local_file=str(tmp_path / "typo.zip"))
+
+    def test_unknown_model_lists_builtins(self):
+        from deeplearning4j_tpu.models import init_pretrained
+        with pytest.raises(FileNotFoundError, match="iris_mlp"):
+            init_pretrained("nope_model", "imagenet")
+
+    def test_cache_still_takes_precedence(self, tmp_path):
+        """install_weights into a cache dir wins over the builtin."""
+        import os
+        from deeplearning4j_tpu.models import pretrained as pt
+        src = os.path.join(os.path.dirname(os.path.abspath(pt.__file__)),
+                           "weights", "iris_mlp_iris.zip")
+        cache = str(tmp_path / "cache")
+        pt.install_weights("iris_mlp", src, "iris", cache_dir=cache)
+        net = pt.init_pretrained("iris_mlp", "iris", cache_dir=cache,
+                                 expected_checksum=pt.checksum(src))
+        assert net.num_params() > 0
