@@ -1,11 +1,12 @@
-"""Benchmark suite — the 5 BASELINE.md configs + flash attention.
+"""Benchmark suite — the 5 BASELINE.md configs + TPU-first extensions.
 
 Primary (driver) metric: ResNet-50 training images/sec on one chip,
-printed as ONE JSON line on stdout (the driver's contract).  The 6-config
+printed as ONE JSON line on stdout (the driver's contract).  The 9-config
 protocol (BASELINE.md: MLP/MNIST, LeNet/CIFAR, ResNet-50, Word2Vec +
 LSTM char-RNN, sharded ResNet-50 with gradient allreduce; plus the
-TPU-first flash-attention fwd+bwd config) is measured post-compile as
-the best of three ~33-step steady-state windows (tunnel-spike robust —
+TPU-first flash-attention fwd+bwd, GPT-2-small TransformerLM, and
+measured-collective configs) is measured post-compile as the best of
+three-to-five ~20-33-step steady-state windows (tunnel-spike robust —
 see _steady_state) and written to ``bench_results.json`` / echoed on
 stderr, including:
   - mfu: model FLOPs utilization from XLA's compiled cost analysis vs the
