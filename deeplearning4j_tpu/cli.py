@@ -112,6 +112,8 @@ def _parse_mesh(spec: str) -> dict:
     for part in spec.split(","):
         name, _, size = part.partition("=")
         name = name.strip()
+        if name in axes:
+            raise SystemExit(f"bad --mesh {spec!r}: duplicate axis {name!r}")
         try:
             axes[name] = int(size)
         except ValueError:
@@ -156,8 +158,7 @@ def cmd_train(args) -> int:
             raise SystemExit(f"--batch-size {args.batch_size} not divisible "
                              f"by mesh data axis {dp}")
         full = [b for b in batches if len(b.features) == args.batch_size]
-        dropped = sum(len(b.features) for b in batches) - \
-            sum(len(b.features) for b in full)
+        dropped = len(xs) - len(full) * args.batch_size
         if not full:
             raise SystemExit(
                 f"dataset ({len(xs)} samples) has no full batch of "

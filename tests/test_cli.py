@@ -119,7 +119,7 @@ class TestMeshTraining:
 
     def test_bad_mesh_spec_rejected(self, blob_npz, conf_json):
         for bad in ("whatever", "data=four", "data=", "data=0", "data=-2",
-                    "model=0"):
+                    "model=0", "data=4,data=2"):
             with pytest.raises(SystemExit, match="bad --mesh"):
                 main(["train", "--config", conf_json, "--data", blob_npz,
                       "--batch-size", "32", "--mesh", bad])
