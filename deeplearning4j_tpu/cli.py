@@ -103,15 +103,33 @@ def _load_model(path: str):
     return load_model(path)
 
 
-def _parse_mesh(spec: str) -> dict:
-    """'data=4,model=2' → {"data": 4, "model": 2} (-1 = infer).  Resolves
-    -1 against the visible device count and guarantees a 'data' axis
+def _parse_mesh(spec: str) -> tuple:
+    """'data=4,model=2[,schedule=1f1b]' → ({"data": 4, "model": 2},
+    schedule) (-1 = infer; schedule defaults to "gpipe").  Resolves -1
+    against the visible device count and guarantees a 'data' axis
     (ShardedTrainer's batch sharding names it), so every failure mode
-    here is a clean one-line CLI error, not a jax traceback."""
+    here is a clean one-line CLI error, not a jax traceback.  The
+    ``schedule`` token picks the pipeline microbatch order for nets that
+    pipeline over a ``pipe`` axis (parallel/pipeline.py)."""
+    from .parallel.pipeline import SCHEDULES
+
     axes = {}
+    schedule = "gpipe"
+    seen_schedule = False
     for part in spec.split(","):
         name, _, size = part.partition("=")
         name = name.strip()
+        if name == "schedule":
+            if seen_schedule:
+                raise SystemExit(
+                    f"bad --mesh {spec!r}: duplicate schedule token")
+            if size.strip() not in SCHEDULES:
+                raise SystemExit(
+                    f"bad --mesh {spec!r}: schedule must be one of "
+                    f"{'/'.join(SCHEDULES)}, got {size.strip()!r}")
+            schedule = size.strip()
+            seen_schedule = True
+            continue
         if name in axes:
             raise SystemExit(f"bad --mesh {spec!r}: duplicate axis {name!r}")
         try:
@@ -123,7 +141,8 @@ def _parse_mesh(spec: str) -> dict:
             raise SystemExit(
                 f"bad --mesh {spec!r}: expected name=size[,name=size...] "
                 "with positive integer sizes (or one -1 to infer), "
-                "e.g. 'data=8' or 'data=4,model=2'")
+                "e.g. 'data=8', 'data=4,model=2' or "
+                "'data=2,pipe=4,schedule=1f1b'")
     axes.setdefault("data", 1)
     if list(axes.values()).count(-1) > 1:
         raise SystemExit(f"bad --mesh {spec!r}: at most one -1 (infer) axis")
@@ -139,7 +158,7 @@ def _parse_mesh(spec: str) -> dict:
             raise SystemExit(f"bad --mesh {spec!r}: cannot infer -1 axis "
                              f"from {n} device(s)")
         axes = {k: (n // known if s == -1 else s) for k, s in axes.items()}
-    return axes
+    return axes, schedule
 
 
 def cmd_train(args) -> int:
@@ -149,7 +168,7 @@ def cmd_train(args) -> int:
     net = _build_model(args)
     xs, ys = _load_data(args.data, train=True, num_classes=_num_classes_of(net))
     batches = DataSet(xs, ys).shuffle(args.seed).batch_by(args.batch_size)
-    mesh_axes = _parse_mesh(args.mesh) if args.mesh else None
+    mesh_axes, schedule = _parse_mesh(args.mesh) if args.mesh else (None, "gpipe")
     if mesh_axes:
         # XLA needs static shapes divisible by the data axis — drop the
         # ragged tail batch instead of erroring mid-epoch
@@ -193,8 +212,10 @@ def cmd_train(args) -> int:
             raise SystemExit(f"--mesh {args.mesh!r} needs {total} device(s), "
                              f"found {jax.device_count()}")
         mesh = build_mesh(mesh_axes, devices=jax.devices()[:total])
-        trainer = ShardedTrainer(net, mesh)
-        print(f"mesh: {dict(mesh.shape)} over {mesh.devices.size} device(s)")
+        trainer = ShardedTrainer(net, mesh, pipeline_schedule=schedule)
+        print(f"mesh: {dict(mesh.shape)} over {mesh.devices.size} device(s)"
+              + (f", pipeline schedule {schedule}" if schedule != "gpipe"
+                 else ""))
     losses = (trainer.fit(it, epochs=args.epochs) if trainer
               else net.fit(it, epochs=args.epochs))
     print(f"trained {args.epochs} epoch(s), {len(losses)} iterations, "
@@ -259,7 +280,9 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--dashboard", help="HTML training report to write")
     t.add_argument("--mesh", help="train sharded over a named device mesh, "
                    "e.g. 'data=8' or 'data=4,model=2' (the reference's "
-                   "ParallelWrapperMain role)")
+                   "ParallelWrapperMain role); an optional "
+                   "'schedule=gpipe|1f1b' token picks the pipeline "
+                   "microbatch order for pipe-axis nets")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("evaluate", help="evaluate a saved model")

@@ -36,9 +36,11 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from ..utils.jax_compat import shard_map
 from .sequencevectors import _sg_pair_grads
 from .word2vec import Word2Vec
 
@@ -79,7 +81,7 @@ def make_dp_sg_step(mesh: Mesh, data_axis: str = "data"):
     # (tiled all_gather), so the scatter-added tables ARE replicated — the
     # static varying-across-mesh inference just can't prove it; the
     # exact-parity tests (test_nlp_distributed.py) pin the semantics.
-    sharded = jax.shard_map(
+    sharded = shard_map(
         shard_fn, mesh=mesh,
         in_specs=(P(), P(), P(data_axis), P(data_axis), P(data_axis),
                   P(data_axis), P()),

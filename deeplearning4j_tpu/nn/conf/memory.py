@@ -90,8 +90,9 @@ def _updater_state_bytes(updater, pcount: int, param_elem_bytes: int) -> int:
 
 
 def _updater_copies(updater) -> int:
-    """Optimizer-state copies of the params (Adam/AdaMax/Nadam/AMSGrad → 2,
-    momentum-family/AdaGrad/RmsProp → 1, Sgd/NoOp → 0)."""
+    """Optimizer-state copies of the params (Adam/AdaMax/Nadam → 2,
+    AMSGrad → 3 — m, v, AND the running max-v — momentum-family/AdaGrad/
+    RmsProp → 1, Sgd/NoOp → 0)."""
     name = type(updater).__name__.lower()
     if name in ("adam", "adamax", "nadam"):
         return 2

@@ -24,6 +24,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from ..utils.jax_compat import vma_of
+
 Array = jax.Array
 
 _NEG_INF = -1e30  # large-finite: keeps padded/causal-masked rows NaN-free
@@ -200,7 +202,16 @@ def _vma(x):
     """Varying-across-mesh-axes of ``x`` (frozenset; empty outside
     shard_map) — pallas out_shapes must carry it so the kernels trace
     under shard_map's check_vma (ulysses/pipelined attention)."""
-    return getattr(jax.typeof(x), "vma", frozenset())
+    return vma_of(x)
+
+
+def _out_struct(shape, dtype, like):
+    """ShapeDtypeStruct carrying ``like``'s vma where the jax version
+    types it (pre-vma jax has no ``vma=`` kwarg and needs none)."""
+    try:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=_vma(like))
+    except TypeError:
+        return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _kernel_eligible(q, block_q: int, block_k: int) -> bool:
@@ -264,8 +275,8 @@ def _flash_forward(q: Array, k: Array, v: Array, kmask, causal: bool,
             pl.BlockSpec((1, 1, block_q), lambda b, i, j: (b, 0, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B * H, T, D), q.dtype, vma=_vma(q)),
-            jax.ShapeDtypeStruct((B * H, 1, T), jnp.float32, vma=_vma(q)),
+            _out_struct((B * H, T, D), q.dtype, q),
+            _out_struct((B * H, 1, T), jnp.float32, q),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, D), jnp.float32),
@@ -428,8 +439,8 @@ def _flash_backward(q, k, v, kmask, o, lse, g, causal, scale):
         in_specs=specs_kv,
         out_specs=[pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0)),
                    pl.BlockSpec((1, block_k, D), lambda b, i, j: (b, i, 0))],
-        out_shape=[jax.ShapeDtypeStruct((B * H, S, D), k.dtype, vma=_vma(k)),
-                   jax.ShapeDtypeStruct((B * H, S, D), v.dtype, vma=_vma(v))],
+        out_shape=[_out_struct((B * H, S, D), k.dtype, k),
+                   _out_struct((B * H, S, D), v.dtype, v)],
         scratch_shapes=[pltpu.VMEM((block_k, D), jnp.float32),
                         pltpu.VMEM((block_k, D), jnp.float32)],
         interpret=interp,
@@ -457,7 +468,7 @@ def _flash_backward(q, k, v, kmask, o, lse, g, causal, scale):
         grid=(B * H, T // block_q, S // block_k),
         in_specs=specs_q,
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((B * H, T, D), q.dtype, vma=_vma(q)),
+        out_shape=_out_struct((B * H, T, D), q.dtype, q),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         interpret=interp,
     )(*args_q)

@@ -23,7 +23,10 @@ from .trainer import ShardedTrainer
 from .inference import ParallelInference
 from .ring import ring_attention, ring_self_attention
 from .ulysses import ulysses_attention, ulysses_self_attention
-from .pipeline import pipeline_apply, stack_stage_params, stage_sharding
+from .pipeline import (
+    pipeline_apply, pipeline_schedule_stats, stack_stage_params,
+    stage_sharding,
+)
 from .transformer import ShardedTransformerLM
 from .elastic import CheckpointManager, ElasticTrainer, FailureDetector
 from .moe import MoE, init_moe_params, moe_forward_dense, moe_forward_ep

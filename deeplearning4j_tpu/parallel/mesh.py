@@ -18,6 +18,9 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..utils.jax_compat import vary_over  # noqa: F401  (re-export: the
+# historical home of vary_over; pipeline/ring import it from here)
+
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
@@ -63,19 +66,6 @@ def put_global(arr, sharding: NamedSharding):
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
-
-
-def vary_over(x, axes: Sequence[str]):
-    """Mark ``x`` as device-varying over ``axes`` it isn't already varying
-    on (shard_map vma typing for zero-init scan carries).  Uses
-    ``jax.lax.pcast`` where available (pvary is deprecated in jax ≥0.9)."""
-    have = jax.typeof(x).vma
-    need = tuple(a for a in axes if a not in have)
-    if not need:
-        return x
-    if hasattr(jax.lax, "pcast"):
-        return jax.lax.pcast(x, need, to="varying")
-    return jax.lax.pvary(x, need)
 
 
 def shard_batch(mesh: Mesh, batch_axis: str = DATA_AXIS):

@@ -30,8 +30,10 @@ from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
+from ..utils.jax_compat import shard_map
 
 Array = jax.Array
 
@@ -153,7 +155,7 @@ def moe_forward_ep(params: Dict[str, Array], x: Array, mesh: Mesh,
         y = jax.lax.psum(y_local, expert_axis)
         return y, jax.lax.psum(aux, expert_axis)
 
-    fn = jax.shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
+    fn = shard_map(shard_fn, mesh=mesh, in_specs=in_specs,
                        out_specs=out_specs)
     return fn(params, x)
 

@@ -16,7 +16,10 @@ build the mesh over jax.devices() spanning all hosts.
 
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List, Optional, TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from ..optimize.score import LazyScore
 
 import jax
 import jax.numpy as jnp
@@ -24,6 +27,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..datasets.dataset import DataSet
+from ..utils.jax_compat import set_mesh
 from ..datasets.iterators import DataSetIterator
 from .mesh import (
     DATA_AXIS, MODEL_AXIS, build_mesh, infer_param_shardings, put_global,
@@ -43,11 +47,23 @@ class ShardedTrainer:
     """
 
     def __init__(self, net, mesh: Optional[Mesh] = None,
-                 data_axis: str = DATA_AXIS, model_axis: str = MODEL_AXIS):
+                 data_axis: str = DATA_AXIS, model_axis: str = MODEL_AXIS,
+                 pipeline_schedule: str = "gpipe"):
+        from .pipeline import SCHEDULES
+        if pipeline_schedule not in SCHEDULES:
+            raise ValueError(f"pipeline_schedule must be one of {SCHEDULES}, "
+                             f"got {pipeline_schedule!r}")
         self.net = net
         self.mesh = mesh if mesh is not None else build_mesh()
         self.data_axis = data_axis
         self.model_axis = model_axis
+        # microbatch order for nets that pipeline over a `pipe` axis
+        # (parallel/pipeline.py): forwarded to the wrapped net when it
+        # carries a schedule knob (ShardedTransformerLM); layer-stack nets
+        # without a pipe dimension ignore it
+        self.pipeline_schedule = pipeline_schedule
+        if hasattr(net, "schedule"):
+            net.schedule = pipeline_schedule
         self.batch_sharding = NamedSharding(self.mesh, P(data_axis))
         self._place_model()
 
@@ -137,13 +153,15 @@ class ShardedTrainer:
 
     def fit_batch(self, ds: DataSet) -> float:
         """One global step: batch split over data axis, grads psum'd by GSPMD."""
-        with jax.sharding.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             return self.net.fit_batch(self.shard_dataset(ds))
 
-    def fit_batches(self, batches) -> List[float]:
+    def fit_batches(self, batches) -> List["LazyScore"]:
         """k steps in ONE dispatch (the container's scanned multi-step),
-        each batch data-sharded on the mesh.  Returns [k] LazyScores."""
-        with jax.sharding.set_mesh(self.mesh):
+        each batch data-sharded on the mesh.  Returns [k] LazyScores
+        (device-resident; float() forces the readback — the fit_batch
+        contract)."""
+        with set_mesh(self.mesh):
             return self.net.fit_batches(
                 [self.shard_dataset(ds) for ds in batches])
 
@@ -160,5 +178,5 @@ class ShardedTrainer:
         return losses
 
     def output(self, x, **kw):
-        with jax.sharding.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             return self.net.output(self._shard_batch_arr(x), **kw)

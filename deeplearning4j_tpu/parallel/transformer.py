@@ -9,8 +9,9 @@ per step in which
     (Megatron-style column/row split with an explicit psum),
   - ``seq``   shards the sequence; attention runs as ring attention with
     K/V blocks rotating over ICI (parallel/ring.py),
-  - ``pipe``  pipelines the homogeneous block stack with a GPipe
-    microbatch schedule (parallel/pipeline.py).
+  - ``pipe``  pipelines the homogeneous block stack with a GPipe or
+    interleaved-1F1B microbatch schedule (parallel/pipeline.py,
+    ``schedule=`` ctor flag).
 
 Embedding/head run under GSPMD outside the manual shard_map island; the
 block math is models/transformer.block_apply — the same function the
@@ -28,8 +29,9 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models.transformer import block_apply, block_params
+from ..utils.jax_compat import set_mesh
 from ..nn.updaters import Adam
-from .pipeline import pipeline_apply, stack_stage_params
+from .pipeline import SCHEDULES, pipeline_apply, stack_stage_params
 from .ring import ring_attention
 
 Array = jax.Array
@@ -62,7 +64,7 @@ class ShardedTransformerLM:
                  n_heads: int, mesh: Mesh, d_ff: int = 0, max_len: int = 512,
                  n_microbatches: int = 2, seed: int = 0, updater=None,
                  compute_dtype=None, seq_parallel: str = "ring",
-                 attention_impl: str = "flash"):
+                 attention_impl: str = "flash", schedule: str = "gpipe"):
         d_ff = d_ff or 4 * d_model
         # normalize to the canonical 4-axis mesh (absent axes = size 1) so
         # specs/collectives can reference every axis unconditionally
@@ -101,6 +103,13 @@ class ShardedTransformerLM:
         if n_layers % mesh.shape.get("pipe", 1):
             raise ValueError(
                 f"n_layers {n_layers} not divisible by pipe={mesh.shape['pipe']}")
+        if schedule not in SCHEDULES:
+            raise ValueError(f"schedule must be one of {SCHEDULES}, "
+                             f"got {schedule!r}")
+        # microbatch order on the pipe axis: "gpipe" = all-forward-then-
+        # all-backward; "1f1b" = interleaved, depth-bounded activation
+        # memory at a recompute cost (parallel/pipeline.py docstring)
+        self.schedule = schedule
         self.mesh = mesh
         self.vocab_size = vocab_size
         self.n_heads = n_heads
@@ -198,6 +207,7 @@ class ShardedTransformerLM:
             h = pipeline_apply(
                 lambda p, h: block_fn(p, h), blocks, h, self.mesh,
                 n_microbatches=self.n_microbatches,
+                schedule=self.schedule,
                 param_specs=self.block_specs,
                 x_spec=P("data", "seq", None))
         from ..nn.layers.normalization import layer_norm
@@ -230,7 +240,7 @@ class ShardedTransformerLM:
             self._jit_step = self._build_step()
         tokens = jax.device_put(jnp.asarray(tokens, jnp.int32), self.token_sharding)
         targets = jax.device_put(jnp.asarray(targets, jnp.int32), self.token_sharding)
-        with jax.sharding.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             self.params, self.opt_state, loss = self._jit_step(
                 self.params, self.opt_state,
                 jnp.asarray(self.iteration, jnp.int32), tokens, targets)
@@ -273,7 +283,7 @@ class ShardedTransformerLM:
         tokens = jax.device_put(jnp.asarray(tokens, jnp.int32), stacked)
         targets = jax.device_put(jnp.asarray(targets, jnp.int32), stacked)
         k = tokens.shape[0]
-        with jax.sharding.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             self.params, self.opt_state, losses = self._jit_multi_step(
                 self.params, self.opt_state,
                 jnp.asarray(self.iteration, jnp.int32), tokens, targets)
@@ -285,5 +295,5 @@ class ShardedTransformerLM:
         if self._jit_logits is None:
             self._jit_logits = jax.jit(self._forward)
         tokens = jax.device_put(jnp.asarray(tokens, jnp.int32), self.token_sharding)
-        with jax.sharding.set_mesh(self.mesh):
+        with set_mesh(self.mesh):
             return self._jit_logits(self.params, tokens)

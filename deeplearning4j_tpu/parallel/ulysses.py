@@ -25,9 +25,11 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.attention import flash_mha
+from ..utils.jax_compat import axis_size, shard_map
 
 Array = jax.Array
 
@@ -43,7 +45,7 @@ def ulysses_attention(q: Array, k: Array, v: Array, axis_name: str,
     local slice of the key-padding mask.  H must divide by the axis size.
     Returns [B, H, T_local, D] sharded the same way.
     """
-    p = jax.lax.axis_size(axis_name)
+    p = axis_size(axis_name)
     h = q.shape[1]
     if h % p:
         raise ValueError(f"n_heads {h} not divisible by '{axis_name}' axis "
@@ -82,7 +84,7 @@ def ulysses_self_attention(q: Array, k: Array, v: Array, mesh: Mesh,
     spec = P(None, None, seq_axis, None)
     mspec = P(None, seq_axis)
     if kmask is None:
-        fn = jax.shard_map(
+        fn = shard_map(
             functools.partial(ulysses_attention, axis_name=seq_axis,
                               causal=causal, scale=scale),
             mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
@@ -92,6 +94,6 @@ def ulysses_self_attention(q: Array, k: Array, v: Array, mesh: Mesh,
         return ulysses_attention(q, k, v, seq_axis, causal=causal,
                                  scale=scale, kmask=m)
 
-    fn = jax.shard_map(body, mesh=mesh,
+    fn = shard_map(body, mesh=mesh,
                        in_specs=(spec, spec, spec, mspec), out_specs=spec)
     return fn(q, k, v, kmask)

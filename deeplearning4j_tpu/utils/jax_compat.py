@@ -1,0 +1,101 @@
+"""jax version portability for the scale-out stack.
+
+The framework targets the modern jax surface (``jax.shard_map``,
+``jax.typeof(...).vma``, ``jax.sharding.set_mesh``) but must also run on
+the jax 0.4.x line some environments bake in, where shard_map still lives
+in ``jax.experimental`` (with ``check_rep`` instead of ``check_vma``),
+varying-across-mesh typing does not exist, and there is no ambient-mesh
+setter.  Every parallel/ module routes through these shims instead of
+touching the moving names directly; on a current jax they are zero-cost
+pass-throughs.
+
+Semantics notes for the 0.4.x path:
+  - ``check_vma=False`` maps to ``check_rep=False``; the default (vma
+    checking ON) also maps to ``check_rep=False`` — 0.4.x's replication
+    checker predates several collective transpose rules the pipeline and
+    ring layers rely on, while the *math* is unaffected (grad parity is
+    pinned by tests/test_parallelism_4d.py and tests/test_parallel.py).
+  - vma typing degrades to "unknown": ``vma_of`` returns an empty
+    frozenset and ``vary_over`` is the identity, which is exactly what a
+    backend without the typing discipline expects.
+  - ``set_mesh`` enters the plain ``Mesh`` context manager — enough for
+    the NamedSharding-carrying jit calls the trainers make.
+"""
+
+from __future__ import annotations
+
+import contextlib
+
+import jax
+
+__all__ = ["axis_size", "enable_x64", "shard_map", "set_mesh", "vma_of",
+           "vary_over"]
+
+_HAS_NEW_SHARD_MAP = hasattr(jax, "shard_map")
+_HAS_TYPEOF = hasattr(jax, "typeof")
+
+
+if _HAS_NEW_SHARD_MAP:
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+        kw = {} if check_vma is None else {"check_vma": check_vma}
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, **kw)
+else:
+    from jax.experimental.shard_map import shard_map as _shard_map_04x
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None):
+        return _shard_map_04x(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=False)
+
+shard_map.__doc__ = """``jax.shard_map`` across jax versions.
+
+Keyword-only, mirroring the modern signature; ``check_vma=None`` means
+"library default".  See the module docstring for the 0.4.x mapping."""
+
+
+if hasattr(jax.sharding, "set_mesh"):
+    set_mesh = jax.sharding.set_mesh
+else:
+    @contextlib.contextmanager
+    def set_mesh(mesh):
+        """Ambient-mesh context for jax without ``jax.sharding.set_mesh``."""
+        with mesh:
+            yield mesh
+
+
+if hasattr(jax, "enable_x64"):
+    enable_x64 = jax.enable_x64
+else:
+    from jax.experimental import enable_x64  # noqa: F401  (0.4.x home)
+
+
+def axis_size(name):
+    """``jax.lax.axis_size`` (0.4.x spells it ``psum(1, name)`` — the
+    classic static-size idiom; the literal 1 folds to the axis size)."""
+    if hasattr(jax.lax, "axis_size"):
+        return jax.lax.axis_size(name)
+    return jax.lax.psum(1, name)
+
+
+def vma_of(x):
+    """The varying-across-mesh axis set of ``x`` (empty frozenset outside
+    shard_map or on jax without vma typing)."""
+    if not _HAS_TYPEOF:
+        return frozenset()
+    return getattr(jax.typeof(x), "vma", frozenset())
+
+
+def vary_over(x, axes):
+    """Mark ``x`` as device-varying over ``axes`` it isn't already varying
+    on (shard_map vma typing for zero-init scan carries).  Uses
+    ``jax.lax.pcast`` where available (pvary is deprecated in jax ≥0.9);
+    identity on jax without vma typing."""
+    if not _HAS_TYPEOF:
+        return x
+    have = vma_of(x)
+    need = tuple(a for a in axes if a not in have)
+    if not need:
+        return x
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, need, to="varying")
+    return jax.lax.pvary(x, need)

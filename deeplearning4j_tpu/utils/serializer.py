@@ -27,7 +27,13 @@ import jax.numpy as jnp
 import ml_dtypes
 import numpy as np
 
-FORMAT_VERSION = 1
+# 1: original format (f32/f64 leaves only).
+# 2: bf16 leaves are stored as their raw bits viewed uint16, under the
+#    entry name "<path>#bfloat16" (np.savez cannot round-trip bf16) —
+#    version-1 readers would surface them as missing keys, so the format
+#    version records the suffix scheme.  Loading v1 zips stays supported.
+FORMAT_VERSION = 2
+SUPPORTED_VERSIONS = (1, 2)
 
 
 def _flatten_tree(tree, prefix="") -> Dict[str, np.ndarray]:
@@ -77,7 +83,10 @@ def _unflatten_into(template, flat: Dict[str, np.ndarray], prefix=""):
         return jnp.asarray(flat[key])
     if key + "#bfloat16" in flat:
         return jnp.asarray(flat[key + "#bfloat16"].view(ml_dtypes.bfloat16))
-    raise KeyError(f"checkpoint missing parameter '{key}'")
+    raise KeyError(
+        f"checkpoint missing parameter '{key}' (format v{FORMAT_VERSION} "
+        f"stores bf16 leaves uint16-viewed under '<name>#bfloat16' — a "
+        f"checkpoint written by a newer format or a mismatched config?)")
 
 
 def save_model(net, path: str, save_updater: bool = True) -> None:
@@ -99,6 +108,12 @@ def load_model(path: str, load_updater: bool = True):
     with zipfile.ZipFile(path, "r") as zf:
         conf_d = json.loads(zf.read("configuration.json"))
         meta = json.loads(zf.read("meta.json"))
+        ver = meta.get("format_version", 1)
+        if ver not in SUPPORTED_VERSIONS:
+            raise ValueError(
+                f"checkpoint format v{ver} not supported (reader knows "
+                f"{SUPPORTED_VERSIONS}); re-save with a matching framework "
+                "version")
         params_flat = _load_npz(zf.read("params.npz"))
         state_flat = _load_npz(zf.read("state.npz"))
         upd_flat = _load_npz(zf.read("updater.npz")) if (

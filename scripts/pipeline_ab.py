@@ -1,0 +1,121 @@
+"""Pipeline-schedule A/B: GPipe vs 1F1B at the transformer-LM shape.
+
+Runs in its own process on a virtual multi-device CPU mesh (a pipe axis
+needs >1 device; the bench box has one chip), so the comparison is
+schedule-vs-schedule under identical placement — relative step time and
+measured peak memory are meaningful even though the absolute CPU numbers
+are not TPU numbers.  Measures, per schedule:
+
+  - steady-state step time (best window, the bench.py protocol)
+  - measured peak temp memory of the compiled train step
+    (``compiled.memory_analysis().temp_size_in_bytes`` — the activation
+    checkpoints live there)
+  - analytic bubble fraction + peak-activation accounting
+    (``pipeline_schedule_stats``)
+
+and asserts first-step loss parity bit-for-bit.  Prints ONE JSON line on
+stdout (bench.py's subprocess contract).  Usage:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=4 \\
+        JAX_PLATFORMS=cpu python scripts/pipeline_ab.py [--quick]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+QUICK = "--quick" in sys.argv or os.environ.get("BENCH_QUICK", "0") == "1"
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+
+def main() -> None:
+    from deeplearning4j_tpu.parallel import ShardedTransformerLM, build_mesh
+    from deeplearning4j_tpu.parallel.pipeline import pipeline_schedule_stats
+
+    n_pipe = 4
+    if len(jax.devices()) < n_pipe:
+        raise SystemExit(f"need {n_pipe} devices "
+                         f"(--xla_force_host_platform_device_count)")
+    # transformer-LM shape, CPU-scaled: the SCHEDULE comparison needs the
+    # block structure (attention + 4x FFN + residuals) and M > S, not the
+    # GPT-2 widths
+    L, D, H, T, V = 8, 128, 8, 128, 256
+    B, M = 16, 8
+    steps = 4 if QUICK else 12
+    if QUICK:
+        L, D, T, B, M = 4, 64, 64, 8, 8
+
+    mesh = build_mesh({"data": 1, "model": 1, "seq": 1, "pipe": n_pipe},
+                      devices=jax.devices()[:n_pipe])
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, V, (B, T))
+    tgts = np.roll(toks, -1, axis=1)
+
+    d_ff = 4 * D
+    # per-layer residuals the gpipe scan checkpoints, in stage-input units
+    # ([mb, T, D] activations): ln1/ln2 outs, q, k, v, attention out,
+    # post-attn residual, FFN in — ~8 D-wide — plus the two d_ff-wide gelu
+    # tensors
+    residual_factor = 8 + 2 * d_ff / D
+    stage_input_bytes = (B // M) * T * D * 4
+
+    out = {"config": "pipeline_schedules", "platform": "cpu-virtual",
+           "n_devices": n_pipe, "n_stages": n_pipe, "n_microbatches": M,
+           "n_layers": L, "d_model": D, "seq_len": T, "batch": B}
+    losses = {}
+    for sched in ("gpipe", "1f1b"):
+        lm = ShardedTransformerLM(vocab_size=V, n_layers=L, d_model=D,
+                                  n_heads=H, mesh=mesh, max_len=T,
+                                  n_microbatches=M, seed=0, schedule=sched)
+        t0 = time.perf_counter()
+        losses[sched] = [float(lm.fit_batch(toks, tgts))]
+        compile_s = time.perf_counter() - t0
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss = lm.fit_batch(toks, tgts)
+            float(loss)
+            best = min(best, (time.perf_counter() - t0) / steps)
+        temp_mb = None
+        try:
+            ma = lm._jit_step.lower(
+                lm.params, lm.opt_state, jnp.asarray(0, jnp.int32),
+                jnp.asarray(toks, jnp.int32), jnp.asarray(tgts, jnp.int32),
+            ).compile().memory_analysis()
+            temp_mb = round(ma.temp_size_in_bytes / 1e6, 2)
+        except Exception as e:  # a missing analysis must not kill the A/B
+            out[f"{sched}_memory_analysis_error"] = f"{type(e).__name__}: {e}"[:120]
+        stats = pipeline_schedule_stats(
+            sched, M, n_pipe, layers_per_stage=L // n_pipe,
+            residual_factor=residual_factor,
+            stage_input_bytes=stage_input_bytes)
+        out[sched] = {
+            "tokens_per_sec": round(B * T / best, 1),
+            "step_sec": round(best, 4),
+            "compile_sec": round(compile_s, 1),
+            "first_loss": losses[sched][0],
+            "measured_peak_temp_mb": temp_mb,
+            "bubble_fraction": round(stats["bubble_fraction"], 4),
+            "peak_live_stage_inputs": stats["peak_live_stage_inputs"],
+            "analytic_peak_activation_mb": round(
+                stats["peak_activation_bytes"] / 1e6, 2),
+        }
+    out["loss_parity_bitwise"] = losses["gpipe"][0] == losses["1f1b"][0]
+    g, f = out["gpipe"], out["1f1b"]
+    if g["measured_peak_temp_mb"] and f["measured_peak_temp_mb"]:
+        out["peak_temp_ratio_1f1b_vs_gpipe"] = round(
+            f["measured_peak_temp_mb"] / g["measured_peak_temp_mb"], 3)
+    out["step_time_ratio_1f1b_vs_gpipe"] = round(
+        f["step_sec"] / g["step_sec"], 3)
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

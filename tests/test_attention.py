@@ -20,6 +20,7 @@ from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, NeuralNetConfigu
 from deeplearning4j_tpu.nn.updaters import Adam, NoOp
 from deeplearning4j_tpu.ops.attention import flash_mha, mha
 from deeplearning4j_tpu.utils.gradient_check import check_gradients
+from deeplearning4j_tpu.utils.jax_compat import enable_x64
 
 RNG = np.random.default_rng(7)
 
@@ -139,7 +140,7 @@ def _net(layers, input_type):
         b.layer(l)
     b.set_input_type(input_type)
     net = MultiLayerNetwork(b.build())
-    with jax.enable_x64(True):
+    with enable_x64(True):
         net.init()
     return net
 
@@ -149,7 +150,7 @@ class TestSelfAttentionLayer:
         net = _net([SelfAttention(n_out=8, n_heads=2, kernel="xla"),
                     RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent")],
                    InputType.recurrent(6, 8))
-        with jax.enable_x64(True):
+        with enable_x64(True):
             assert check_gradients(net, _seq_data(), epsilon=1e-6,
                                    max_rel_error=1e-4, verbose=True)
 
@@ -157,7 +158,7 @@ class TestSelfAttentionLayer:
         net = _net([SelfAttention(n_out=8, n_heads=2, causal=True, kernel="xla"),
                     RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent")],
                    InputType.recurrent(6, 8))
-        with jax.enable_x64(True):
+        with enable_x64(True):
             assert check_gradients(net, _seq_data(), epsilon=1e-6,
                                    max_rel_error=1e-4, verbose=True)
 
@@ -182,7 +183,7 @@ class TestSelfAttentionLayer:
                     RnnOutputLayer(n_out=3, activation="softmax", loss="mcxent")],
                    InputType.recurrent(6, 8))
         x = RNG.normal(size=(1, 8, 6))
-        with jax.enable_x64(True):
+        with enable_x64(True):
             out1 = np.asarray(net.output(x))
             x2 = x.copy()
             x2[:, 5:] = 99.0  # corrupt the future
@@ -196,7 +197,7 @@ class TestSelfAttentionLayer:
         x = RNG.normal(size=(2, 8, 6))
         mask = np.ones((2, 8), np.float32)
         mask[:, 6:] = 0.0
-        with jax.enable_x64(True):
+        with enable_x64(True):
             out1 = np.asarray(net.output(x, mask=mask))
             x2 = x.copy()
             x2[:, 6:] = 123.0  # corrupt masked-out steps
@@ -216,7 +217,7 @@ class TestLearnedSelfAttention:
                     RnnOutputLayer(n_out=2, activation="softmax", loss="mcxent")],
                    InputType.recurrent(6, 10))
         x = RNG.normal(size=(4, 10, 6))
-        with jax.enable_x64(True):
+        with enable_x64(True):
             out = np.asarray(net.output(x))
         assert out.shape == (4, 3, 2)
 
@@ -226,6 +227,6 @@ class TestLearnedSelfAttention:
                    InputType.recurrent(6, 8))
         x = RNG.normal(size=(4, 8, 6))
         y = np.eye(3)[RNG.integers(0, 3, (4, 2))]
-        with jax.enable_x64(True):
+        with enable_x64(True):
             assert check_gradients(net, DataSet(x, y), epsilon=1e-6,
                                    max_rel_error=1e-4, verbose=True)

@@ -19,6 +19,7 @@ from deeplearning4j_tpu.nn.layers import (
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork, NeuralNetConfiguration
 from deeplearning4j_tpu.nn.updaters import NoOp
 from deeplearning4j_tpu.utils.gradient_check import check_gradients
+from deeplearning4j_tpu.utils.jax_compat import enable_x64
 
 RNG = np.random.default_rng(12345)
 
@@ -29,13 +30,13 @@ def _net(layers, input_type):
         b.layer(l)
     b.set_input_type(input_type)
     net = MultiLayerNetwork(b.build())
-    with jax.enable_x64(True):
+    with enable_x64(True):
         net.init()
     return net
 
 
 def _check(net, ds, **kw):
-    with jax.enable_x64(True):
+    with enable_x64(True):
         ok = check_gradients(net, ds, epsilon=1e-6, max_rel_error=1e-4,
                              verbose=True, **kw)
     assert ok
