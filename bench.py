@@ -26,6 +26,10 @@ stderr, including:
   - pipeline_1f1b_*: GPipe-vs-1F1B schedule A/B (bubble fraction, peak
     activation memory analytic+measured) on a virtual 4-device CPU mesh
     via scripts/pipeline_ab.py
+  - compressed_wire_bytes_est + grad_compression_wire_ratio: the DCN-tier
+    compressed gradient exchange — per-step wire bytes at the threshold
+    default, and the dense/compressed A/B on a virtual 2-slice mesh via
+    scripts/compression_ab.py, hard-gated at >=8x with loss parity
 
 BASELINE.md: the reference publishes NO numbers; the driver target is
 >=0.8x per-chip of H100+nd4j-cuda on ResNet-50 ≈ 2000 img/s.
@@ -305,6 +309,18 @@ def _param_bytes(net) -> int:
                for l in jax.tree_util.tree_leaves(net.params))
 
 
+def _compressed_wire_bytes(net) -> int:
+    """Per-step DCN wire bytes if the model's gradient crossed a 2-slice
+    dcn axis threshold-compressed (ops/compression accounting)."""
+    import jax
+
+    from deeplearning4j_tpu.ops.compression import compression_stats
+
+    n = sum(l.size for l in jax.tree_util.tree_leaves(net.params))
+    return compression_stats(n, "threshold",
+                             n_slices=2)["compressed_wire_bytes_per_step"]
+
+
 def _flops_per_step(net, x, y):
     """XLA's own cost analysis of the compiled train step (None if the
     backend doesn't report it)."""
@@ -419,6 +435,11 @@ def bench_resnet50(platform: str):
     # bench_collective for the measured rate)
     out["allreduce_traffic_gbps_est"] = round(
         2 * _param_bytes(net) / sec / 1e9, 3)
+    # ...and what the CROSS-SLICE tier of that exchange would put on the
+    # DCN with threshold compression on (grad_compression="threshold",
+    # 2-slice accounting; ops/compression.py) — the wire the compressed
+    # exchange exists for
+    out["compressed_wire_bytes_est"] = _compressed_wire_bytes(net)
     return out
 
 
@@ -530,7 +551,8 @@ def bench_sharded_resnet(platform: str):
     return {"metric": "sharded_resnet50_images_per_sec",
             "value": round(batch / sec, 2), "unit": "images/sec",
             "n_devices": n_dev,
-            "allreduce_traffic_gbps_est": round(grad_bytes / sec / 1e9, 3)}
+            "allreduce_traffic_gbps_est": round(grad_bytes / sec / 1e9, 3),
+            "compressed_wire_bytes_est": _compressed_wire_bytes(net)}
 
 
 def bench_collective(n_params: int = 25_600_000):
@@ -792,6 +814,54 @@ def bench_pipeline_schedules():
                 ab.get("peak_temp_ratio_1f1b_vs_gpipe")}
 
 
+def bench_grad_compression():
+    """Config 10: dense vs threshold/bitmap DCN gradient exchange on a
+    virtual 2-slice mesh (scripts/compression_ab.py; the dryrun-harness
+    subprocess mechanism — a dcn axis needs >1 slice).  The deliverables
+    are the wire-bytes ratio and loss-curve parity; the absolute CPU step
+    time is NOT a TPU figure and is labeled as such.  HARD gates (the
+    satellite's regression contract): the threshold arm's wire ratio must
+    be >=8x, the error-feedback loss curves must stay within tolerance of
+    dense, and grad_compression=None must be bit-identical to the
+    unadorned trainer — a silent miss on any of these is a correctness
+    regression, not a perf note."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env["JAX_PLATFORMS"] = "cpu"
+    script = os.path.join(_REPO, "scripts", "compression_ab.py")
+    cmd = [sys.executable, script] + (["--quick"] if QUICK else [])
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=1800, cwd=_REPO)
+    if p.returncode != 0:
+        raise RuntimeError(f"compression_ab failed (rc={p.returncode}): "
+                           f"{p.stderr[-1500:]}")
+    ab = json.loads(p.stdout.strip().splitlines()[-1])
+    if not ab.get("wire_ratio_ok") or ab["wire_ratio_threshold"] < 8.0:
+        raise RuntimeError("compression wire-bytes ratio gate FAILED "
+                           f"(need >=8x): {ab}")
+    if not ab.get("loss_parity_ok") or not ab.get("compressed_learns"):
+        raise RuntimeError(f"compression loss-parity gate FAILED: {ab}")
+    if not ab.get("dense_bitwise_vs_today"):
+        raise RuntimeError("grad_compression=None is no longer bit-identical "
+                           f"to the default trainer: {ab}")
+    return {"metric": "grad_compression_wire_ratio",
+            "value": ab["wire_ratio_threshold"], "unit": "x (analytic)",
+            "platform": ab["platform"], "mesh": ab["mesh"],
+            "n_params": ab["n_params"],
+            "wire_bytes_per_step": {
+                "dense": ab["threshold"]["dense_wire_bytes_per_step"],
+                "threshold": ab["threshold"]["wire_bytes_per_step"],
+                "bitmap": ab["bitmap"]["wire_bytes_per_step"]},
+            "bitmap_wire_ratio": ab["bitmap"]["wire_ratio"],
+            "final_loss": {m: ab[m]["final_loss"]
+                           for m in ("dense", "threshold", "bitmap")},
+            "loss_parity_ok": True, "dense_bitwise_vs_today": True,
+            "n_buckets": ab["threshold"]["n_buckets"]}
+
+
 def main() -> None:
     import jax
 
@@ -808,7 +878,8 @@ def main() -> None:
                      ("flash_attention", lambda: bench_flash_attention(platform)),
                      ("transformer_lm", lambda: bench_transformer_lm(platform)),
                      ("collective", bench_collective),
-                     ("pipeline_schedules", bench_pipeline_schedules)]:
+                     ("pipeline_schedules", bench_pipeline_schedules),
+                     ("grad_compression", bench_grad_compression)]:
         try:
             t0 = time.perf_counter()
             out = fn()

@@ -104,17 +104,22 @@ def _load_model(path: str):
 
 
 def _parse_mesh(spec: str) -> tuple:
-    """'data=4,model=2[,schedule=1f1b]' → ({"data": 4, "model": 2},
-    schedule) (-1 = infer; schedule defaults to "gpipe").  Resolves -1
-    against the visible device count and guarantees a 'data' axis
-    (ShardedTrainer's batch sharding names it), so every failure mode
-    here is a clean one-line CLI error, not a jax traceback.  The
-    ``schedule`` token picks the pipeline microbatch order for nets that
-    pipeline over a ``pipe`` axis (parallel/pipeline.py)."""
+    """'data=4,model=2[,schedule=1f1b][,compress=threshold]' →
+    ({"data": 4, "model": 2}, schedule, compress) (-1 = infer; schedule
+    defaults to "gpipe", compress to None).  Resolves -1 against the
+    visible device count and guarantees a 'data' axis (ShardedTrainer's
+    batch sharding names it), so every failure mode here is a clean
+    one-line CLI error, not a jax traceback.  The ``schedule`` token
+    picks the pipeline microbatch order for nets that pipeline over a
+    ``pipe`` axis (parallel/pipeline.py); the ``compress`` token enables
+    the DCN-tier compressed gradient exchange for meshes with a ``dcn``
+    axis (ops/compression.py)."""
+    from .ops.compression import METHODS
     from .parallel.pipeline import SCHEDULES
 
     axes = {}
     schedule = "gpipe"
+    compress = None
     seen_schedule = False
     for part in spec.split(","):
         name, _, size = part.partition("=")
@@ -129,6 +134,16 @@ def _parse_mesh(spec: str) -> tuple:
                     f"{'/'.join(SCHEDULES)}, got {size.strip()!r}")
             schedule = size.strip()
             seen_schedule = True
+            continue
+        if name == "compress":
+            if compress is not None:
+                raise SystemExit(
+                    f"bad --mesh {spec!r}: duplicate compress token")
+            if size.strip() not in METHODS:
+                raise SystemExit(
+                    f"bad --mesh {spec!r}: compress must be one of "
+                    f"{'/'.join(METHODS)}, got {size.strip()!r}")
+            compress = size.strip()
             continue
         if name in axes:
             raise SystemExit(f"bad --mesh {spec!r}: duplicate axis {name!r}")
@@ -158,7 +173,10 @@ def _parse_mesh(spec: str) -> tuple:
             raise SystemExit(f"bad --mesh {spec!r}: cannot infer -1 axis "
                              f"from {n} device(s)")
         axes = {k: (n // known if s == -1 else s) for k, s in axes.items()}
-    return axes, schedule
+    if compress is not None and "dcn" not in axes:
+        raise SystemExit(f"bad --mesh {spec!r}: compress={compress} needs a "
+                         "dcn axis, e.g. 'dcn=2,data=4,compress=threshold'")
+    return axes, schedule, compress
 
 
 def cmd_train(args) -> int:
@@ -168,11 +186,12 @@ def cmd_train(args) -> int:
     net = _build_model(args)
     xs, ys = _load_data(args.data, train=True, num_classes=_num_classes_of(net))
     batches = DataSet(xs, ys).shuffle(args.seed).batch_by(args.batch_size)
-    mesh_axes, schedule = _parse_mesh(args.mesh) if args.mesh else (None, "gpipe")
+    mesh_axes, schedule, compress = (_parse_mesh(args.mesh) if args.mesh
+                                     else (None, "gpipe", None))
     if mesh_axes:
         # XLA needs static shapes divisible by the data axis — drop the
         # ragged tail batch instead of erroring mid-epoch
-        dp = mesh_axes["data"]
+        dp = mesh_axes["data"] * mesh_axes.get("dcn", 1)
         if args.batch_size % dp:
             raise SystemExit(f"--batch-size {args.batch_size} not divisible "
                              f"by mesh data axis {dp}")
@@ -212,10 +231,12 @@ def cmd_train(args) -> int:
             raise SystemExit(f"--mesh {args.mesh!r} needs {total} device(s), "
                              f"found {jax.device_count()}")
         mesh = build_mesh(mesh_axes, devices=jax.devices()[:total])
-        trainer = ShardedTrainer(net, mesh, pipeline_schedule=schedule)
+        trainer = ShardedTrainer(net, mesh, pipeline_schedule=schedule,
+                                 grad_compression=compress)
         print(f"mesh: {dict(mesh.shape)} over {mesh.devices.size} device(s)"
               + (f", pipeline schedule {schedule}" if schedule != "gpipe"
-                 else ""))
+                 else "")
+              + (f", grad compression {compress}" if compress else ""))
     losses = (trainer.fit(it, epochs=args.epochs) if trainer
               else net.fit(it, epochs=args.epochs))
     print(f"trained {args.epochs} epoch(s), {len(losses)} iterations, "
@@ -282,7 +303,10 @@ def build_parser() -> argparse.ArgumentParser:
                    "e.g. 'data=8' or 'data=4,model=2' (the reference's "
                    "ParallelWrapperMain role); an optional "
                    "'schedule=gpipe|1f1b' token picks the pipeline "
-                   "microbatch order for pipe-axis nets")
+                   "microbatch order for pipe-axis nets, and "
+                   "'compress=threshold|bitmap' enables the DCN-tier "
+                   "compressed gradient exchange on dcn-axis meshes, "
+                   "e.g. 'dcn=2,data=4,compress=threshold'")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("evaluate", help="evaluate a saved model")

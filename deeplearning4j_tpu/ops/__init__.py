@@ -9,3 +9,7 @@ from .dtypes import DTypePolicy, default_policy, canonical_dtype
 from .activations import Activation, get_activation
 from .initializers import WeightInit, init_weight
 from .losses import Loss, get_loss
+from .compression import (
+    GradBucketer, bitmap_decode, bitmap_encode, compressed_pmean,
+    compression_stats, threshold_decode, threshold_encode,
+)

@@ -11,14 +11,23 @@ mechanism: a jax.sharding.Mesh + GSPMD-partitioned jit programs.
     multi-host via jax.distributed
   reference SharedTrainingMaster (threshold-compressed
     gradients over Aeron UDP, SharedTrainingMaster.java:55)
-                                                       → dense grad allreduce
-    over ICI; no compression needed at ICI bandwidth
+                                                       → TWO-tier exchange:
+    dense grad allreduce over the intra-slice ICI axis (where bandwidth
+    makes compression pointless) + opt-in compressed exchange over the
+    cross-slice ``dcn`` axis, whose DCN links are orders of magnitude
+    slower — ``ShardedTrainer(grad_compression="threshold"|"bitmap")``
+    is the EncodingHandler thresholdEncode/bitmapEncode analog, with the
+    reference's error-feedback residual (ops/compression.py,
+    docs/PARALLELISM.md "Gradient compression over DCN")
   reference ParameterServerTrainer                     → subsumed by
     collectives (documented non-goal)
   TP / PP / SP — absent in the reference — are first-class here.
 """
 
-from .mesh import build_mesh, replicated, shard_batch, infer_param_shardings
+from .mesh import (
+    build_mesh, build_two_tier_mesh, replicated, shard_batch,
+    infer_param_shardings,
+)
 from .trainer import ShardedTrainer
 from .inference import ParallelInference
 from .ring import ring_attention, ring_self_attention
@@ -31,5 +40,6 @@ from .transformer import ShardedTransformerLM
 from .elastic import CheckpointManager, ElasticTrainer, FailureDetector
 from .moe import MoE, init_moe_params, moe_forward_dense, moe_forward_ep
 from .distributed import (
-    initialize, is_coordinator, local_batch_slice, process_count, process_index,
+    detect_num_slices, initialize, is_coordinator, local_batch_slice,
+    process_count, process_index,
 )

@@ -67,6 +67,29 @@ def local_batch_slice(global_batch: int) -> slice:
     return slice(i * per, (i + 1) * per)
 
 
+def detect_num_slices() -> int:
+    """Number of DCN-connected TPU slices this job spans (1 when the whole
+    job is ICI-connected).
+
+    Reads ``MEGASCALE_NUM_SLICES`` — the Cloud TPU multislice runtime's
+    env contract (every worker of a multislice job gets it) — and falls
+    back to distinct ``device.slice_index`` values when the backend
+    exposes them.  Use it to size the ``dcn`` axis:
+
+        mesh = build_two_tier_mesh(detect_num_slices())
+        trainer = ShardedTrainer(net, mesh, grad_compression="threshold")
+
+    Multi-HOST but single-slice jobs correctly report 1: cross-host
+    traffic within a slice is still ICI, where the dense exchange is the
+    right call (see parallel/__init__ docstring)."""
+    import os
+    env = os.environ.get("MEGASCALE_NUM_SLICES")
+    if env:
+        return max(1, int(env))
+    slice_ids = {getattr(d, "slice_index", 0) for d in jax.devices()}
+    return max(1, len(slice_ids))
+
+
 def is_coordinator() -> bool:
     """True on process 0 — gate checkpoint writes / logging / UI servers
     the way the reference gates them on the Spark driver."""

@@ -44,6 +44,10 @@ class _HostSnapshot:
         self.params = host(net.params)
         self.state = host(net.state)
         self.opt_state = host(net.opt_state)
+        # compressed-exchange error-feedback residual (serializer format
+        # v3): losing it on restore would drop in-flight compression error
+        residual = getattr(net, "grad_residual", None)
+        self.grad_residual = None if residual is None else host(residual)
         self.iteration = net.iteration
         self.epoch = getattr(net, "epoch", 0)
         # serializer writes this into meta.json — the checkpoint must
@@ -243,6 +247,9 @@ class ElasticTrainer:
         net.params = model.params
         net.state = model.state
         net.opt_state = model.opt_state
+        # None when the checkpoint predates compression (or it is off) —
+        # _place_model re-inits zeros in that case
+        net.grad_residual = getattr(model, "grad_residual", None)
         net.iteration = model.iteration
         self.global_step = step
         logger.info("restored checkpoint @ step %d", step)

@@ -7,7 +7,17 @@ named axes; XLA GSPMD inserts the psum/all-gather/reduce-scatter collectives
 that ride ICI intra-slice and DCN across slices.
 
 Axis convention: ``data`` (DP), ``model`` (TP), ``seq`` (SP/CP),
-``pipe`` (PP).  Build a mesh with the axes you use; absent axes = size 1.
+``pipe`` (PP), ``dcn`` (cross-slice DP).  Build a mesh with the axes you
+use; absent axes = size 1.
+
+The two-tier interconnect is first-class: axes over devices WITHIN a TPU
+slice ride the ICI (fast — dense collectives are free at that bandwidth),
+while an outer ``dcn`` axis spans slices over the data-center network,
+which is orders of magnitude slower — the tier where
+``ShardedTrainer(grad_compression=...)`` swaps the dense psum for the
+compressed exchange (ops/compression.py).  ``build_two_tier_mesh`` builds
+the slice-major device layout so consecutive devices (ICI neighbors on
+Cloud TPU) land in the same slice row.
 """
 
 from __future__ import annotations
@@ -25,6 +35,7 @@ DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
 PIPE_AXIS = "pipe"
+DCN_AXIS = "dcn"
 
 
 def build_mesh(axes: Optional[Dict[str, int]] = None,
@@ -46,6 +57,26 @@ def build_mesh(axes: Optional[Dict[str, int]] = None,
         raise ValueError(f"mesh axes {dict(zip(names, sizes))} != {n} devices")
     arr = np.asarray(devices).reshape(sizes)
     return Mesh(arr, names)
+
+
+def build_two_tier_mesh(n_slices: int,
+                        axes: Optional[Dict[str, int]] = None,
+                        devices: Optional[Sequence] = None) -> Mesh:
+    """Mesh with an OUTER ``dcn`` axis of ``n_slices`` plus inner ICI axes
+    (default: all remaining devices on ``data``).
+
+    The dcn axis is placed first so each slice's devices form one
+    contiguous row — on Cloud TPU, ``jax.devices()`` orders devices
+    slice-major, so the row boundary is the real ICI/DCN boundary.  Pair
+    with ``ShardedTrainer(grad_compression=...)`` to compress the
+    cross-slice gradient exchange; ``distributed.detect_num_slices()``
+    reads the multislice runtime's slice count."""
+    if n_slices < 1:
+        raise ValueError(f"n_slices must be >= 1, got {n_slices}")
+    inner = dict(axes) if axes else {DATA_AXIS: -1}
+    if DCN_AXIS in inner:
+        raise ValueError("pass the dcn size as n_slices, not in axes")
+    return build_mesh({DCN_AXIS: n_slices, **inner}, devices)
 
 
 def put_global(arr, sharding: NamedSharding):

@@ -32,8 +32,16 @@ import numpy as np
 #    entry name "<path>#bfloat16" (np.savez cannot round-trip bf16) —
 #    version-1 readers would surface them as missing keys, so the format
 #    version records the suffix scheme.  Loading v1 zips stays supported.
-FORMAT_VERSION = 2
-SUPPORTED_VERSIONS = (1, 2)
+# 3: optional "grad_residual.npz" — the error-feedback residual of the
+#    compressed DCN gradient exchange (parallel/trainer.py
+#    grad_compression=; params-tree structure, each leaf carries a leading
+#    dcn-slice axis).  Dropping it would silently lose in-flight
+#    compression error on restore, so writers bump the version; v1/v2
+#    readers reject v3 zips instead of resuming with a truncated state.
+#    Loading v1/v2 zips stays supported (no residual → trainers re-init
+#    zeros).
+FORMAT_VERSION = 3
+SUPPORTED_VERSIONS = (1, 2, 3)
 
 
 def _flatten_tree(tree, prefix="") -> Dict[str, np.ndarray]:
@@ -102,6 +110,10 @@ def save_model(net, path: str, save_updater: bool = True) -> None:
         zf.writestr("state.npz", _npz_bytes(_flatten_tree(net.state)))
         if save_updater:
             zf.writestr("updater.npz", _npz_bytes(_flatten_tree(net.opt_state)))
+        residual = getattr(net, "grad_residual", None)
+        if residual is not None:
+            zf.writestr("grad_residual.npz",
+                        _npz_bytes(_flatten_tree(residual)))
 
 
 def load_model(path: str, load_updater: bool = True):
@@ -116,8 +128,11 @@ def load_model(path: str, load_updater: bool = True):
                 "version")
         params_flat = _load_npz(zf.read("params.npz"))
         state_flat = _load_npz(zf.read("state.npz"))
+        names = zf.namelist()
         upd_flat = _load_npz(zf.read("updater.npz")) if (
-            load_updater and "updater.npz" in zf.namelist()) else None
+            load_updater and "updater.npz" in names) else None
+        resid_flat = _load_npz(zf.read("grad_residual.npz")) if (
+            "grad_residual.npz" in names) else None
 
     if conf_d.get("type") == "ComputationGraphConfiguration":
         from ..nn.graph import ComputationGraph, ComputationGraphConfiguration
@@ -132,6 +147,10 @@ def load_model(path: str, load_updater: bool = True):
     net.state = _unflatten_into(net.state, state_flat)
     if upd_flat is not None:
         net.opt_state = _unflatten_into(net.opt_state, upd_flat)
+    if resid_flat is not None:
+        # params tree is only the structural template here — residual
+        # leaves carry their own (slice-leading) shapes from the npz
+        net.grad_residual = _unflatten_into(net.params, resid_flat)
     net.iteration = meta.get("iteration", 0)
     net.epoch = meta.get("epoch", 0)
     return net

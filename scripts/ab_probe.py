@@ -1,12 +1,16 @@
 """Tenancy-controlled A/B probe: one bench config, one source tree, on the
 real chip.  Usage: python probe.py <tree_path> <config> [tag]
 
-Timing protocol is IDENTICAL for every arm (best-of-3 33-step windows,
-value-readback sync — bench.py's round-3+ protocol) and lives HERE, so the
-r2/r3 trees are measured with the same method as HEAD; only the library
-code differs.  Prints one JSON line.
+Configs: mlp / lenet / charrnn / w2v / resnet.  Timing protocol is
+IDENTICAL for every arm (best-of-3 33-step windows, value-readback sync —
+bench.py's round-3+ protocol) and lives HERE, so old trees are measured
+with the same method as HEAD; only the library code differs.  Prints one
+JSON line.  PROBE_QUICK=1 shrinks windows (and the resnet shape) for
+CPU-feasible code-vs-code A/Bs — the relative HEAD-vs-tree comparison
+stays valid because both arms share the setting.
 """
 import json
+import os
 import sys
 import time
 
@@ -19,7 +23,8 @@ import jax
 import jax.numpy as jnp
 import jax.random as jrandom
 
-WARMUP, WINDOWS, PER = 10, 3, 33
+QUICK = os.environ.get("PROBE_QUICK", "0") == "1"
+WARMUP, WINDOWS, PER = (3, 2, 8) if QUICK else (10, 3, 33)
 
 
 def sync(state):
@@ -46,12 +51,19 @@ def steady(step_fn, state):
 def net_step(net, x, y):
     if net._jit_step is None:
         net._jit_step = net._make_step()
+    if isinstance(net.params, dict):  # ComputationGraph (e.g. ResNet50)
+        x = {net.conf.network_inputs[0]: x}
+        y = {net.conf.network_outputs[0]: y}
+        m = {net.conf.network_inputs[0]: None}
+        lm = {net.conf.network_outputs[0]: None}
+    else:
+        m = lm = None
 
     def step(state, i):
         params, st, opt = state
         params, st, opt, loss = net._jit_step(
             params, st, opt, jnp.asarray(i, jnp.int32), x, y,
-            jrandom.PRNGKey(i), None, None)
+            jrandom.PRNGKey(i), m, lm)
         return (params, st, opt)
 
     return step, (net.params, net.state, net.opt_state)
@@ -106,6 +118,46 @@ elif config == "charrnn":
 
     sec = steady(rnn_step, net.params)
     out = {"config": "charrnn", "chars_per_sec": round(batch * T / sec, 1)}
+elif config == "w2v":
+    # steady-state fit on a fresh model each window (bench.py's protocol:
+    # the first fit pays compilation, later fits on the same shapes hit
+    # the jit cache), end-to-end through the final-table readback
+    from deeplearning4j_tpu.nlp import Word2Vec
+    vocab = [f"w{i}" for i in range(2000)]
+    n_sent = 800 if QUICK else 8000
+    sentences = [" ".join(rng.choice(vocab, size=20)) for _ in range(n_sent)]
+    n_words = sum(len(s.split()) for s in sentences)
+
+    def make():
+        return Word2Vec(layer_size=128, window=5, min_word_frequency=1,
+                        epochs=1, batch_size=4096, subsampling=0)
+
+    warm = make()
+    warm.fit(sentences)
+    warm.word_vector("w0")
+    rate = 0.0
+    for _ in range(2 if QUICK else 3):
+        t0 = time.perf_counter()
+        m = make()
+        m.fit(sentences)
+        m.word_vector("w0")
+        rate = max(rate, n_words / (time.perf_counter() - t0))
+    out = {"config": "w2v", "words_per_sec": round(rate, 1)}
+elif config == "resnet":
+    from deeplearning4j_tpu.models import ResNet50
+    from deeplearning4j_tpu.nn.updaters import Nesterovs
+    batch, size = (16, 64) if QUICK else (128, 224)
+    net = ResNet50(height=size, width=size, channels=3, num_classes=1000,
+                   updater=Nesterovs(lr=0.1, momentum=0.9))
+    if jax.devices()[0].platform != "cpu":
+        net.conf.compute_dtype = "bfloat16"
+    x = jnp.asarray(rng.normal(size=(batch, size, size, 3)).astype(np.float32))
+    y = jnp.asarray(np.eye(1000, dtype=np.float32)[
+        rng.integers(0, 1000, batch)])
+    step, state = net_step(net, x, y)
+    sec = steady(step, state)
+    out = {"config": "resnet", "images_per_sec": round(batch / sec, 1),
+           "batch": batch, "size": size}
 else:
     raise SystemExit(f"unknown config {config}")
 

@@ -1,0 +1,145 @@
+"""Gradient-compression A/B: dense vs threshold/bitmap DCN exchange.
+
+Runs on a virtual 2-slice mesh (dcn=2 × data=2 over 4 CPU devices — the
+dcn axis needs >1 "slice"; the bench box has one chip), so all three arms
+share placement and data and differ ONLY in how the gradient crosses the
+dcn axis.  Per arm, measures:
+
+  - loss curve over N steps of the same MLP/blobs workload, seed-matched
+    against the single-device reference curve (error-feedback convergence
+    parity — the property the reference's residual accumulator exists for)
+  - per-step DCN wire bytes: dense ring-allreduce bytes vs the encoded
+    buffers the compressed exchange actually all_gathers
+    (ops/compression.compression_stats — accounting, since virtual CPU
+    "slices" have no real wire)
+  - dense-arm bit-identity: ShardedTrainer(grad_compression=None) must
+    reproduce the single-device curve step for step (the today's-trainer
+    guarantee)
+
+Prints ONE JSON line on stdout (bench.py's subprocess contract).  Usage:
+
+    XLA_FLAGS=--xla_force_host_platform_device_count=4 \\
+        JAX_PLATFORMS=cpu python scripts/compression_ab.py [--quick]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+QUICK = "--quick" in sys.argv or os.environ.get("BENCH_QUICK", "0") == "1"
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+
+
+def _mlp(seed=3, lr=0.05):
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import (
+        MultiLayerNetwork, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.updaters import Sgd
+
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(lr=lr))
+            .layer(Dense(n_out=64, activation="tanh"))
+            .layer(Dense(n_out=32, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(24)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def main() -> None:
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.ops.compression import compression_stats
+    from deeplearning4j_tpu.parallel import ShardedTrainer
+    from deeplearning4j_tpu.parallel.mesh import build_two_tier_mesh
+
+    n_dev = 4
+    if len(jax.devices()) < n_dev:
+        raise SystemExit(f"need {n_dev} devices "
+                         f"(--xla_force_host_platform_device_count)")
+    steps = 12 if QUICK else 40
+    batch = 128
+    bucket_mb = 0.001  # tiny buckets → the bucketed path is exercised
+
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(3, 24)) * 3
+    ys = rng.integers(0, 3, batch)
+    xs = (centers[ys] + rng.normal(size=(batch, 24))).astype(np.float32)
+    ds = DataSet(xs, np.eye(3, dtype=np.float32)[ys])
+
+    def mesh():
+        return build_two_tier_mesh(2, {"data": 2},
+                                   devices=jax.devices()[:n_dev])
+
+    # single-device reference curve (the parity target)
+    ref_net = _mlp()
+    ref = [float(ref_net.fit_batch(ds)) for _ in range(steps)]
+    n_params = ref_net.num_params()
+
+    out = {"config": "grad_compression", "platform": "cpu-virtual",
+           "n_devices": n_dev, "mesh": {"dcn": 2, "data": 2},
+           "steps": steps, "batch": batch, "n_params": n_params}
+    curves = {}
+    for arm in (None, "threshold", "bitmap"):
+        trainer = ShardedTrainer(_mlp(), mesh(), grad_compression=arm,
+                                 compression_bucket_mb=bucket_mb)
+        t0 = time.perf_counter()
+        losses = [float(trainer.fit_batch(ds)) for _ in range(steps)]
+        sec = (time.perf_counter() - t0) / steps
+        name = arm or "dense"
+        curves[name] = losses
+        stats = compression_stats(
+            n_params, arm, n_slices=2,
+            bucket_bytes=int(bucket_mb * (1 << 20))) if arm else None
+        out[name] = {
+            "first_loss": losses[0], "final_loss": losses[-1],
+            "sec_per_step_cpu": round(sec, 4),
+            "max_abs_loss_gap_vs_single": round(
+                max(abs(a - b) for a, b in zip(losses, ref)), 6),
+        }
+        if stats:
+            out[name].update({
+                "n_buckets": stats["n_buckets"],
+                "wire_bytes_per_step": stats["compressed_wire_bytes_per_step"],
+                "dense_wire_bytes_per_step":
+                    stats["dense_wire_bytes_per_step"],
+                "wire_ratio": round(stats["wire_ratio"], 2),
+            })
+
+    # the acceptance gates ------------------------------------------------
+    # 1. grad_compression=None ≡ today's trainer (no kwarg), bitwise: the
+    #    None path must dispatch to the net's own jit step untouched.
+    #    (vs SINGLE device the dense mesh run matches to float tolerance
+    #    only — GSPMD's psum reduction order differs, same bound the
+    #    tests/test_parallel.py parity tests use.)
+    legacy = ShardedTrainer(_mlp(), mesh())
+    out["dense_bitwise_vs_today"] = (
+        [float(legacy.fit_batch(ds)) for _ in range(steps)]
+        == curves["dense"])
+    out["dense_close_to_single"] = bool(np.allclose(
+        curves["dense"], ref, rtol=2e-4))
+    # 2. ≥8x wire reduction at the threshold default
+    out["wire_ratio_threshold"] = out["threshold"]["wire_ratio"]
+    out["wire_ratio_ok"] = out["threshold"]["wire_ratio"] >= 8.0
+    # 3. loss-curve parity within tolerance: compressed training converges
+    #    with the dense run (error feedback working), measured as the final
+    #    loss staying within 25% relative + small absolute slack
+    dense_final = curves["dense"][-1]
+    tol = 0.25 * dense_final + 0.02
+    out["loss_parity_tolerance"] = round(tol, 6)
+    out["loss_parity_ok"] = all(
+        abs(curves[m][-1] - dense_final) <= tol
+        for m in ("threshold", "bitmap"))
+    out["compressed_learns"] = all(
+        curves[m][-1] < 0.3 * curves[m][0] for m in ("threshold", "bitmap"))
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -185,10 +185,11 @@ class TestWiring:
 
     def test_cli_mesh_schedule_token(self):
         from deeplearning4j_tpu.cli import _parse_mesh
-        axes, schedule = _parse_mesh("data=2,pipe=4,schedule=1f1b")
+        axes, schedule, compress = _parse_mesh("data=2,pipe=4,schedule=1f1b")
         assert axes == {"data": 2, "pipe": 4}
         assert schedule == "1f1b"
-        axes, schedule = _parse_mesh("data=8")
+        assert compress is None
+        axes, schedule, compress = _parse_mesh("data=8")
         assert schedule == "gpipe"
         with pytest.raises(SystemExit, match="schedule"):
             _parse_mesh("data=8,schedule=fast")
@@ -215,7 +216,9 @@ class TestSatellites:
 
     def test_serializer_version_and_bf16_hint(self):
         from deeplearning4j_tpu.utils import serializer
-        assert serializer.FORMAT_VERSION == 2
+        # v3 = v2 (bf16 uint16-view scheme) + optional grad_residual.npz
+        # (compressed-exchange error feedback, tests/test_compression.py)
+        assert serializer.FORMAT_VERSION == 3
         with pytest.raises(KeyError, match="bfloat16"):
             serializer._unflatten_into({"a": jnp.zeros(2)}, {}, "")
 
