@@ -30,6 +30,11 @@ stderr, including:
     compressed gradient exchange — per-step wire bytes at the threshold
     default, and the dense/compressed A/B on a virtual 2-slice mesh via
     scripts/compression_ab.py, hard-gated at >=8x with loss parity
+  - chaos_recovery_faults_recovered: the chaos-soak fault-recovery gate
+    (scripts/chaos_soak.py) — a scripted >=5-kind fault schedule against
+    a real ElasticTrainer loop, hard-gated on zero unrecovered failures,
+    corrupt-latest checkpoint fallback, chaos-off bitwise identity, and
+    loss parity with the fault-free run (docs/FAULT_TOLERANCE.md)
 
 BASELINE.md: the reference publishes NO numbers; the driver target is
 >=0.8x per-chip of H100+nd4j-cuda on ResNet-50 ≈ 2000 img/s.
@@ -862,6 +867,57 @@ def bench_grad_compression():
             "n_buckets": ab["threshold"]["n_buckets"]}
 
 
+def bench_chaos_recovery():
+    """Config 11: chaos-tested fault recovery (scripts/chaos_soak.py; the
+    subprocess mechanism, CPU — fault injection needs no accelerator).  A
+    scripted schedule fires ≥5 distinct fault kinds (device loss, mid-zip
+    checkpoint-write crash, truncated + bit-flipped latest checkpoint,
+    hung step, NaN gradients) into a real ElasticTrainer loop.  HARD
+    gates (the robustness contract, not perf): zero unrecovered failures,
+    restore falls back to the newest INTACT checkpoint when the latest is
+    corrupt, chaos machinery disabled is bit-identical to the plain
+    trainer, and the chaos arm's final loss stays within tolerance of the
+    fault-free run.  The reported value is the recovery count — fixed by
+    the deterministic schedule, so any change means the schedule or the
+    recovery behavior changed."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    script = os.path.join(_REPO, "scripts", "chaos_soak.py")
+    cmd = [sys.executable, script] + (["--quick"] if QUICK else [])
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=1800, cwd=_REPO)
+    if p.returncode != 0:
+        raise RuntimeError(f"chaos_soak failed (rc={p.returncode}): "
+                           f"{p.stdout[-500:]} {p.stderr[-1000:]}")
+    soak = json.loads(p.stdout.strip().splitlines()[-1])
+    if soak.get("unrecovered") != 0:
+        raise RuntimeError(f"chaos soak had UNRECOVERED failures: {soak}")
+    if not soak.get("intact_fallback_ok"):
+        raise RuntimeError("corrupt-latest checkpoint fallback FAILED "
+                           f"in chaos soak: {soak}")
+    if not soak.get("disabled_bitwise"):
+        raise RuntimeError("chaos-disabled run is no longer bit-identical "
+                           f"to the plain trainer: {soak}")
+    if not soak.get("loss_parity_ok") or not soak.get("chaos_learns"):
+        raise RuntimeError(f"chaos-arm loss parity gate FAILED: {soak}")
+    if soak.get("n_fault_kinds", 0) < 5:
+        raise RuntimeError(f"chaos soak exercised <5 fault kinds: {soak}")
+    return {"metric": "chaos_recovery_faults_recovered",
+            "value": soak["recoveries"], "unit": "recoveries",
+            "platform": soak["platform"],
+            "fault_kinds": soak["fault_kinds"],
+            "faults_injected": soak["faults_injected"],
+            "recovery_seconds": soak["recovery_seconds"],
+            "corrupt_checkpoints_quarantined":
+                soak["corrupt_checkpoints_quarantined"],
+            "stale_tmp_cleaned": soak["stale_tmp_cleaned"],
+            "disabled_bitwise": True, "loss_parity_ok": True,
+            "final_loss": soak["final_loss"]}
+
+
 def main() -> None:
     import jax
 
@@ -879,7 +935,8 @@ def main() -> None:
                      ("transformer_lm", lambda: bench_transformer_lm(platform)),
                      ("collective", bench_collective),
                      ("pipeline_schedules", bench_pipeline_schedules),
-                     ("grad_compression", bench_grad_compression)]:
+                     ("grad_compression", bench_grad_compression),
+                     ("chaos_recovery", bench_chaos_recovery)]:
         try:
             t0 = time.perf_counter()
             out = fn()

@@ -179,6 +179,51 @@ def _parse_mesh(spec: str) -> tuple:
     return axes, schedule, compress
 
 
+def _parse_chaos(spec: str):
+    """'kind@step[,kind@step...][,seed=S][,hang=SECONDS]' →
+    (FaultSchedule, seed, hang_seconds).  Fault kinds are the
+    parallel/chaos.py FaultKind names (device_loss, ckpt_write_crash,
+    ckpt_truncate, ckpt_bitflip, hung_step, nan_grads); every parse
+    failure is a one-line CLI error, not a traceback."""
+    from .parallel.chaos import FaultKind, FaultSchedule
+
+    faults: dict = {}
+    seed, hang = 0, 5.0
+    for part in spec.split(","):
+        part = part.strip()
+        if "=" in part and "@" not in part:
+            key, _, val = part.partition("=")
+            try:
+                if key == "seed":
+                    seed = int(val)
+                elif key == "hang":
+                    hang = float(val)
+                else:
+                    raise SystemExit(f"bad --chaos {spec!r}: unknown option "
+                                     f"{key!r} (seed=, hang=)")
+            except ValueError:
+                raise SystemExit(f"bad --chaos {spec!r}: {key}= needs a "
+                                 "number")
+            continue
+        kind, _, step = part.partition("@")
+        if kind not in FaultKind.ALL:
+            raise SystemExit(f"bad --chaos {spec!r}: unknown fault kind "
+                             f"{kind!r} — one of {'/'.join(FaultKind.ALL)}")
+        try:
+            step_i = int(step)
+            if step_i < 1:
+                raise ValueError
+        except ValueError:
+            raise SystemExit(f"bad --chaos {spec!r}: {kind} needs a positive "
+                             f"step, e.g. '{kind}@5'")
+        faults.setdefault(step_i, []).append(kind)
+    if not faults:
+        raise SystemExit(f"bad --chaos {spec!r}: no faults — expected "
+                         "kind@step[,kind@step...], e.g. "
+                         "'device_loss@5,nan_grads@9,seed=1'")
+    return FaultSchedule(faults), seed, hang
+
+
 def cmd_train(args) -> int:
     from .datasets import DataSet, ListDataSetIterator
     from .optimize import ScoreIterationListener
@@ -215,6 +260,9 @@ def cmd_train(args) -> int:
         storage = InMemoryStatsStorage()
         listeners.append(StatsListener(storage, session_id="cli_train"))
     net.set_listeners(*listeners)
+    if args.chaos and not args.elastic_dir:
+        raise SystemExit("--chaos needs --elastic-dir (faults are injected "
+                         "into the ElasticTrainer recovery loop)")
     trainer = None
     if mesh_axes:
         # the reference's ParallelWrapperMain role (parallelism/main/
@@ -232,13 +280,54 @@ def cmd_train(args) -> int:
                              f"found {jax.device_count()}")
         mesh = build_mesh(mesh_axes, devices=jax.devices()[:total])
         trainer = ShardedTrainer(net, mesh, pipeline_schedule=schedule,
-                                 grad_compression=compress)
+                                 grad_compression=compress,
+                                 nan_guard=args.nan_guard)
         print(f"mesh: {dict(mesh.shape)} over {mesh.devices.size} device(s)"
               + (f", pipeline schedule {schedule}" if schedule != "gpipe"
                  else "")
-              + (f", grad compression {compress}" if compress else ""))
+              + (f", grad compression {compress}" if compress else "")
+              + (f", nan guard budget {args.nan_guard}" if args.nan_guard
+                 else ""))
+    elif args.nan_guard is not None:
+        if not hasattr(net, "set_nan_guard"):
+            raise SystemExit(f"--nan-guard is not supported for "
+                             f"{type(net).__name__} yet")
+        net.set_nan_guard(args.nan_guard)
+        print(f"nan guard armed (budget {args.nan_guard})")
+    if args.elastic_dir:
+        # checkpoint-restore recovery (the reference CheckpointListener +
+        # Spark task-retry role; docs/FAULT_TOLERANCE.md) — with --chaos,
+        # scripted faults are injected INSIDE the recovery loop, a
+        # self-test that the stack rides out the scheduled failures
+        from .parallel import ChaosInjector, ElasticTrainer
+
+        class _Plain:
+            def __init__(self, n):
+                self.net = n
+
+            def fit_batch(self, ds):
+                return self.net.fit_batch(ds)
+
+        inner = trainer if trainer is not None else _Plain(net)
+        injector = None
+        if args.chaos:
+            sched, seed, hang = _parse_chaos(args.chaos)
+            injector = inner = ChaosInjector(inner, sched,
+                                             hang_seconds=hang, seed=seed)
+            print(f"chaos armed: {sched.pending()} fault(s) scheduled")
+        trainer = ElasticTrainer(
+            inner, args.elastic_dir, checkpoint_every=args.checkpoint_every,
+            sync_every=min(10, args.checkpoint_every),
+            step_timeout=args.step_timeout, backoff_base=0.5, jitter_seed=0)
+        if injector is not None:
+            injector.attach_checkpoints(trainer.ckpt)
     losses = (trainer.fit(it, epochs=args.epochs) if trainer
               else net.fit(it, epochs=args.epochs))
+    if args.elastic_dir:
+        et = trainer
+        print(f"elastic: {et.total_restarts} recovery(ies), "
+              f"{et.recovery_seconds:.1f}s in recovery, final checkpoint @ "
+              f"step {et.global_step} in {args.elastic_dir}")
     print(f"trained {args.epochs} epoch(s), {len(losses)} iterations, "
           f"final loss {losses[-1]:.5f}")
     if args.dashboard:
@@ -307,6 +396,26 @@ def build_parser() -> argparse.ArgumentParser:
                    "'compress=threshold|bitmap' enables the DCN-tier "
                    "compressed gradient exchange on dcn-axis meshes, "
                    "e.g. 'dcn=2,data=4,compress=threshold'")
+    t.add_argument("--nan-guard", type=int, default=None, metavar="BUDGET",
+                   help="arm the divergence guard: steps with non-finite "
+                   "gradients apply no update; BUDGET consecutive bad steps "
+                   "escalate (recoverable under --elastic-dir)")
+    t.add_argument("--elastic-dir", metavar="DIR",
+                   help="train under ElasticTrainer: rolling checkpoints in "
+                   "DIR + automatic restore-and-continue on recoverable "
+                   "failures (docs/FAULT_TOLERANCE.md)")
+    t.add_argument("--checkpoint-every", type=int, default=100,
+                   help="checkpoint interval in steps for --elastic-dir")
+    t.add_argument("--step-timeout", type=float, default=None,
+                   metavar="SECONDS",
+                   help="step watchdog for --elastic-dir: a step exceeding "
+                   "this wall clock is treated as hung and recovered")
+    t.add_argument("--chaos", metavar="SPEC",
+                   help="inject scripted faults (chaos drill; needs "
+                   "--elastic-dir): 'kind@step[,kind@step...]"
+                   "[,seed=S][,hang=SECONDS]', kinds: device_loss/"
+                   "ckpt_write_crash/ckpt_truncate/ckpt_bitflip/hung_step/"
+                   "nan_grads")
     t.set_defaults(fn=cmd_train)
 
     e = sub.add_parser("evaluate", help="evaluate a saved model")

@@ -35,6 +35,24 @@ from ..optimize.score import LazyScore, materialize_scores
 Array = jax.Array
 
 
+class DivergenceError(RuntimeError):
+    """The opt-in divergence guard exhausted its bad-step budget: too many
+    consecutive steps produced non-finite gradients/loss, so skipping
+    updates is no longer masking a transient (bad batch, overflow spike)
+    but a diverged run.  The message carries the "non-finite gradient"
+    marker the elastic FailureDetector recognizes, so an ElasticTrainer
+    wrapping this net escalates to checkpoint-restore instead of dying."""
+
+    def __init__(self, bad_steps: int, budget: int):
+        super().__init__(
+            f"non-finite gradients for {bad_steps} consecutive steps "
+            f"(budget {budget}) — updates were skipped but the run is "
+            "diverging; restore a checkpoint (ElasticTrainer recovers this "
+            "automatically) or lower the learning rate")
+        self.bad_steps = bad_steps
+        self.budget = budget
+
+
 @dataclasses.dataclass
 class MultiLayerConfiguration:
     """Configs-as-data for a sequential net (reference
@@ -155,6 +173,9 @@ class MultiLayerNetwork:
         self.listeners: List[Any] = []
         self.input_types: List[InputType] = []
         self._jit_step = None
+        self._jit_step_guarded = None
+        self._nan_guard_budget: Optional[int] = None
+        self._bad_steps = 0
         self._jit_multi_step = None
         self._jit_step_tbptt = None
         self._jit_step_tbptt_scan = None
@@ -384,6 +405,110 @@ class MultiLayerNetwork:
 
         return jax.jit(step, donate_argnums=(0, 1, 2))
 
+    # ------------------------------------------------------------------
+    # divergence guard (opt-in)
+    # ------------------------------------------------------------------
+
+    def set_nan_guard(self, budget: Optional[int] = 3) -> None:
+        """Opt-in divergence guard: every step checks loss + gradients for
+        NaN/Inf in-program; a non-finite step applies NO update (params,
+        optimizer state, and batch-norm state come back bit-identical) and
+        burns one unit of ``budget``.  ``budget`` consecutive bad steps
+        raise :class:`DivergenceError` — recoverable under ElasticTrainer,
+        which restores the last checkpoint.  ``budget=None`` disables the
+        guard; disabled (the default) the training step is the exact same
+        jitted program as before — zero cost, bit-identical.
+
+        Cost when enabled: the per-step skipped/ok flag is read on host,
+        which turns the async fit_batch chain into one device sync per
+        step.  Use it for runs where a poisoned step costs more than the
+        sync (large-scale / long-horizon training), not for microbenchmarks.
+        """
+        if budget is not None and budget < 1:
+            raise ValueError(f"nan guard budget must be >= 1, got {budget}")
+        self._nan_guard_budget = budget
+        self._bad_steps = 0
+
+    @staticmethod
+    def _grads_finite(loss, grads):
+        """Scalar bool: loss and every gradient leaf are finite."""
+        ok = jnp.isfinite(loss)
+        for g in jax.tree_util.tree_leaves(grads):
+            ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+        return ok
+
+    @staticmethod
+    def _select_tree(ok, new, old):
+        """tree of where(ok, new, old) — the guarded step's skip switch.
+        jnp.where keeps the OLD bits exactly when ok is False (NaNs in the
+        rejected branch do not propagate through a select)."""
+        return jax.tree_util.tree_map(
+            lambda n, o: jnp.where(ok, n, o), new, old)
+
+    def _make_step_guarded(self):
+        """_make_step plus the in-program non-finite check: same math on
+        the good path, but a step whose loss or gradients contain NaN/Inf
+        returns the INPUT params/state/opt-state unchanged (bit-identical)
+        together with ok=False, so the host can count bad steps against
+        the budget.  Built only when the guard is enabled — the default
+        path keeps its exact pre-guard program."""
+        def step(params, state, opt_state, it, x, labels, rng, mask, label_mask):
+            def loss_fn(p):
+                loss, new_state = self._loss(p, state, x, labels, train=True,
+                                             rng=rng, mask=mask,
+                                             label_mask=label_mask)
+                return loss, new_state
+
+            (loss, new_state), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            ok = self._grads_finite(loss, grads)
+            new_params, new_opt = self._apply_updates(grads, params, opt_state,
+                                                      it.astype(jnp.float32))
+            return (self._select_tree(ok, new_params, params),
+                    self._select_tree(ok, new_state, state),
+                    self._select_tree(ok, new_opt, opt_state), loss, ok)
+
+        return jax.jit(step, donate_argnums=(0, 1, 2))
+
+    def _note_guarded_step(self, ok: bool) -> None:
+        """Host-side budget accounting shared by the plain and sharded
+        guarded steps: reset on a good step, escalate past the budget."""
+        if ok:
+            self._bad_steps = 0
+            return
+        self._bad_steps += 1
+        import logging
+        logging.getLogger("deeplearning4j_tpu").warning(
+            "non-finite gradients at iteration %d — update skipped "
+            "(%d/%d bad steps)", self.iteration, self._bad_steps,
+            self._nan_guard_budget)
+        if self._bad_steps > self._nan_guard_budget:
+            # self-resetting: the raise IS the escalation — whoever catches
+            # it (ElasticTrainer) restores a checkpoint, and the fresh run
+            # deserves a fresh budget, not an instant re-raise
+            bad, self._bad_steps = self._bad_steps, 0
+            raise DivergenceError(bad, self._nan_guard_budget)
+
+    def _fit_batch_guarded(self, ds: DataSet):
+        """fit_batch through the guarded step (set_nan_guard enabled)."""
+        if self._jit_step_guarded is None:
+            self._jit_step_guarded = self._make_step_guarded()
+        self._rng, sub = jax.random.split(self._rng)
+        x = jnp.asarray(ds.features)
+        y = None if ds.labels is None else jax.tree_util.tree_map(jnp.asarray, ds.labels)
+        m = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+        lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        self.params, self.state, self.opt_state, loss, ok = self._jit_step_guarded(
+            self.params, self.state, self.opt_state,
+            self._iter_scalar(1), x, y, sub, m, lm)
+        self.iteration += 1
+        # the guard's documented cost: reading the flag is a device sync
+        self._note_guarded_step(bool(ok))
+        score = LazyScore(loss)
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, score)
+        return score
+
     def _make_step_tbptt(self):
         """One TBPTT chunk step: like _make_step but threads recurrent
         carries; truncation is automatic because each chunk is its own
@@ -556,7 +681,14 @@ class MultiLayerNetwork:
         calls keep the TPU busy with zero per-step host round trips (the
         readback the reference pays at MultiLayerNetwork.java:1165)."""
         if self.conf.backprop_type == "tbptt":
+            if self._nan_guard_budget is not None:
+                raise NotImplementedError(
+                    "the nan guard does not compose with TBPTT yet — chunk "
+                    "steps apply updates inside a scan; run with "
+                    "set_nan_guard(None)")
             return self._fit_batch_tbptt(ds)
+        if self._nan_guard_budget is not None:
+            return self._fit_batch_guarded(ds)
         if self._jit_step is None:
             self._jit_step = self._make_step()
         self._rng, sub = jax.random.split(self._rng)

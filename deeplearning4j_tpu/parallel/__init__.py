@@ -21,6 +21,10 @@ mechanism: a jax.sharding.Mesh + GSPMD-partitioned jit programs.
     docs/PARALLELISM.md "Gradient compression over DCN")
   reference ParameterServerTrainer                     → subsumed by
     collectives (documented non-goal)
+  reference CheckpointListener + Spark task-retry      → ElasticTrainer
+    (checkpoint-restore recovery with backoff+jitter, step watchdog,
+    divergence guard) — chaos-tested by deterministic fault injection
+    (chaos.py, scripts/chaos_soak.py, docs/FAULT_TOLERANCE.md)
   TP / PP / SP — absent in the reference — are first-class here.
 """
 
@@ -37,7 +41,12 @@ from .pipeline import (
     stage_sharding,
 )
 from .transformer import ShardedTransformerLM
-from .elastic import CheckpointManager, ElasticTrainer, FailureDetector
+from .elastic import (
+    CheckpointManager, ElasticTrainer, FailureDetector, StepHangError,
+)
+from .chaos import (
+    ChaosInjector, FaultKind, FaultSchedule, bitflip_file, truncate_file,
+)
 from .moe import MoE, init_moe_params, moe_forward_dense, moe_forward_ep
 from .distributed import (
     detect_num_slices, initialize, is_coordinator, local_batch_slice,

@@ -1,0 +1,263 @@
+"""Deterministic fault injection for the training stack (chaos testing).
+
+The recovery loop around :class:`~.elastic.ElasticTrainer` — failure
+detection, checkpoint restore, mesh rebuild, divergence guard — is exactly
+the code that never runs in a healthy CI pass, yet at pod scale device
+loss, torn checkpoint writes, hung collectives, and NaN-poisoned steps are
+routine events (PAPERS.md: the TPU-supercomputer retrospective names
+resilience, not peak FLOPs, as the availability lever).  This module makes
+those events *scriptable and reproducible*:
+
+- :class:`FaultSchedule` — a seeded/scripted map of step → fault kinds.
+- :class:`ChaosInjector` — wraps a trainer (and optionally its
+  CheckpointManager) and injects each scheduled fault at the scripted
+  step: recoverable device errors, checkpoint-write crashes mid-zip,
+  truncated / bit-flipped checkpoint files, hung steps, NaN gradients.
+
+Usage (the chaos-soak harness, scripts/chaos_soak.py):
+
+    schedule = FaultSchedule.scripted({5: [FaultKind.DEVICE_LOSS],
+                                       9: [FaultKind.NAN_GRADS]})
+    inj = ChaosInjector(trainer, schedule)
+    et = ElasticTrainer(inj, ckpt_dir, step_timeout=30.0, backoff_base=0.1)
+    inj.attach_checkpoints(et.ckpt)      # arm the I/O faults too
+    et.fit(data, epochs=1)               # faults fire; recovery must hold
+    assert inj.unrecovered == 0
+
+Every fault is injected exactly once (consumed from the schedule), at a
+deterministic step index, with any randomness (bit-flip offsets, random
+schedules) drawn from seeded generators — a failing chaos run replays
+bit-for-bit.  Injection happens INSIDE the ElasticTrainer's try block, so
+a fault the stack cannot recover from fails the run loudly instead of
+flaking.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class FaultKind:
+    """The fault menu.  String constants (not an enum) so schedules stay
+    JSON/CLI-friendly — ``--chaos device_loss@5,nan_grads@9``."""
+
+    #: recoverable infra error raised from the step (XLA device loss)
+    DEVICE_LOSS = "device_loss"
+    #: the next checkpoint write crashes mid-zip, leaving a stale .tmp
+    CKPT_WRITE_CRASH = "ckpt_write_crash"
+    #: truncate the newest on-disk checkpoint (torn write after rename)
+    CKPT_TRUNCATE = "ckpt_truncate"
+    #: flip bits in the middle of the newest on-disk checkpoint
+    CKPT_BITFLIP = "ckpt_bitflip"
+    #: the step blocks for ``hang_seconds`` (hung collective/dispatch)
+    HUNG_STEP = "hung_step"
+    #: the step's batch is poisoned with NaN features → NaN gradients
+    NAN_GRADS = "nan_grads"
+
+    ALL = (DEVICE_LOSS, CKPT_WRITE_CRASH, CKPT_TRUNCATE, CKPT_BITFLIP,
+           HUNG_STEP, NAN_GRADS)
+
+
+def truncate_file(path: str, keep_fraction: float = 0.5) -> None:
+    """Truncate ``path`` to ``keep_fraction`` of its bytes — a torn write."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(1, int(size * keep_fraction)))
+
+
+def bitflip_file(path: str, n_flips: int = 8, seed: int = 0) -> None:
+    """Flip ``n_flips`` random bits in the middle half of ``path`` —
+    deterministic for a given seed.  The middle half targets entry
+    payloads (zip magic at the start and the central directory at the end
+    fail loudly on their own; payload corruption is what only the v4
+    integrity digests catch)."""
+    rng = np.random.default_rng(seed)
+    size = os.path.getsize(path)
+    lo, hi = size // 4, max(size // 4 + 1, (3 * size) // 4)
+    with open(path, "r+b") as f:
+        for off in rng.integers(lo, hi, size=n_flips):
+            f.seek(int(off))
+            b = f.read(1)
+            if not b:
+                continue
+            f.seek(int(off))
+            f.write(bytes([b[0] ^ (1 << int(rng.integers(0, 8)))]))
+
+
+class FaultSchedule:
+    """step index → list of fault kinds, deterministic and replayable.
+
+    Steps are 1-based *injector call* indices (the first ``fit_batch`` the
+    injector sees is step 1), counted across retries — a fault consumed at
+    step k is not re-injected when recovery replays that step.
+    """
+
+    def __init__(self, faults: Optional[Dict[int, List[str]]] = None):
+        self.faults: Dict[int, List[str]] = {
+            int(k): list(v) for k, v in (faults or {}).items()}
+        for kinds in self.faults.values():
+            for kind in kinds:
+                if kind not in FaultKind.ALL:
+                    raise ValueError(f"unknown fault kind {kind!r} — one of "
+                                     f"{FaultKind.ALL}")
+
+    @classmethod
+    def scripted(cls, faults: Dict[int, Any]) -> "FaultSchedule":
+        """{step: kind or [kinds]} → schedule."""
+        return cls({s: ([k] if isinstance(k, str) else list(k))
+                    for s, k in faults.items()})
+
+    @classmethod
+    def random(cls, seed: int, n_steps: int, rate: float = 0.05,
+               kinds: Optional[List[str]] = None) -> "FaultSchedule":
+        """Seeded random schedule: each step draws a fault with probability
+        ``rate``, kind uniform over ``kinds``.  Same seed → same schedule,
+        so a failing soak replays exactly."""
+        kinds = list(kinds or FaultKind.ALL)
+        rng = np.random.default_rng(seed)
+        faults: Dict[int, List[str]] = {}
+        for step in range(1, n_steps + 1):
+            if rng.random() < rate:
+                faults[step] = [kinds[int(rng.integers(0, len(kinds)))]]
+        return cls(faults)
+
+    def pop(self, step: int) -> List[str]:
+        """Faults scheduled at ``step``, consumed (injected once)."""
+        return self.faults.pop(step, [])
+
+    def pending(self) -> int:
+        return sum(len(v) for v in self.faults.values())
+
+    def __repr__(self) -> str:
+        return f"FaultSchedule({self.faults!r})"
+
+
+class ChaosInjector:
+    """Wraps a trainer-like object (``fit_batch`` + ``net``) and injects
+    scheduled faults.  Sits BETWEEN the ElasticTrainer and the real
+    trainer, so every injected fault exercises the real recovery path::
+
+        ElasticTrainer(ChaosInjector(trainer, schedule), ckpt_dir, ...)
+
+    Checkpoint-I/O faults (write crash, corrupt-on-disk) additionally need
+    ``attach_checkpoints(et.ckpt)`` to arm the manager wrappers.
+    """
+
+    def __init__(self, trainer, schedule: FaultSchedule,
+                 hang_seconds: float = 0.0,
+                 sleep_fn: Callable[[float], None] = time.sleep,
+                 seed: int = 0):
+        self.trainer = trainer
+        self.schedule = schedule
+        self.hang_seconds = hang_seconds
+        self.sleep_fn = sleep_fn
+        self.seed = seed
+        self.step = 0              # injector call index (1-based in events)
+        self.events: List[dict] = []   # (step, kind) log, replayable
+        self._ckpt = None
+        self._crash_next_write = False
+
+    # -- trainer protocol --------------------------------------------------
+
+    @property
+    def net(self):
+        return getattr(self.trainer, "net", self.trainer)
+
+    def _place_model(self) -> None:
+        if hasattr(self.trainer, "_place_model"):
+            self.trainer._place_model()
+
+    # -- checkpoint I/O faults ---------------------------------------------
+
+    def attach_checkpoints(self, ckpt) -> None:
+        """Arm checkpoint-write faults on a CheckpointManager: its ``save``
+        / ``save_async`` are wrapped so a scheduled CKPT_WRITE_CRASH makes
+        the NEXT write die mid-zip — a partial ``.tmp`` is left behind
+        (the stale-tmp leak CheckpointManager.__init__ cleans) and the
+        final rename never happens, exactly a crash between write and
+        rename."""
+        self._ckpt = ckpt
+        real_save, real_save_async = ckpt.save, ckpt.save_async
+
+        def save(net, step):
+            self._maybe_crash_write(step)
+            return real_save(net, step)
+
+        def save_async(net, step):
+            self._maybe_crash_write(step)
+            return real_save_async(net, step)
+
+        ckpt.save, ckpt.save_async = save, save_async
+
+    def _maybe_crash_write(self, step: int) -> None:
+        if not self._crash_next_write:
+            return
+        self._crash_next_write = False
+        tmp = self._ckpt._path(step) + ".tmp"
+        with open(tmp, "wb") as f:        # the torn half-written zip
+            f.write(b"PK\x03\x04 chaos: torn checkpoint write")
+        self._log(self.step, FaultKind.CKPT_WRITE_CRASH,
+                  f"crashed write of step {step}, stale tmp left")
+        raise RuntimeError(
+            "DATA_LOSS: chaos — checkpoint write crashed mid-zip")
+
+    def _corrupt_latest(self, kind: str) -> None:
+        if self._ckpt is None:
+            raise RuntimeError(f"{kind} scheduled but no CheckpointManager "
+                               "attached (call attach_checkpoints)")
+        latest = self._ckpt.latest()
+        if latest is None:
+            self._log(self.step, kind, "no checkpoint on disk yet — no-op")
+            return
+        path, step = latest
+        if kind == FaultKind.CKPT_TRUNCATE:
+            truncate_file(path)
+        else:
+            bitflip_file(path, n_flips=16, seed=self.seed + self.step)
+        self._log(self.step, kind, f"corrupted {os.path.basename(path)}")
+
+    # -- the wrapped step --------------------------------------------------
+
+    def _log(self, step: int, kind: str, detail: str = "") -> None:
+        self.events.append({"step": step, "kind": kind, "detail": detail})
+        logger.warning("chaos @%d: %s %s", step, kind, detail)
+
+    def injected(self, kind: Optional[str] = None) -> int:
+        if kind is None:
+            return len(self.events)
+        return sum(1 for e in self.events if e["kind"] == kind)
+
+    def fit_batch(self, ds):
+        self.step += 1
+        faults = self.schedule.pop(self.step)
+        for kind in faults:
+            if kind == FaultKind.DEVICE_LOSS:
+                self._log(self.step, kind)
+                raise RuntimeError("UNAVAILABLE: chaos — device lost")
+            if kind == FaultKind.CKPT_WRITE_CRASH:
+                self._crash_next_write = True   # fires inside the manager
+            elif kind in (FaultKind.CKPT_TRUNCATE, FaultKind.CKPT_BITFLIP):
+                self._corrupt_latest(kind)
+            elif kind == FaultKind.HUNG_STEP:
+                self._log(self.step, kind, f"sleeping {self.hang_seconds}s")
+                self.sleep_fn(self.hang_seconds)
+            elif kind == FaultKind.NAN_GRADS:
+                self._log(self.step, kind, "poisoning batch features")
+                ds = _poison_dataset(ds)
+        return self.trainer.fit_batch(ds)
+
+
+def _poison_dataset(ds):
+    """A copy of ``ds`` whose features are all-NaN — the forward/backward
+    then produces genuinely non-finite gradients, exercising the REAL
+    divergence-guard path (not a simulated flag)."""
+    feats = np.full_like(np.asarray(ds.features, dtype=np.float32), np.nan)
+    clone = type(ds)(feats, ds.labels, ds.features_mask, ds.labels_mask)
+    return clone

@@ -75,6 +75,19 @@ class CheckpointManager:
         self._executor = None
         self._pending = None
         os.makedirs(directory, exist_ok=True)
+        self._clean_stale_tmp()
+
+    def _clean_stale_tmp(self) -> None:
+        """Remove ``checkpoint_*.zip.tmp`` left by a crash mid-(async-)write.
+        The atomic-rename protocol means a .tmp is never the newest valid
+        state — without this they leak forever, one per crash."""
+        for fn in os.listdir(self.directory):
+            if fn.startswith("checkpoint_") and fn.endswith(".zip.tmp"):
+                try:
+                    os.remove(os.path.join(self.directory, fn))
+                    logger.info("removed stale checkpoint temp file %s", fn)
+                except OSError:
+                    pass
 
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"checkpoint_{step:010d}.zip")
@@ -137,7 +150,14 @@ class CheckpointManager:
         out = []
         for fn in sorted(os.listdir(self.directory)):
             if fn.startswith("checkpoint_") and fn.endswith(".zip"):
-                step = int(fn[len("checkpoint_"):-len(".zip")])
+                try:
+                    step = int(fn[len("checkpoint_"):-len(".zip")])
+                except ValueError:
+                    # a foreign/renamed file matching the glob must not
+                    # take down every list/prune/restore in the store
+                    logger.warning("skipping unparsable checkpoint filename "
+                                   "%s", fn)
+                    continue
                 out.append((os.path.join(self.directory, fn), step))
         return out
 
@@ -145,22 +165,61 @@ class CheckpointManager:
         ckpts = self.list_checkpoints()
         return ckpts[-1] if ckpts else None
 
+    def _quarantine(self, path: str) -> None:
+        """Rename a checkpoint that failed to load to ``<path>.corrupt`` —
+        keeps the evidence for post-mortem while taking it out of the
+        rotation, so the next restore/prune doesn't re-try (or protect)
+        a file that is known garbage."""
+        try:
+            os.replace(path, path + ".corrupt")
+            logger.warning("quarantined corrupt checkpoint as %s.corrupt",
+                           os.path.basename(path))
+        except OSError:
+            pass
+
     def restore_latest(self, loader: Callable[[str], Any]):
-        """→ (model, step) from the newest checkpoint, or (None, -1).
+        """→ (model, step) from the newest INTACT checkpoint, or (None, -1).
+
         Waits for any in-flight async write first, so the newest state is
         always restorable; a FAILED async write is logged and skipped —
         recovery must proceed from the newest checkpoint that did land,
-        not die on the write that didn't."""
+        not die on the write that didn't.  A checkpoint whose load fails
+        (truncated/bit-flipped zip, integrity-digest mismatch — serializer
+        format v4) is quarantined and restore falls through to the next
+        older one: a corrupt LATEST must cost one checkpoint interval, not
+        the whole job."""
         try:
             self.wait()
         except Exception as exc:
             logger.warning("in-flight async checkpoint write failed (%s) — "
                            "restoring from the newest on-disk checkpoint", exc)
-        latest = self.latest()
-        if latest is None:
-            return None, -1
-        path, step = latest
-        return loader(path), step
+        candidates = list(reversed(self.list_checkpoints()))
+        for path, step in candidates:
+            try:
+                return loader(path), step
+            except Exception as exc:
+                logger.error("checkpoint %s failed to load (%s: %s) — "
+                             "falling back to the next older checkpoint",
+                             os.path.basename(path), type(exc).__name__, exc)
+                self._quarantine(path)
+        if candidates:
+            logger.error("all %d checkpoints failed to load — restarting "
+                         "from current in-memory params", len(candidates))
+        return None, -1
+
+
+class StepHangError(RuntimeError):
+    """The step watchdog fired: a dispatch exceeded ``step_timeout`` wall
+    clock.  Message carries DEADLINE_EXCEEDED so the default
+    FailureDetector classifies it as recoverable."""
+
+    def __init__(self, elapsed: float, timeout: float):
+        super().__init__(
+            f"DEADLINE_EXCEEDED: step watchdog — dispatch took "
+            f"{elapsed:.1f}s (> step_timeout={timeout:.1f}s); treating the "
+            "step as hung and recovering from checkpoint")
+        self.elapsed = elapsed
+        self.timeout = timeout
 
 
 class FailureDetector:
@@ -173,7 +232,8 @@ class FailureDetector:
     #: and burn the restart budget re-hitting them
     RECOVERABLE_MARKERS = ("DEADLINE_EXCEEDED", "UNAVAILABLE", "DATA_LOSS",
                            "ABORTED", "device halted", "device lost",
-                           "connection reset", "socket closed")
+                           "connection reset", "socket closed",
+                           "non-finite gradient")
 
     def is_recoverable(self, exc: Exception) -> bool:
         if isinstance(exc, (ValueError, TypeError, KeyError)):
@@ -194,6 +254,17 @@ class ElasticTrainer:
     On a recoverable failure: rebuild (via ``rebuild_fn``, e.g. re-creating
     the mesh over surviving devices), restore the newest checkpoint, and
     continue from there.  ``max_restarts`` bounds the retry budget.
+
+    Restart pacing: ``backoff_base > 0`` sleeps
+    ``min(backoff_max, backoff_base * 2**(restarts-1))`` scaled by a seeded
+    jitter factor between restore attempts — at pod scale, thousands of
+    workers restarting in lockstep re-stampede the very storage/network
+    that just failed; the jitter decorrelates them.  ``step_timeout``
+    arms a wall-clock watchdog: a dispatch that neither completes nor
+    raises (hung collective, dead tunnel) is converted into a recoverable
+    :class:`StepHangError` instead of blocking forever.  ``sleep_fn`` /
+    ``clock`` are injectable so recovery timing is testable with a fake
+    clock (tests/test_chaos.py).
     """
 
     def __init__(self, trainer, checkpoint_dir: str,
@@ -205,7 +276,16 @@ class ElasticTrainer:
                  loader: Optional[Callable[[str], Any]] = None,
                  sync_every: int = 10,
                  restart_reset_after: Optional[int] = None,
-                 async_checkpoints: bool = False):
+                 async_checkpoints: bool = False,
+                 backoff_base: float = 0.0,
+                 backoff_max: float = 30.0,
+                 backoff_jitter: float = 0.5,
+                 jitter_seed: Optional[int] = None,
+                 step_timeout: Optional[float] = None,
+                 sleep_fn: Callable[[float], None] = time.sleep,
+                 clock: Callable[[], float] = time.monotonic):
+        import random
+
         self.trainer = trainer
         self.ckpt = CheckpointManager(checkpoint_dir, keep_last)
         self.checkpoint_every = max(1, checkpoint_every)
@@ -215,8 +295,23 @@ class ElasticTrainer:
         self.loader = loader or self._default_loader
         self.sync_every = max(1, sync_every)
         self.async_checkpoints = async_checkpoints
+        self.backoff_base = backoff_base
+        self.backoff_max = backoff_max
+        self.backoff_jitter = backoff_jitter
+        self._jitter_rng = random.Random(jitter_seed)
+        self.step_timeout = step_timeout
+        self.sleep_fn = sleep_fn
+        self.clock = clock
         self.restarts = 0        # consecutive-failure budget (resets)
         self.total_restarts = 0  # lifetime count, for observability
+        self.recovery_seconds = 0.0  # total wall clock spent in recovery
+        self.backoff_sleeps: List[float] = []  # delays slept, observability
+        # the watchdog arms only after one step has completed since the
+        # last (re)start (it re-disarms on every recovery): the first step
+        # jit-compiles (unbounded, legitimate wall clock) and a restore
+        # re-places + recompiles — counting compile time as a hang would
+        # turn every cold start into a spurious recovery loop
+        self._watchdog_armed = False
         self.global_step = 0
         # max_restarts bounds CONSECUTIVE failures, not lifetime failures:
         # after this many successful steps the counter resets, so a
@@ -254,6 +349,45 @@ class ElasticTrainer:
         self.global_step = step
         logger.info("restored checkpoint @ step %d", step)
 
+    def _materialize(self, loss) -> None:
+        """Force the device barrier (``loss.value()``), under the watchdog
+        when ``step_timeout`` is armed: a dispatch that never completes
+        (hung collective, dead device tunnel) raises neither — the read
+        just blocks.  Running the read on a worker thread bounds the wait;
+        on timeout the worker is abandoned (it stays parked on the dead
+        dispatch) and the step surfaces as a recoverable StepHangError."""
+        if self.step_timeout is None or not self._watchdog_armed:
+            loss.value()
+            return
+        import threading
+        box: dict = {}
+
+        def read():
+            try:
+                box["v"] = loss.value()
+            except Exception as exc:  # surfaced below, on the caller
+                box["e"] = exc
+
+        # a bare DAEMON thread, not an executor worker: a genuinely hung
+        # read parks this thread forever, and a non-daemon worker would
+        # then block interpreter exit at the executor's atexit join
+        t = threading.Thread(target=read, daemon=True, name="step-watchdog")
+        t.start()
+        t.join(self.step_timeout)
+        if t.is_alive():
+            raise StepHangError(self.step_timeout, self.step_timeout)
+        if "e" in box:
+            raise box["e"]
+
+    def _backoff_delay(self) -> float:
+        """Exponential backoff with seeded jitter for restart ``restarts``
+        (1-based; call after incrementing).  0 when backoff is disabled."""
+        if self.backoff_base <= 0:
+            return 0.0
+        base = min(self.backoff_max,
+                   self.backoff_base * (2.0 ** (self.restarts - 1)))
+        return base * (1.0 + self.backoff_jitter * self._jitter_rng.random())
+
     def fit_batch(self, ds) -> float:
         """One step with checkpoint + recovery semantics.
 
@@ -261,8 +395,13 @@ class ElasticTrainer:
         device failure would otherwise surface at some later read, outside
         this try block.  Materializing every ``sync_every`` steps keeps the
         failure inside the recovery loop while amortizing the host sync —
-        at most sync_every steps are replayed from the last checkpoint."""
+        at most sync_every steps are replayed from the last checkpoint.
+        With ``step_timeout`` set, a step whose wall clock exceeds the
+        budget — whether it blocked in the dispatch (caught by the
+        watchdog thread) or crawled through a degraded link (caught by the
+        elapsed check) — is treated as hung and recovered."""
         while True:
+            t_start = self.clock()
             try:
                 loss = self.trainer.fit_batch(ds)
                 self.global_step += 1
@@ -273,7 +412,12 @@ class ElasticTrainer:
                     # before a checkpoint write, so a latent failure can't
                     # first materialize mid-save and corrupt the newest
                     # checkpoint
-                    loss.value()
+                    self._materialize(loss)
+                if self.step_timeout is not None:
+                    elapsed = self.clock() - t_start
+                    if self._watchdog_armed and elapsed > self.step_timeout:
+                        raise StepHangError(elapsed, self.step_timeout)
+                    self._watchdog_armed = True
                 if saving:
                     if self.async_checkpoints:
                         # zip/deflate overlaps the next training steps;
@@ -291,6 +435,7 @@ class ElasticTrainer:
             except Exception as exc:
                 if not self.detector.is_recoverable(exc):
                     raise
+                t_fail = self.clock()
                 self._ok_steps = 0
                 self.restarts += 1
                 self.total_restarts += 1
@@ -298,6 +443,12 @@ class ElasticTrainer:
                 if self.restarts > self.max_restarts:
                     raise RuntimeError(
                         f"exceeded max_restarts={self.max_restarts}") from exc
+                delay = self._backoff_delay()
+                if delay > 0:
+                    logger.info("backing off %.2fs before restart %d "
+                                "(exponential + jitter)", delay, self.restarts)
+                    self.backoff_sleeps.append(delay)
+                    self.sleep_fn(delay)
                 if self.rebuild_fn is not None:
                     self.trainer = self.rebuild_fn()
                 self._restore()
@@ -306,6 +457,10 @@ class ElasticTrainer:
                 # next step, or the jit step sees uncommitted inputs
                 if hasattr(self.trainer, "_place_model"):
                     self.trainer._place_model()
+                # re-placement/rebuild recompiles: the next step gets the
+                # cold-start compile grace again
+                self._watchdog_armed = False
+                self.recovery_seconds += self.clock() - t_fail
 
     def fit(self, data, epochs: int = 1) -> List[float]:
         losses: List[float] = []

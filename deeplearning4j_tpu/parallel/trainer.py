@@ -63,7 +63,8 @@ class ShardedTrainer:
                  grad_compression: Optional[str] = None,
                  dcn_axis: str = DCN_AXIS,
                  compression_threshold: Optional[float] = None,
-                 compression_bucket_mb: float = 4.0):
+                 compression_bucket_mb: float = 4.0,
+                 nan_guard: Optional[int] = None):
         from .pipeline import SCHEDULES
         from ..ops import compression as _compression
         if pipeline_schedule not in SCHEDULES:
@@ -101,6 +102,21 @@ class ShardedTrainer:
                         f"grad_compression composes with dcn×data parallelism "
                         f"only (axis {ax!r} has size {size}); drop the axis "
                         "or run grad_compression=None")
+        # divergence guard (opt-in; None = the exact pre-guard programs):
+        # dense path rides the net's own guarded step; the compressed path
+        # builds its guard into the two-tier shard_map step so a skipped
+        # step ALSO skips residual accumulation — otherwise the error-
+        # feedback state would absorb the poisoned gradient and replay it
+        # on the next (healthy) step
+        self.nan_guard = nan_guard
+        self._bad_steps = 0
+        if nan_guard is not None:
+            if grad_compression is None:
+                if not hasattr(net, "set_nan_guard"):
+                    raise NotImplementedError(
+                        f"nan_guard is not supported for "
+                        f"{type(net).__name__} yet (needs set_nan_guard)")
+                net.set_nan_guard(nan_guard)
         # microbatch order for nets that pipeline over a `pipe` axis
         # (parallel/pipeline.py): forwarded to the wrapped net when it
         # carries a schedule knob (ShardedTransformerLM); layer-stack nets
@@ -249,6 +265,7 @@ class ShardedTrainer:
         method, thr = self.grad_compression, self.compression_threshold
         bucketer = C.GradBucketer(net.params, self.compression_bucket_bytes)
         is_graph = isinstance(net.params, dict)
+        guard = self.nan_guard is not None
 
         def device_step(params, state, opt_state, it, x, y, rng, m, lm,
                         residual):
@@ -268,6 +285,15 @@ class ShardedTrainer:
                 loss_fn, has_aux=True)(params)
             # tier 1: dense ICI allreduce — free at ICI bandwidth
             grads = jax.lax.pmean(grads, data)
+            if guard:
+                # divergence guard: decided BEFORE the compressed exchange
+                # and agreed GLOBALLY (pmin over both DP tiers) — one
+                # slice skipping while another applies would fork the
+                # replicated params across slices
+                ok = jnp.isfinite(loss)
+                for g in jax.tree_util.tree_leaves(grads):
+                    ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(g)))
+                ok = jax.lax.pmin(ok.astype(jnp.int32), (data, dcn)) > 0
             # tier 2: bucketed compressed DCN exchange with error feedback.
             # acc = slice gradient + what previous steps failed to send;
             # the un-transmitted part of acc becomes the next residual —
@@ -294,14 +320,29 @@ class ShardedTrainer:
                 if jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact) else a,
                 new_state)
             loss = jax.lax.pmean(jax.lax.pmean(loss, data), dcn)
+            if guard:
+                # skip the WHOLE step on a non-finite gradient: params,
+                # opt state, bn state, AND the error-feedback residual
+                # stay bit-identical (the residual otherwise absorbs the
+                # poisoned acc and re-injects it next step)
+                sel = lambda n, o: jax.tree_util.tree_map(  # noqa: E731
+                    lambda a, b: jnp.where(ok, a, b), n, o)
+                new_params = sel(new_params, params)
+                new_state = sel(new_state, state)
+                new_opt = sel(new_opt, opt_state)
+                new_res = sel(new_res, res)
+                new_res = jax.tree_util.tree_map(lambda a: a[None], new_res)
+                return (new_params, new_state, new_opt, new_res, loss,
+                        ok.astype(jnp.int32))
             new_res = jax.tree_util.tree_map(lambda a: a[None], new_res)
             return new_params, new_state, new_opt, new_res, loss
 
         pb = P((dcn, data))
+        out_specs = (P(), P(), P(), P(dcn), P()) + ((P(),) if guard else ())
         stepped = shard_map(
             device_step, mesh=mesh,
             in_specs=(P(), P(), P(), P(), pb, pb, P(), pb, pb, P(dcn)),
-            out_specs=(P(), P(), P(), P(dcn), P()), check_vma=False)
+            out_specs=out_specs, check_vma=False)
         return jax.jit(stepped, donate_argnums=(0, 1, 2, 9))
 
     def _fit_batch_compressed(self, ds: DataSet):
@@ -324,15 +365,39 @@ class ShardedTrainer:
                 y = {net.conf.network_outputs[0]: y}
                 m = {net.conf.network_inputs[0]: m}
                 lm = {net.conf.network_outputs[0]: lm}
-            (net.params, net.state, net.opt_state, net.grad_residual,
-             loss) = self._compressed_step(
+            outs = self._compressed_step(
                 net.params, net.state, net.opt_state, net._iter_scalar(1),
                 x, y, sub, m, lm, net.grad_residual)
+            (net.params, net.state, net.opt_state, net.grad_residual,
+             loss) = outs[:5]
             net.iteration += 1
+            if self.nan_guard is not None:
+                self._note_guarded_step(bool(outs[5]))
             score = LazyScore(loss)
             for lst in net.listeners:
                 lst.iteration_done(net, net.iteration, score)
             return score
+
+    def _note_guarded_step(self, ok: bool) -> None:
+        """Budget accounting for the compressed path's guard (the dense
+        path uses the net's own counter — same semantics)."""
+        from ..nn.multilayer import DivergenceError
+        import logging
+
+        if ok:
+            self._bad_steps = 0
+            return
+        self._bad_steps += 1
+        logging.getLogger("deeplearning4j_tpu").warning(
+            "non-finite gradients at iteration %d (compressed exchange) — "
+            "update + residual accumulation skipped (%d/%d bad steps)",
+            self.net.iteration, self._bad_steps, self.nan_guard)
+        if self._bad_steps > self.nan_guard:
+            # self-resetting on escalation (same semantics as the net's
+            # guard): the catcher restores a checkpoint and the fresh run
+            # gets a fresh budget
+            bad, self._bad_steps = self._bad_steps, 0
+            raise DivergenceError(bad, self.nan_guard)
 
     # -- training ----------------------------------------------------------
 

@@ -40,8 +40,25 @@ import numpy as np
 #    readers reject v3 zips instead of resuming with a truncated state.
 #    Loading v1/v2 zips stays supported (no residual → trainers re-init
 #    zeros).
-FORMAT_VERSION = 3
-SUPPORTED_VERSIONS = (1, 2, 3)
+# 4: meta.json carries "integrity": {entry_name: sha256 hex} over every
+#    other zip entry's raw bytes.  Zip's own per-entry CRC32 only protects
+#    the deflate stream — a bit flip in the central directory, a torn
+#    write, or an entry swapped between checkpoints can still hand the
+#    loader plausible-looking garbage.  The digest is verified on load
+#    (CheckpointIntegrityError on mismatch) so restore can fall back to an
+#    older intact checkpoint instead of resuming from corrupt state
+#    (parallel/elastic.py CheckpointManager.restore_latest).  v1-v3 zips
+#    (no "integrity" key) still load, unverified.
+FORMAT_VERSION = 4
+SUPPORTED_VERSIONS = (1, 2, 3, 4)
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A checkpoint entry's bytes do not match the digest recorded in
+    meta.json — the file was truncated, bit-flipped, or otherwise
+    corrupted after it was written.  RuntimeError (not ValueError) so the
+    elastic FailureDetector classifies it as a recoverable storage
+    failure, not a programming error."""
 
 
 def _flatten_tree(tree, prefix="") -> Dict[str, np.ndarray]:
@@ -97,28 +114,51 @@ def _unflatten_into(template, flat: Dict[str, np.ndarray], prefix=""):
         f"checkpoint written by a newer format or a mismatched config?)")
 
 
+def _digest(data: bytes) -> str:
+    import hashlib
+
+    return hashlib.sha256(data).hexdigest()
+
+
 def save_model(net, path: str, save_updater: bool = True) -> None:
+    entries = {"configuration.json":
+               json.dumps(net.conf.to_dict(), indent=1).encode(),
+               "params.npz": _npz_bytes(_flatten_tree(net.params)),
+               "state.npz": _npz_bytes(_flatten_tree(net.state))}
+    if save_updater:
+        entries["updater.npz"] = _npz_bytes(_flatten_tree(net.opt_state))
+    residual = getattr(net, "grad_residual", None)
+    if residual is not None:
+        entries["grad_residual.npz"] = _npz_bytes(_flatten_tree(residual))
+    meta = {
+        "format_version": FORMAT_VERSION,
+        "iteration": net.iteration,
+        "epoch": net.epoch,
+        "model_class": getattr(net, "_model_class", type(net).__name__),
+        # end-to-end digests over the entry bytes (v4): meta.json is tiny
+        # and parsed (json errors surface on their own), everything else
+        # is verified against these on load
+        "integrity": {name: _digest(data) for name, data in entries.items()},
+    }
     with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-        zf.writestr("configuration.json", json.dumps(net.conf.to_dict(), indent=1))
-        zf.writestr("meta.json", json.dumps({
-            "format_version": FORMAT_VERSION,
-            "iteration": net.iteration,
-            "epoch": net.epoch,
-            "model_class": getattr(net, "_model_class", type(net).__name__),
-        }))
-        zf.writestr("params.npz", _npz_bytes(_flatten_tree(net.params)))
-        zf.writestr("state.npz", _npz_bytes(_flatten_tree(net.state)))
-        if save_updater:
-            zf.writestr("updater.npz", _npz_bytes(_flatten_tree(net.opt_state)))
-        residual = getattr(net, "grad_residual", None)
-        if residual is not None:
-            zf.writestr("grad_residual.npz",
-                        _npz_bytes(_flatten_tree(residual)))
+        zf.writestr("meta.json", json.dumps(meta))
+        for name, data in entries.items():
+            zf.writestr(name, data)
+
+
+def _read_verified(zf: "zipfile.ZipFile", name: str, integrity, path) -> bytes:
+    data = zf.read(name)
+    want = (integrity or {}).get(name)
+    if want is not None and _digest(data) != want:
+        raise CheckpointIntegrityError(
+            f"checkpoint entry {name!r} in {path!r} fails its sha256 digest "
+            "— the file is corrupt (torn write / bit flip); restore from an "
+            "older checkpoint")
+    return data
 
 
 def load_model(path: str, load_updater: bool = True):
     with zipfile.ZipFile(path, "r") as zf:
-        conf_d = json.loads(zf.read("configuration.json"))
         meta = json.loads(zf.read("meta.json"))
         ver = meta.get("format_version", 1)
         if ver not in SUPPORTED_VERSIONS:
@@ -126,12 +166,19 @@ def load_model(path: str, load_updater: bool = True):
                 f"checkpoint format v{ver} not supported (reader knows "
                 f"{SUPPORTED_VERSIONS}); re-save with a matching framework "
                 "version")
-        params_flat = _load_npz(zf.read("params.npz"))
-        state_flat = _load_npz(zf.read("state.npz"))
+        integrity = meta.get("integrity")  # absent in v1-v3: load unverified
+        conf_d = json.loads(_read_verified(zf, "configuration.json",
+                                           integrity, path))
+        params_flat = _load_npz(_read_verified(zf, "params.npz", integrity,
+                                               path))
+        state_flat = _load_npz(_read_verified(zf, "state.npz", integrity,
+                                              path))
         names = zf.namelist()
-        upd_flat = _load_npz(zf.read("updater.npz")) if (
+        upd_flat = _load_npz(_read_verified(
+            zf, "updater.npz", integrity, path)) if (
             load_updater and "updater.npz" in names) else None
-        resid_flat = _load_npz(zf.read("grad_residual.npz")) if (
+        resid_flat = _load_npz(_read_verified(
+            zf, "grad_residual.npz", integrity, path)) if (
             "grad_residual.npz" in names) else None
 
     if conf_d.get("type") == "ComputationGraphConfiguration":

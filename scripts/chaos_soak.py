@@ -1,0 +1,229 @@
+"""Chaos soak: a scripted fault schedule against a REAL training loop.
+
+Three arms over the same seeded MLP/blobs workload:
+
+  baseline  — plain ``net.fit_batch`` loop, no wrapper (the pre-change
+              trainer's math)
+  elastic   — ElasticTrainer around the same net, NO faults, guard off:
+              must reproduce the baseline loss curve BIT-FOR-BIT (chaos
+              machinery disabled ⇒ zero behavior change)
+  chaos     — ElasticTrainer + ChaosInjector firing ≥5 distinct fault
+              kinds (device loss, checkpoint-write crash mid-zip,
+              truncated + bit-flipped latest checkpoint, hung step,
+              NaN-poisoned gradients incl. a budget-escalation pair),
+              with backoff+jitter, the step watchdog, and the divergence
+              guard armed: must complete with ZERO unrecovered failures,
+              fall back to the newest INTACT checkpoint when the latest
+              is corrupt (quarantining the corrupt file), and land within
+              loss tolerance of the fault-free arm
+
+Also verifies the stale-``.tmp`` cleanup contract: the mid-zip write crash
+leaves a torn temp file; re-opening the checkpoint directory removes it.
+
+Prints ONE JSON line on stdout (bench.py's subprocess contract).  Usage:
+
+    JAX_PLATFORMS=cpu python scripts/chaos_soak.py [--quick]
+"""
+
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+QUICK = "--quick" in sys.argv or os.environ.get("BENCH_QUICK", "0") == "1"
+
+import numpy as np  # noqa: E402
+
+
+def _mlp(seed=3, lr=0.05):
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import (
+        MultiLayerNetwork, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.updaters import Sgd
+
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(lr=lr))
+            .layer(Dense(n_out=32, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(16)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def _data(batch=96):
+    from deeplearning4j_tpu.datasets import DataSet
+
+    rng = np.random.default_rng(0)
+    centers = rng.normal(size=(3, 16)) * 3
+    ys = rng.integers(0, 3, batch)
+    xs = (centers[ys] + rng.normal(size=(batch, 16))).astype(np.float32)
+    return DataSet(xs, np.eye(3, dtype=np.float32)[ys])
+
+
+class _Plain:
+    """Minimal trainer wrapper (fit_batch + net) for ElasticTrainer."""
+
+    def __init__(self, net):
+        self.net = net
+
+    def fit_batch(self, ds):
+        return self.net.fit_batch(ds)
+
+
+def _schedule(FaultKind, FaultSchedule, steps):
+    """The scripted soak schedule — 6 fault kinds, ≥8 injections, placed
+    so the corrupt-latest → fallback path is guaranteed: corruption and
+    the device loss that forces the restore land in the SAME injector
+    step (ordered list), before any fresh checkpoint write can replace
+    the corrupted latest."""
+    q1, mid, q3 = steps // 4, steps // 2, (3 * steps) // 4
+    return FaultSchedule.scripted({
+        q1: FaultKind.DEVICE_LOSS,
+        q1 + 2: FaultKind.CKPT_WRITE_CRASH,
+        # ≥2 steps after the last recovery: the watchdog re-arms after one
+        # completed step (compile grace), so the hang lands armed
+        q1 + 4: FaultKind.HUNG_STEP,
+        # corrupt the newest on-disk checkpoint AND lose the device in one
+        # step: restore MUST skip the corrupt latest and fall back
+        mid: [FaultKind.CKPT_TRUNCATE, FaultKind.DEVICE_LOSS],
+        mid + 3: FaultKind.NAN_GRADS,                  # single skip
+        q3: [FaultKind.NAN_GRADS],                     # escalation pair:
+        q3 + 1: [FaultKind.NAN_GRADS],                 # budget 1 → restore
+        q3 + 3: [FaultKind.CKPT_BITFLIP, FaultKind.DEVICE_LOSS],
+    })
+
+
+def run_soak(quick=QUICK, ckpt_root=None):
+    import tempfile
+
+    from deeplearning4j_tpu.parallel import (
+        ChaosInjector, CheckpointManager, ElasticTrainer, FailureDetector,
+        FaultKind, FaultSchedule,
+    )
+
+    class RecordingDetector(FailureDetector):
+        """Records the exception type of every recovered failure, so the
+        soak can assert each fault class took its intended recovery path
+        (e.g. the hang really went through the watchdog)."""
+
+        def __init__(self):
+            self.failures = []
+
+        def on_failure(self, exc, attempt):
+            self.failures.append(type(exc).__name__)
+            super().on_failure(exc, attempt)
+
+    steps = 24 if quick else 60
+    hang = 2.0 if quick else 4.0
+    timeout = 0.8 if quick else 1.5
+    ds = _data()
+    ckpt_root = ckpt_root or tempfile.mkdtemp(prefix="chaos_soak_")
+
+    out = {"config": "chaos_recovery", "platform": "cpu", "steps": steps}
+
+    # -- arm 1: baseline (the pre-change trainer's math) -------------------
+    base_net = _mlp()
+    base = [float(base_net.fit_batch(ds)) for _ in range(steps)]
+
+    # -- arm 2: elastic wrapper, chaos OFF → bit-identical -----------------
+    el_dir = os.path.join(ckpt_root, "elastic_off")
+    et_off = ElasticTrainer(_Plain(_mlp()), el_dir, checkpoint_every=8,
+                            sync_every=4, step_timeout=timeout,
+                            backoff_base=0.05, jitter_seed=7)
+    off = [float(et_off.fit_batch(ds)) for _ in range(steps)]
+    out["disabled_bitwise"] = off == base
+
+    # -- arm 3: chaos ------------------------------------------------------
+    chaos_dir = os.path.join(ckpt_root, "chaos")
+    net = _mlp()
+    net.set_nan_guard(1)
+    sched = _schedule(FaultKind, FaultSchedule, steps)
+    n_scheduled = sched.pending()
+    inj = ChaosInjector(_Plain(net), sched, hang_seconds=hang, seed=11)
+    detector = RecordingDetector()
+    et = ElasticTrainer(inj, chaos_dir, checkpoint_every=2, sync_every=1,
+                        max_restarts=4, keep_last=4,
+                        backoff_base=0.05, backoff_max=0.5, jitter_seed=7,
+                        step_timeout=timeout, failure_detector=detector)
+    inj.attach_checkpoints(et.ckpt)
+
+    t0 = time.perf_counter()
+    unrecovered = None
+    losses = []
+    try:
+        for _ in range(steps):
+            losses.append(float(et.fit_batch(ds)))
+        unrecovered = 0
+    except Exception as exc:  # a fault the stack could not recover from
+        unrecovered = 1
+        out["unrecovered_error"] = f"{type(exc).__name__}: {exc}"[:300]
+    wall = time.perf_counter() - t0
+
+    kinds_injected = sorted({e["kind"] for e in inj.events})
+    out.update({
+        "unrecovered": unrecovered,
+        "faults_scheduled": n_scheduled,
+        "faults_injected": len(inj.events),
+        "faults_pending": sched.pending(),
+        "fault_kinds": kinds_injected,
+        "n_fault_kinds": len(kinds_injected),
+        "recoveries": et.total_restarts,
+        "recovery_seconds": round(et.recovery_seconds, 3),
+        "backoff_sleeps": [round(s, 4) for s in et.backoff_sleeps],
+        "wall_seconds": round(wall, 2),
+        "events": inj.events,
+        "recovered_failure_types": detector.failures,
+    })
+    # each fault class took its INTENDED recovery path
+    out["hang_recovered_by_watchdog"] = "StepHangError" in detector.failures
+    out["divergence_escalated"] = "DivergenceError" in detector.failures
+    # corrupt-latest fallback really happened: the corrupted checkpoints
+    # were quarantined (restore skipped them and loaded an older intact
+    # one — had it died on them, `unrecovered` would be 1)
+    quarantined = glob.glob(os.path.join(chaos_dir, "*.corrupt"))
+    out["corrupt_checkpoints_quarantined"] = len(quarantined)
+    out["intact_fallback_ok"] = unrecovered == 0 and len(quarantined) >= 1
+
+    # stale-tmp cleanup contract: plant a torn temp (the write-crash fault
+    # leaves one too, unless a later save of the same step overwrote it),
+    # re-open the directory, it must be gone
+    stale = os.path.join(chaos_dir, "checkpoint_9999999999.zip.tmp")
+    with open(stale, "wb") as f:
+        f.write(b"torn")
+    CheckpointManager(chaos_dir)
+    out["stale_tmp_cleaned"] = not os.path.exists(stale)
+
+    # loss parity vs the fault-free arm: recovery replays rolled-back
+    # steps from the checkpoint, so the chaos arm may lag the baseline by
+    # a few effective steps — the criterion is converging to the same
+    # solution, not step-for-step identity
+    out["final_loss"] = {"baseline": base[-1],
+                         "chaos": losses[-1] if losses else None}
+    tol = 0.25 * base[-1] + 0.05
+    out["loss_parity_tolerance"] = round(tol, 6)
+    out["loss_parity_ok"] = bool(
+        losses and abs(losses[-1] - base[-1]) <= tol)
+    out["chaos_learns"] = bool(losses and losses[-1] < 0.3 * losses[0])
+    out["soak_ok"] = bool(
+        unrecovered == 0 and out["faults_pending"] == 0
+        and out["n_fault_kinds"] >= 5 and out["intact_fallback_ok"]
+        and out["stale_tmp_cleaned"] and out["disabled_bitwise"]
+        and out["hang_recovered_by_watchdog"] and out["divergence_escalated"]
+        and out["loss_parity_ok"] and out["chaos_learns"])
+    return out
+
+
+def main() -> None:
+    out = run_soak()
+    print(json.dumps(out), flush=True)
+    if not out["soak_ok"]:
+        raise SystemExit(2)
+
+
+if __name__ == "__main__":
+    main()

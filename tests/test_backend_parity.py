@@ -91,10 +91,23 @@ class TestPrecisionPolicyParity:
         steps = 40 if kind == "lstm" else STEPS  # recurrent path learns slower
         net32, l32 = _train(conf, ds, "float32", steps)
         net16, l16 = _train(conf, ds, "bfloat16", steps)
-        # identical init/seed/data → curves track within bf16 rounding drift
+        # identical init/seed/data → curves track within bf16 rounding drift.
+        # The relative envelope is only meaningful while the f32 loss is —
+        # lenet trains this toy task to ~1e-4, where rel = |gap| / l32
+        # blows up on a collapsed denominator (measured: rel ≤ 0.12 while
+        # l32 > 0.05, then 0.96 at l32 ≈ 1e-3 with an ABSOLUTE gap < 1e-3;
+        # deterministic on this box, not a flake — the pre-PR-3 unmasked
+        # median deterministically read 0.2225).  So: relative drift over
+        # the learning phase, absolute gap over the whole curve.
         rel = np.abs(l16 - l32) / np.maximum(np.abs(l32), 1e-3)
         assert rel[0] < 0.05, f"step-0 loss diverged: {l32[0]} vs {l16[0]}"
-        assert np.median(rel) < 0.15, f"median rel drift {np.median(rel):.3f}"
+        meaningful = l32 > 0.05
+        assert meaningful.any()
+        med = np.median(rel[meaningful])
+        assert med < 0.15, f"median rel drift {med:.3f} (learning phase)"
+        # measured max |gap|: 0.032 (lenet), well under 0.08 on all three
+        gap = np.abs(l16 - l32).max()
+        assert gap < 0.08 * l32[0], f"abs loss gap {gap:.4f}"
         # both must actually learn
         assert l32[-1] < 0.5 * l32[0]
         assert l16[-1] < 0.5 * l16[0]

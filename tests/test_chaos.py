@@ -1,0 +1,605 @@
+"""Chaos-tested fault tolerance (PR 3): checkpoint integrity (serializer
+v4), corrupt-checkpoint restore fallback, the divergence guard, elastic
+backoff/watchdog timing with a fake clock, deterministic fault schedules,
+and the end-to-end chaos soak."""
+
+import json
+import os
+import zipfile
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import (
+    DivergenceError, MultiLayerNetwork, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+from deeplearning4j_tpu.parallel import (
+    ChaosInjector, CheckpointManager, ElasticTrainer, FailureDetector,
+    FaultKind, FaultSchedule, StepHangError, bitflip_file, truncate_file,
+)
+from deeplearning4j_tpu.utils.serializer import (
+    CheckpointIntegrityError, load_model, save_model,
+)
+
+
+def small_net(seed=5):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(lr=0.01))
+            .layer(Dense(n_out=8, activation="relu"))
+            .layer(OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def data(n=64):
+    rng = np.random.default_rng(0)
+    xs = np.concatenate([rng.normal(-2, 1, (n // 2, 4)),
+                         rng.normal(2, 1, (n // 2, 4))]).astype(np.float32)
+    ys = np.zeros((n, 2), np.float32)
+    ys[:n // 2, 0] = 1
+    ys[n // 2:, 1] = 1
+    return DataSet(xs, ys)
+
+
+def nan_data(n=64):
+    ds = data(n)
+    return DataSet(np.full_like(ds.features, np.nan), ds.labels)
+
+
+def leaves(tree):
+    return [np.asarray(l) for l in jax.tree_util.tree_leaves(tree)]
+
+
+def trees_equal(a, b):
+    return all(np.array_equal(x, y) for x, y in zip(leaves(a), leaves(b)))
+
+
+class Plain:
+    def __init__(self, net):
+        self.net = net
+
+    def fit_batch(self, ds):
+        return self.net.fit_batch(ds)
+
+
+# ---------------------------------------------------------------------------
+# serializer v4: per-entry integrity digests
+# ---------------------------------------------------------------------------
+
+class TestSerializerIntegrity:
+    def test_v4_roundtrip_writes_digests(self, tmp_path):
+        net = small_net()
+        net.fit_batch(data())
+        p = str(tmp_path / "m.zip")
+        net.save(p)
+        with zipfile.ZipFile(p) as zf:
+            meta = json.loads(zf.read("meta.json"))
+        assert meta["format_version"] == 4
+        assert set(meta["integrity"]) == {
+            "configuration.json", "params.npz", "state.npz", "updater.npz"}
+        m = load_model(p)
+        x = data().features[:4]
+        np.testing.assert_allclose(m.output(x), net.output(x), rtol=1e-5)
+
+    def test_tampered_entry_raises_integrity_error(self, tmp_path):
+        net = small_net()
+        p, p2 = str(tmp_path / "m.zip"), str(tmp_path / "bad.zip")
+        net.save(p)
+        # rebuild the zip with one flipped byte inside params.npz — zip's
+        # own CRC is recomputed by writestr, so only the v4 digest catches
+        with zipfile.ZipFile(p) as zin, zipfile.ZipFile(p2, "w") as zout:
+            for name in zin.namelist():
+                b = zin.read(name)
+                if name == "params.npz":
+                    b = b[:200] + bytes([b[200] ^ 0xFF]) + b[201:]
+                zout.writestr(name, b)
+        with pytest.raises(CheckpointIntegrityError, match="params.npz"):
+            load_model(p2)
+
+    def test_v3_zip_without_integrity_still_loads(self, tmp_path):
+        """Back-compat: pre-v4 checkpoints (no integrity key) load
+        unverified — the v3→v4 migration path, including the v3 residual
+        entry (a v3 zip carrying grad_residual.npz must restore it)."""
+        net = small_net()
+        net.grad_residual = [
+            {k: np.ones((2,) + tuple(v.shape), np.float32)
+             for k, v in layer.items()} for layer in net.params]
+        p, p3 = str(tmp_path / "m.zip"), str(tmp_path / "v3.zip")
+        save_model(net, p)
+        with zipfile.ZipFile(p) as zin, zipfile.ZipFile(p3, "w") as zout:
+            for name in zin.namelist():
+                b = zin.read(name)
+                if name == "meta.json":
+                    meta = json.loads(b)
+                    del meta["integrity"]
+                    meta["format_version"] = 3
+                    b = json.dumps(meta).encode()
+                zout.writestr(name, b)
+        m = load_model(p3)
+        x = data().features[:4]
+        np.testing.assert_allclose(m.output(x), net.output(x), rtol=1e-5)
+        assert m.grad_residual is not None
+        assert trees_equal(net.grad_residual, m.grad_residual)
+
+    def test_v4_roundtrip_with_grad_residual(self, tmp_path):
+        """v3's grad_residual.npz rides v4 unchanged, digest-verified:
+        restore must carry the error-feedback residual bit-for-bit."""
+        net = small_net()
+        net.grad_residual = [
+            {k: np.random.default_rng(1).normal(
+                size=(2,) + tuple(v.shape)).astype(np.float32)
+             for k, v in layer.items()} for layer in net.params]
+        p = str(tmp_path / "m.zip")
+        save_model(net, p)
+        with zipfile.ZipFile(p) as zf:
+            meta = json.loads(zf.read("meta.json"))
+        assert "grad_residual.npz" in meta["integrity"]
+        m = load_model(p)
+        assert m.grad_residual is not None
+        assert trees_equal(net.grad_residual, m.grad_residual)
+
+    def test_unsupported_future_version_rejected(self, tmp_path):
+        net = small_net()
+        p, p9 = str(tmp_path / "m.zip"), str(tmp_path / "v9.zip")
+        net.save(p)
+        with zipfile.ZipFile(p) as zin, zipfile.ZipFile(p9, "w") as zout:
+            for name in zin.namelist():
+                b = zin.read(name)
+                if name == "meta.json":
+                    meta = json.loads(b)
+                    meta["format_version"] = 9
+                    b = json.dumps(meta).encode()
+                zout.writestr(name, b)
+        with pytest.raises(ValueError, match="not supported"):
+            load_model(p9)
+
+
+# ---------------------------------------------------------------------------
+# CheckpointManager hardening
+# ---------------------------------------------------------------------------
+
+class TestCheckpointManagerHardening:
+    def test_stale_tmp_cleaned_on_init(self, tmp_path):
+        stale = tmp_path / "checkpoint_0000000007.zip.tmp"
+        stale.write_bytes(b"torn mid-write")
+        other = tmp_path / "notes.txt"
+        other.write_text("keep me")
+        CheckpointManager(str(tmp_path))
+        assert not stale.exists()
+        assert other.exists()
+
+    def test_list_checkpoints_skips_unparsable(self, tmp_path):
+        net = small_net()
+        cm = CheckpointManager(str(tmp_path))
+        cm.save(net, 3)
+        (tmp_path / "checkpoint_notastep.zip").write_bytes(b"junk")
+        assert [s for _, s in cm.list_checkpoints()] == [3]
+
+    @pytest.mark.parametrize("corrupt", [
+        lambda p: truncate_file(p, 0.5),
+        lambda p: bitflip_file(p, n_flips=16, seed=3),
+        lambda p: open(p, "wb").write(b"PK\x03\x04 garbage"),
+    ], ids=["truncate", "bitflip", "garbage"])
+    def test_restore_falls_back_to_newest_intact(self, tmp_path, corrupt):
+        net = small_net()
+        cm = CheckpointManager(str(tmp_path))
+        cm.save(net, 10)
+        net.fit_batch(data())
+        cm.save(net, 20)
+        path20, _ = cm.latest()
+        corrupt(path20)
+        model, step = cm.restore_latest(load_model)
+        assert step == 10 and model is not None
+        # the corrupt latest is quarantined out of the rotation
+        assert os.path.exists(path20 + ".corrupt")
+        assert [s for _, s in cm.list_checkpoints()] == [10]
+
+    def test_restore_all_corrupt_returns_none(self, tmp_path):
+        net = small_net()
+        cm = CheckpointManager(str(tmp_path))
+        cm.save(net, 1)
+        cm.save(net, 2)
+        for p, _ in cm.list_checkpoints():
+            truncate_file(p, 0.3)
+        model, step = cm.restore_latest(load_model)
+        assert model is None and step == -1
+
+    def test_bitflipped_payload_detected_and_skipped(self, tmp_path):
+        """A payload bit flip that keeps the zip structurally valid is
+        exactly what the v4 digests exist for: rebuild the newest zip with
+        a tampered params.npz (fresh zip CRCs — zipfile alone would load
+        it), and restore must still fall back."""
+        net = small_net()
+        cm = CheckpointManager(str(tmp_path))
+        cm.save(net, 1)
+        net.fit_batch(data())
+        cm.save(net, 2)
+        path2, _ = cm.latest()
+        with zipfile.ZipFile(path2) as zin:
+            entries = {n: zin.read(n) for n in zin.namelist()}
+        b = entries["params.npz"]
+        entries["params.npz"] = b[:150] + bytes([b[150] ^ 1]) + b[151:]
+        with zipfile.ZipFile(path2, "w") as zout:
+            for n, v in entries.items():
+                zout.writestr(n, v)
+        model, step = cm.restore_latest(load_model)
+        assert step == 1 and model is not None
+
+
+# ---------------------------------------------------------------------------
+# divergence guard
+# ---------------------------------------------------------------------------
+
+class TestNanGuard:
+    def test_skip_leaves_params_opt_state_bit_identical(self):
+        net = small_net()
+        net.fit_batch(data())
+        net.set_nan_guard(3)
+        p0, s0, o0 = leaves(net.params), leaves(net.state), leaves(net.opt_state)
+        it0 = net.iteration
+        net.fit_batch(nan_data())
+        assert trees_equal(p0, net.params)
+        assert trees_equal(s0, net.state)
+        assert trees_equal(o0, net.opt_state)
+        assert net._bad_steps == 1 and net.iteration == it0 + 1
+
+    def test_budget_escalates_with_recoverable_error(self):
+        net = small_net()
+        net.set_nan_guard(1)
+        net.fit_batch(nan_data())
+        with pytest.raises(DivergenceError) as ei:
+            net.fit_batch(nan_data())
+        # the elastic FailureDetector must classify it recoverable —
+        # escalation routes to checkpoint restore, not a crash
+        assert FailureDetector().is_recoverable(ei.value)
+        # self-resetting: the catcher restores and gets a fresh budget
+        assert net._bad_steps == 0
+
+    def test_good_step_resets_budget(self):
+        net = small_net()
+        net.set_nan_guard(1)
+        net.fit_batch(nan_data())
+        assert net._bad_steps == 1
+        net.fit_batch(data())
+        assert net._bad_steps == 0
+        net.fit_batch(nan_data())  # budget available again — no raise
+        assert net._bad_steps == 1
+
+    def test_guard_off_keeps_default_step(self):
+        """Disabled (default) ⇒ the guarded program is never even built:
+        the pre-change jit step is what runs, bit-identical by
+        construction."""
+        net = small_net()
+        net.fit_batch(data())
+        assert net._jit_step is not None
+        assert net._jit_step_guarded is None
+
+    def test_guarded_loss_matches_unguarded_on_clean_steps(self):
+        a, b = small_net(), small_net()
+        b.set_nan_guard(5)
+        ds = data()
+        la = [float(a.fit_batch(ds)) for _ in range(5)]
+        lb = [float(b.fit_batch(ds)) for _ in range(5)]
+        assert la == lb  # same math, same rng stream → bitwise
+
+    def test_tbptt_guard_unsupported(self):
+        conf = (NeuralNetConfiguration.builder().seed(5)
+                .layer(Dense(n_out=4, activation="relu"))
+                .layer(OutputLayer(n_out=2, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        conf.backprop_type = "tbptt"
+        net = MultiLayerNetwork(conf)
+        net.init()
+        net.set_nan_guard(1)
+        with pytest.raises(NotImplementedError, match="TBPTT"):
+            net.fit_batch(data())
+
+    def test_elastic_recovers_divergence_via_checkpoint(self, tmp_path):
+        """End-to-end: guard escalation → ElasticTrainer restores the last
+        checkpoint and training continues."""
+        net = small_net()
+        net.set_nan_guard(1)
+        et = ElasticTrainer(Plain(net), str(tmp_path), checkpoint_every=2,
+                            sync_every=1, max_restarts=2)
+        good, bad = data(), nan_data()
+        for _ in range(4):
+            et.fit_batch(good)
+        p_ckpt = leaves(net.params)  # step-4 checkpoint state
+        et.fit_batch(bad)            # skip 1/1
+        loss = et.fit_batch(bad)     # skip 2/1 → escalate → restore → retry
+        assert et.total_restarts == 1
+        assert trees_equal(p_ckpt, net.params) or np.isfinite(float(loss))
+        out = [float(et.fit_batch(good)) for _ in range(3)]
+        assert all(np.isfinite(out))
+
+
+class TestShardedCompressedGuard:
+    def _trainer(self, nan_guard=None):
+        from deeplearning4j_tpu.parallel import ShardedTrainer
+        from deeplearning4j_tpu.parallel.mesh import build_two_tier_mesh
+
+        net = small_net()
+        mesh = build_two_tier_mesh(2, {"data": 2}, devices=jax.devices()[:4])
+        return ShardedTrainer(net, mesh, grad_compression="threshold",
+                              compression_bucket_mb=0.001,
+                              nan_guard=nan_guard)
+
+    def test_nan_step_skips_update_and_residual(self):
+        tr = self._trainer(nan_guard=3)
+        tr.fit_batch(data())          # one real step: residual is nonzero
+        p0 = leaves(tr.net.params)
+        o0 = leaves(tr.net.opt_state)
+        r0 = leaves(tr.net.grad_residual)
+        assert any(np.abs(l).sum() > 0 for l in r0)
+        tr.fit_batch(nan_data())
+        assert trees_equal(p0, tr.net.params)
+        assert trees_equal(o0, tr.net.opt_state)
+        # residual accumulation skipped too — a poisoned acc must not be
+        # deferred into the next healthy step
+        assert trees_equal(r0, tr.net.grad_residual)
+        assert tr._bad_steps == 1
+
+    def test_budget_escalates(self):
+        tr = self._trainer(nan_guard=1)
+        tr.fit_batch(nan_data())
+        with pytest.raises(DivergenceError):
+            tr.fit_batch(nan_data())
+
+    def test_guard_off_unchanged_output_arity(self):
+        tr = self._trainer(nan_guard=None)
+        loss = tr.fit_batch(data())
+        assert np.isfinite(float(loss))
+        assert tr.nan_guard is None
+
+
+# ---------------------------------------------------------------------------
+# backoff + watchdog (fake clock)
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestBackoffAndWatchdog:
+    def test_exponential_backoff_with_bounded_jitter(self, tmp_path):
+        net = small_net()
+
+        class AlwaysFail:
+            def __init__(self):
+                self.net = net
+
+            def fit_batch(self, ds):
+                raise RuntimeError("UNAVAILABLE: device lost")
+
+        sleeps = []
+        et = ElasticTrainer(AlwaysFail(), str(tmp_path), max_restarts=4,
+                            backoff_base=1.0, backoff_max=5.0,
+                            backoff_jitter=0.5, jitter_seed=0,
+                            sleep_fn=sleeps.append)
+        with pytest.raises(RuntimeError, match="max_restarts"):
+            et.fit_batch(data())
+        assert len(sleeps) == 4
+        # delay n ∈ [base·2^(n-1), base·2^(n-1)·(1+jitter)], capped at max
+        for n, s in enumerate(sleeps, start=1):
+            lo = min(5.0, 1.0 * 2 ** (n - 1))
+            assert lo <= s <= lo * 1.5 + 1e-9, (n, s)
+        # deterministic: same seed → same jitter sequence
+        sleeps2 = []
+        et2 = ElasticTrainer(AlwaysFail(), str(tmp_path), max_restarts=4,
+                             backoff_base=1.0, backoff_max=5.0,
+                             backoff_jitter=0.5, jitter_seed=0,
+                             sleep_fn=sleeps2.append)
+        with pytest.raises(RuntimeError):
+            et2.fit_batch(data())
+        assert sleeps == sleeps2
+
+    def test_backoff_disabled_by_default(self, tmp_path):
+        net = small_net()
+        calls = []
+
+        class FailOnce:
+            def __init__(self):
+                self.net = net
+                self.n = 0
+
+            def fit_batch(self, ds):
+                self.n += 1
+                if self.n == 1:
+                    raise RuntimeError("UNAVAILABLE: device lost")
+                return net.fit_batch(ds)
+
+        et = ElasticTrainer(FailOnce(), str(tmp_path),
+                            sleep_fn=calls.append)
+        et.fit_batch(data())
+        assert calls == [] and et.backoff_sleeps == []
+
+    def test_watchdog_converts_slow_step_to_recoverable(self, tmp_path):
+        """Wall-clock watchdog with a fake clock: a step that 'takes' 100s
+        (the injected hang) becomes a StepHangError → restore-and-retry,
+        not an infinite stall."""
+        net = small_net()
+        clock = FakeClock()
+
+        class SlowAtStep3:
+            def __init__(self):
+                self.net = net
+                self.n = 0
+
+            def fit_batch(self, ds):
+                self.n += 1
+                if self.n == 3:
+                    clock.t += 100.0  # the hang
+                return net.fit_batch(ds)
+
+        def sleep(s):
+            clock.t += s  # backoff sleeps tick the same fake clock
+
+        et = ElasticTrainer(SlowAtStep3(), str(tmp_path), checkpoint_every=1,
+                            sync_every=1, step_timeout=10.0, clock=clock,
+                            max_restarts=2, backoff_base=2.0, jitter_seed=0,
+                            sleep_fn=sleep)
+        losses = [float(et.fit_batch(data())) for _ in range(4)]
+        assert et.total_restarts == 1
+        assert all(np.isfinite(losses))
+        # recovery time accounted on the same clock: at least the backoff
+        assert et.recovery_seconds >= 2.0
+
+    def test_watchdog_not_armed_on_first_step(self, tmp_path):
+        """Compile grace: the FIRST step after a (re)start may take
+        arbitrarily long (jit compile) without tripping the watchdog."""
+        net = small_net()
+        clock = FakeClock()
+
+        class SlowFirst:
+            def __init__(self):
+                self.net = net
+                self.n = 0
+
+            def fit_batch(self, ds):
+                self.n += 1
+                if self.n == 1:
+                    clock.t += 1000.0  # "compile"
+                return net.fit_batch(ds)
+
+        et = ElasticTrainer(SlowFirst(), str(tmp_path), sync_every=1,
+                            step_timeout=10.0, clock=clock, max_restarts=0)
+        losses = [float(et.fit_batch(data())) for _ in range(3)]
+        assert et.total_restarts == 0 and all(np.isfinite(losses))
+
+    def test_hang_error_is_recoverable(self):
+        assert FailureDetector().is_recoverable(StepHangError(99.0, 10.0))
+
+
+# ---------------------------------------------------------------------------
+# FaultSchedule / ChaosInjector
+# ---------------------------------------------------------------------------
+
+class TestFaultSchedule:
+    def test_scripted_and_pop_consumes(self):
+        s = FaultSchedule.scripted({3: FaultKind.DEVICE_LOSS,
+                                    5: [FaultKind.CKPT_TRUNCATE,
+                                        FaultKind.DEVICE_LOSS]})
+        assert s.pending() == 3
+        assert s.pop(3) == [FaultKind.DEVICE_LOSS]
+        assert s.pop(3) == []   # consumed — retries don't re-inject
+        assert s.pop(5) == [FaultKind.CKPT_TRUNCATE, FaultKind.DEVICE_LOSS]
+        assert s.pending() == 0
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultSchedule({1: ["meteor_strike"]})
+
+    def test_random_is_seed_deterministic(self):
+        a = FaultSchedule.random(seed=42, n_steps=200, rate=0.1)
+        b = FaultSchedule.random(seed=42, n_steps=200, rate=0.1)
+        c = FaultSchedule.random(seed=43, n_steps=200, rate=0.1)
+        assert a.faults == b.faults
+        assert a.faults != c.faults
+        assert a.pending() > 0
+
+
+class TestChaosInjector:
+    def test_device_loss_recovered_by_elastic(self, tmp_path):
+        net = small_net()
+        sched = FaultSchedule.scripted({3: FaultKind.DEVICE_LOSS})
+        inj = ChaosInjector(Plain(net), sched)
+        et = ElasticTrainer(inj, str(tmp_path), checkpoint_every=1,
+                            sync_every=1)
+        losses = [float(et.fit_batch(data())) for _ in range(5)]
+        assert all(np.isfinite(losses))
+        assert et.total_restarts == 1
+        assert inj.injected(FaultKind.DEVICE_LOSS) == 1
+        assert sched.pending() == 0
+
+    def test_write_crash_leaves_stale_tmp_and_recovers(self, tmp_path):
+        net = small_net()
+        sched = FaultSchedule.scripted({2: FaultKind.CKPT_WRITE_CRASH})
+        inj = ChaosInjector(Plain(net), sched)
+        et = ElasticTrainer(inj, str(tmp_path), checkpoint_every=1,
+                            sync_every=1)
+        inj.attach_checkpoints(et.ckpt)
+        for _ in range(2):
+            et.fit_batch(data())
+        assert et.total_restarts == 1
+        assert inj.injected(FaultKind.CKPT_WRITE_CRASH) == 1
+
+    def test_corrupt_fault_requires_attached_manager(self):
+        net = small_net()
+        inj = ChaosInjector(
+            Plain(net), FaultSchedule.scripted({1: FaultKind.CKPT_TRUNCATE}))
+        with pytest.raises(RuntimeError, match="attach_checkpoints"):
+            inj.fit_batch(data())
+
+    def test_nan_poison_exercises_real_guard(self, tmp_path):
+        net = small_net()
+        net.set_nan_guard(3)
+        sched = FaultSchedule.scripted({2: FaultKind.NAN_GRADS})
+        inj = ChaosInjector(Plain(net), sched)
+        et = ElasticTrainer(inj, str(tmp_path), checkpoint_every=1,
+                            sync_every=1)
+        et.fit_batch(data())
+        p0 = leaves(net.params)
+        et.fit_batch(data())   # poisoned by the injector → guarded skip
+        assert trees_equal(p0, net.params)
+        assert net._bad_steps == 1
+
+
+class TestChaosCLI:
+    def test_parse_chaos_ok(self):
+        from deeplearning4j_tpu.cli import _parse_chaos
+        sched, seed, hang = _parse_chaos(
+            "device_loss@5,nan_grads@9,nan_grads@10,seed=3,hang=2.5")
+        assert sched.faults == {5: ["device_loss"], 9: ["nan_grads"],
+                                10: ["nan_grads"]}
+        assert seed == 3 and hang == 2.5
+
+    @pytest.mark.parametrize("spec", [
+        "meteor@3", "device_loss@", "device_loss@0", "seed=3",
+        "device_loss@5,rate=1",
+    ])
+    def test_parse_chaos_errors(self, spec):
+        from deeplearning4j_tpu.cli import _parse_chaos
+        with pytest.raises(SystemExit, match="chaos"):
+            _parse_chaos(spec)
+
+    def test_chaos_requires_elastic_dir(self, tmp_path):
+        from deeplearning4j_tpu.cli import main
+        np.savez(tmp_path / "d.npz", x=np.zeros((8, 4), np.float32),
+                 y=np.zeros(8, np.int64))
+        with pytest.raises(SystemExit, match="elastic-dir"):
+            main(["train", "--zoo", "lenet", "--data",
+                  str(tmp_path / "d.npz"), "--chaos", "device_loss@1"])
+
+
+# ---------------------------------------------------------------------------
+# the soak itself (quick mode)
+# ---------------------------------------------------------------------------
+
+class TestChaosSoak:
+    def test_quick_soak_all_gates(self, tmp_path):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "chaos_soak", os.path.join(os.path.dirname(__file__), "..",
+                                       "scripts", "chaos_soak.py"))
+        soak = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(soak)
+        out = soak.run_soak(quick=True, ckpt_root=str(tmp_path))
+        assert out["unrecovered"] == 0, out.get("unrecovered_error")
+        assert out["faults_pending"] == 0
+        assert out["n_fault_kinds"] >= 5
+        assert out["intact_fallback_ok"]
+        assert out["stale_tmp_cleaned"]
+        assert out["disabled_bitwise"]
+        assert out["loss_parity_ok"] and out["chaos_learns"]
+        assert out["soak_ok"]

@@ -217,8 +217,10 @@ class TestSatellites:
     def test_serializer_version_and_bf16_hint(self):
         from deeplearning4j_tpu.utils import serializer
         # v3 = v2 (bf16 uint16-view scheme) + optional grad_residual.npz
-        # (compressed-exchange error feedback, tests/test_compression.py)
-        assert serializer.FORMAT_VERSION == 3
+        # (compressed-exchange error feedback, tests/test_compression.py);
+        # v4 adds per-entry integrity digests (tests/test_chaos.py)
+        assert serializer.FORMAT_VERSION == 4
+        assert 3 in serializer.SUPPORTED_VERSIONS
         with pytest.raises(KeyError, match="bfloat16"):
             serializer._unflatten_into({"a": jnp.zeros(2)}, {}, "")
 
