@@ -35,6 +35,11 @@ stderr, including:
     a real ElasticTrainer loop, hard-gated on zero unrecovered failures,
     corrupt-latest checkpoint fallback, chaos-off bitwise identity, and
     loss parity with the fault-free run (docs/FAULT_TOLERANCE.md)
+  - serving_throughput_rps: the production-serving A/B gate
+    (scripts/serving_ab.py) — legacy fixed-poll ParallelInference vs the
+    new serving.Engine on the same synthetic open-loop LeNet load,
+    hard-gated on new >= 1.0x legacy throughput AND new p99 <= legacy
+    at equal load, zero unwarmed serves (docs/SERVING.md)
 
 BASELINE.md: the reference publishes NO numbers; the driver target is
 >=0.8x per-chip of H100+nd4j-cuda on ResNet-50 ≈ 2000 img/s.
@@ -867,6 +872,53 @@ def bench_grad_compression():
             "n_buckets": ab["threshold"]["n_buckets"]}
 
 
+def bench_serving():
+    """Config 12: production-serving A/B (scripts/serving_ab.py; the CPU
+    subprocess mechanism — the batching logic under test is host-side).
+    The legacy fixed-poll ParallelInference and the new serving.Engine
+    each serve the SAME synthetic open-loop trickle on the LeNet model;
+    HARD gates (the serving regression contract): new throughput >= 1.0x
+    legacy AND new p99 <= legacy p99 at equal offered load, with zero
+    unwarmed serves (AOT warmup really covered every bucket) and zero
+    request errors.  The headline value is the new engine's requests/sec
+    on this box — NOT a TPU figure; the deliverables are the ratios."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    script = os.path.join(_REPO, "scripts", "serving_ab.py")
+    cmd = [sys.executable, script] + (["--quick"] if QUICK else [])
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=1800, cwd=_REPO)
+    if p.returncode != 0:
+        raise RuntimeError(f"serving_ab failed (rc={p.returncode}): "
+                           f"{p.stderr[-1500:]}")
+    ab = json.loads(p.stdout.strip().splitlines()[-1])
+    if not ab.get("throughput_ok"):
+        raise RuntimeError("serving throughput gate FAILED (new engine must "
+                           f"be >= 1.0x legacy ParallelInference): {ab}")
+    if not ab.get("p99_ok"):
+        raise RuntimeError("serving p99 gate FAILED (new engine p99 must be "
+                           f"<= legacy at equal load): {ab}")
+    if not ab.get("all_completed"):
+        raise RuntimeError(f"serving A/B had request errors: {ab}")
+    if ab["new"].get("unwarmed_serves"):
+        raise RuntimeError("serving AOT warmup gate FAILED (a request paid "
+                           f"a serve-time compile): {ab}")
+    return {"metric": "serving_throughput_rps",
+            "value": ab["new"]["throughput_rps"], "unit": "requests/sec (cpu)",
+            "platform": ab["platform"], "n_requests": ab["n_requests"],
+            "throughput_ratio_new_vs_legacy":
+                ab["throughput_ratio_new_vs_legacy"],
+            "p50_ms": {"legacy": ab["legacy"]["p50_ms"],
+                       "new": ab["new"]["p50_ms"]},
+            "p99_ms": {"legacy": ab["legacy"]["p99_ms"],
+                       "new": ab["new"]["p99_ms"]},
+            "batch_occupancy": ab["new"]["batch_occupancy"],
+            "p99_ok": True, "throughput_ok": True}
+
+
 def bench_chaos_recovery():
     """Config 11: chaos-tested fault recovery (scripts/chaos_soak.py; the
     subprocess mechanism, CPU — fault injection needs no accelerator).  A
@@ -936,7 +988,8 @@ def main() -> None:
                      ("collective", bench_collective),
                      ("pipeline_schedules", bench_pipeline_schedules),
                      ("grad_compression", bench_grad_compression),
-                     ("chaos_recovery", bench_chaos_recovery)]:
+                     ("chaos_recovery", bench_chaos_recovery),
+                     ("serving_throughput", bench_serving)]:
         try:
             t0 = time.perf_counter()
             out = fn()

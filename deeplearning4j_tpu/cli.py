@@ -12,6 +12,8 @@ configs).  Usage:
     python -m deeplearning4j_tpu evaluate --model model.zip --data mnist
     python -m deeplearning4j_tpu predict --model model.zip --input x.npz \\
         --output preds.npz
+    python -m deeplearning4j_tpu serve --model model.zip --max-batch 32 \\
+        --slo-ms 50 --replicas -1 --admission shed --port 9000
     python -m deeplearning4j_tpu summary --model model.zip
 
 ``--data`` accepts a built-in name (mnist / cifar10 / iris / emnist /
@@ -362,6 +364,56 @@ def cmd_predict(args) -> int:
     return 0
 
 
+def cmd_serve(args) -> int:
+    """Production serving (docs/SERVING.md): load a checkpoint into the
+    versioned registry, AOT-warm every shape bucket, and serve — either
+    over HTTP (POST /predict + GET /metrics on the UI server) or as a
+    --smoke self-test that pushes synthetic requests through the engine
+    and prints the metrics snapshot."""
+    from .serving import Engine, ModelRegistry
+
+    reg = ModelRegistry()
+    name = args.name
+    version = reg.load(name, args.model, version=args.version)
+    reg.set_alias(name, "prod", version)
+    engine = Engine.from_registry(
+        reg, name, "prod", max_batch=args.max_batch, slo_ms=args.slo_ms,
+        replicas=args.replicas, max_queue=args.queue_cap,
+        admission=args.admission)
+    engine.load()
+    print(f"serving {name} v{version} (alias 'prod'): "
+          f"max_batch={args.max_batch}, slo={args.slo_ms}ms, "
+          f"replicas={len(engine._replicas)}, admission={args.admission}, "
+          f"warmed buckets {engine.batcher.buckets}")
+    if args.smoke:
+        shape = engine._example_shape
+        rng = np.random.default_rng(0)
+        futs = [engine.output_async(
+            rng.normal(size=(1 + i % 4,) + shape).astype(np.float32))
+            for i in range(args.smoke)]
+        for f in futs:
+            f.result(timeout=120)
+        print(json.dumps(engine.metrics_snapshot()))
+        engine.shutdown()
+        return 0
+    from .ui import UIServer
+
+    server = UIServer(port=args.port, host=args.host).attach_engine(engine)
+    server.start()
+    print(f"listening on http://{args.host}:{server.port} — "
+          "POST /predict, GET /metrics")
+    import threading
+
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        engine.shutdown()
+    return 0
+
+
 def cmd_summary(args) -> int:
     net = _load_model(args.model)
     from .nn.conf.memory import memory_report
@@ -428,6 +480,31 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--input", required=True, help=".npz with array 'x'")
     r.add_argument("--output", required=True, help=".npz to write")
     r.set_defaults(fn=cmd_predict)
+
+    v = sub.add_parser("serve", help="serve a saved model (docs/SERVING.md)")
+    v.add_argument("--model", required=True, help="checkpoint zip to serve")
+    v.add_argument("--name", default="model",
+                   help="registry name for the model (default: 'model')")
+    v.add_argument("--version", type=int, default=None,
+                   help="registry version number (default: auto-assign)")
+    v.add_argument("--max-batch", type=int, default=32,
+                   help="dynamic batcher fused-batch cap")
+    v.add_argument("--slo-ms", type=float, default=50.0,
+                   help="per-request deadline budget; queued requests past "
+                   "it fail fast with DeadlineExceededError")
+    v.add_argument("--replicas", type=int, default=-1,
+                   help="engine replicas (-1 = one per local device)")
+    v.add_argument("--admission", choices=("block", "shed"), default="shed",
+                   help="overload policy: block callers or shed with "
+                   "OverloadedError (HTTP 429)")
+    v.add_argument("--queue-cap", type=int, default=256,
+                   help="admission queue bound in requests")
+    v.add_argument("--port", type=int, default=9000)
+    v.add_argument("--host", default="127.0.0.1")
+    v.add_argument("--smoke", type=int, default=0, metavar="N",
+                   help="push N synthetic requests through the engine, "
+                   "print the metrics snapshot, and exit (self-test)")
+    v.set_defaults(fn=cmd_serve)
 
     s = sub.add_parser("summary", help="model + memory summary")
     s.add_argument("--model", required=True)

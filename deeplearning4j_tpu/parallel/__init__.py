@@ -26,6 +26,11 @@ mechanism: a jax.sharding.Mesh + GSPMD-partitioned jit programs.
     divergence guard) — chaos-tested by deterministic fault injection
     (chaos.py, scripts/chaos_soak.py, docs/FAULT_TOLERANCE.md)
   TP / PP / SP — absent in the reference — are first-class here.
+
+Inference serving moved to the ``serving/`` subsystem (deadline-aware
+batching, AOT warmup, replicas, versioned hot-swap, admission control —
+docs/SERVING.md); the ``ParallelInference`` exported here is a thin
+back-compat shim over one ``serving.Engine``.
 """
 
 from .mesh import (

@@ -1,29 +1,55 @@
-"""ParallelInference — batched concurrent serving.
+"""ParallelInference — back-compat shim over the serving engine.
 
-Reference: parallelism/ParallelInference.java:32,82,130 — BATCHED mode
-collects concurrent requests into one device call via an observable queue
-(inference/observers/BatchedInferenceObservable.java).  Here: a worker
-thread drains a request queue, pads/concatenates into one jit'd forward,
-and resolves per-request futures.  The jit'd apply replaces the reference's
-per-model replica pool — one compiled program serves any batch size bucket.
+Reference: parallelism/ParallelInference.java:32,82,130 — BATCHED mode.
+The original implementation here (a worker thread draining a request
+queue on a fixed ``queue_timeout_s`` poll) is superseded by the
+``serving/`` subsystem; this class keeps the old constructor and the
+``output`` / ``output_async`` / ``shutdown`` semantics as a thin wrapper
+over one ``serving.Engine`` so existing callers and tests keep working.
+
+Semantics preserved exactly:
+  - requests are answered in arrival order, fused up to ``max_batch``;
+  - model errors propagate to every waiter of the failed batch;
+  - ``shutdown()`` fails queued/late requests with RuntimeError instead
+    of hanging them (now deterministic even for a request enqueued
+    concurrently with shutdown — the old worker could exit between the
+    shutdown flag and the queue read, stranding that future).
+
+Semantics improved (the old implementation's bugs, fixed in serving/):
+  - drains split at ``max_batch`` BEFORE shape-bucketing, so a 33-row
+    drain at ``max_batch=32`` runs as 32+1, not as one unbucketed
+    33-row program;
+  - the fixed poll becomes the engine's event-driven close
+    (``queue_timeout_s`` maps to the batch-forming window), removing
+    the per-batch poll stall.
+
+New code should use ``deeplearning4j_tpu.serving.Engine`` directly —
+it adds deadlines, AOT warmup, replicas, admission control, hot-swap,
+and metrics (docs/SERVING.md).
 """
 
 from __future__ import annotations
 
-import queue
-import threading
 from concurrent.futures import Future
-from typing import List, Optional, Tuple
+from typing import List, Optional
 
 import numpy as np
 
+from ..serving.engine import Engine
+
+# the old queue had no deadline concept: requests waited forever.  The
+# shim keeps that by setting a deadline far beyond any real wait.
+_NO_DEADLINE_MS = 3_600_000.0
+
 
 class ParallelInference:
-    """Batched inference server around any model with .output(x).
+    """Batched inference server around any model with ``.output(x)``.
 
-    ``max_batch`` caps the fused batch (reference batchLimit); requests are
-    answered in arrival order.  ``bucket_sizes`` quantizes batch shapes so
-    XLA compiles a handful of programs instead of one per size.
+    ``max_batch`` caps the fused batch (reference batchLimit);
+    ``bucket_sizes`` quantizes batch shapes so XLA compiles a handful of
+    programs instead of one per size; ``queue_timeout_s`` — the old
+    fixed poll interval — now bounds how long the oldest request waits
+    for companions before its batch closes.
     """
 
     def __init__(self, model, max_batch: int = 32, queue_timeout_s: float = 0.005,
@@ -31,81 +57,18 @@ class ParallelInference:
         self.model = model
         self.max_batch = max_batch
         self.timeout = queue_timeout_s
-        if bucket_sizes is None:
-            bucket_sizes, b = [], 1
-            while b < max_batch:
-                bucket_sizes.append(b)
-                b *= 2
-            bucket_sizes.append(max_batch)
-        self.buckets = sorted(set(bucket_sizes))
-        self._queue: "queue.Queue[Tuple[np.ndarray, Future]]" = queue.Queue()
-        self._shutdown = threading.Event()
-        self._worker = threading.Thread(target=self._run, daemon=True)
-        self._worker.start()
+        self.engine = Engine(
+            model, max_batch=max_batch, bucket_sizes=bucket_sizes,
+            slo_ms=_NO_DEADLINE_MS, max_wait_ms=queue_timeout_s * 1000.0,
+            replicas=1, max_queue=1_000_000, admission="block")
+        self.buckets = list(self.engine.batcher.buckets)
 
     def output(self, x: np.ndarray) -> np.ndarray:
         """Submit one request (any leading batch size); blocks for result."""
-        fut: Future = Future()
-        self._queue.put((np.asarray(x), fut))
-        return fut.result()
+        return self.engine.output(np.asarray(x))
 
     def output_async(self, x: np.ndarray) -> Future:
-        fut: Future = Future()
-        self._queue.put((np.asarray(x), fut))
-        return fut
+        return self.engine.output_async(np.asarray(x))
 
     def shutdown(self) -> None:
-        self._shutdown.set()
-        self._worker.join(timeout=5)
-        # fail any requests still queued (or submitted after shutdown) so
-        # callers blocked in fut.result() wake up instead of hanging
-        while True:
-            try:
-                _, fut = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            if not fut.done():
-                fut.set_exception(RuntimeError("ParallelInference is shut down"))
-
-    # -- worker ------------------------------------------------------------
-
-    def _bucket(self, n: int) -> int:
-        for b in self.buckets:
-            if n <= b:
-                return b
-        return n
-
-    def _run(self) -> None:
-        while not self._shutdown.is_set():
-            batch: List[Tuple[np.ndarray, Future]] = []
-            try:
-                batch.append(self._queue.get(timeout=0.05))
-            except queue.Empty:
-                continue
-            try:
-                total = batch[0][0].shape[0]
-                # coalesce whatever arrived within the window (BATCHED mode)
-                while total < self.max_batch:
-                    try:
-                        item = self._queue.get(timeout=self.timeout)
-                        batch.append(item)
-                        total += item[0].shape[0]
-                    except queue.Empty:
-                        break
-                xs = np.concatenate([b[0] for b in batch], axis=0)
-                padded_n = self._bucket(xs.shape[0])
-                if padded_n > xs.shape[0]:
-                    pad = np.zeros((padded_n - xs.shape[0],) + xs.shape[1:], xs.dtype)
-                    xs = np.concatenate([xs, pad], axis=0)
-                out = self.model.output(xs)
-                if isinstance(out, list):  # ComputationGraph returns a list
-                    out = out[0]
-                ofs = 0
-                for x, fut in batch:
-                    n = x.shape[0]
-                    fut.set_result(out[ofs:ofs + n])
-                    ofs += n
-            except Exception as e:  # propagate to all waiters
-                for _, fut in batch:
-                    if not fut.done():
-                        fut.set_exception(e)
+        self.engine.shutdown()

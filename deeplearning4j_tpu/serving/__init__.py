@@ -1,0 +1,35 @@
+"""Serving (L5): the production inference subsystem.
+
+Supersedes the old ``parallel/inference.py`` batched-queue toy (which
+remains as a thin back-compat shim over this engine).  Pieces:
+
+  batcher.py   deadline-aware dynamic micro-batching, pow2 shape
+               buckets, admission control (block/shed)
+  registry.py  versioned model registry, alias pinning ("prod" -> v7),
+               hot-swap that drains in-flight batches, rollback = alias
+               move; loads serializer FORMAT_VERSION 1-4 checkpoints
+  engine.py    N engine replicas over jax.local_devices(), round-robin
+               dispatch with per-replica in-flight caps, AOT warmup of
+               every (bucket, dtype) pair at load
+  metrics.py   fixed-bucket latency histograms + counters, exported on
+               ui/server.py's /metrics endpoint
+
+Reference lineage: DL4J's ParallelInference BATCHED mode + the model-
+server role; design cf. the serving sections of "TensorFlow: A system
+for large-scale machine learning" and TPU serving practice (PAPERS.md).
+See docs/SERVING.md.
+"""
+
+from .batcher import (
+    ADMISSION_POLICIES, DeadlineExceededError, DynamicBatcher,
+    OverloadedError, pow2_buckets,
+)
+from .engine import Engine
+from .metrics import LatencyHistogram, ServingMetrics
+from .registry import ModelRegistry
+
+__all__ = [
+    "ADMISSION_POLICIES", "DeadlineExceededError", "DynamicBatcher",
+    "Engine", "LatencyHistogram", "ModelRegistry", "OverloadedError",
+    "ServingMetrics", "pow2_buckets",
+]

@@ -1,0 +1,264 @@
+"""Deadline-aware dynamic micro-batcher.
+
+Replaces the fixed-poll drain of the old ``parallel/inference.py``
+worker (``queue.get(timeout=queue_timeout_s)`` per item — a latency
+floor under EVERY request, and a throughput stall whenever the queue
+briefly empties) with an event-driven close: a batch closes the moment
+
+  * queued rows reach ``max_batch`` (never overshooting it — the old
+    drain bucketed on the TOTAL queued rows, so a 33-row drain at
+    ``max_batch=32`` ran an unbucketed 33-row program; here drains are
+    split at ``max_batch`` BEFORE bucketing), or
+  * waiting any longer would eat into the oldest request's deadline:
+    close time = earliest deadline − the EMA device time of the bucket
+    the batch would run in (seeded by AOT warmup, see engine.load()).
+
+Requests carry their own deadline (default: submit + SLO budget).  A
+request whose deadline passes while still queued fails fast with
+``DeadlineExceededError`` instead of returning a stale result.
+
+Admission control: the queue is bounded (``max_queue`` requests) with a
+configurable overload policy — ``"block"`` (backpressure the caller) or
+``"shed"`` (raise ``OverloadedError`` immediately) — so overload
+degrades predictably instead of growing an unbounded queue until OOM.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Deque, Dict, List, Optional, Sequence
+
+import numpy as np
+
+ADMISSION_POLICIES = ("block", "shed")
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's deadline passed before a device slot freed up —
+    the caller's SLO is already blown, so the result would be stale."""
+
+
+class OverloadedError(RuntimeError):
+    """The admission queue is full and the policy is ``shed`` — retry
+    with backoff or route to another replica group."""
+
+
+class _Request:
+    __slots__ = ("x", "rows", "future", "t_submit", "deadline")
+
+    def __init__(self, x: np.ndarray, future: Future, t_submit: float,
+                 deadline: float):
+        self.x = x
+        self.rows = int(x.shape[0])
+        self.future = future
+        self.t_submit = t_submit
+        self.deadline = deadline
+
+
+def pow2_buckets(max_batch: int) -> List[int]:
+    """1, 2, 4, ... up to and including ``max_batch``."""
+    out, b = [], 1
+    while b < max_batch:
+        out.append(b)
+        b *= 2
+    out.append(max_batch)
+    return sorted(set(out))
+
+
+class DynamicBatcher:
+    """Bounded request queue + deadline-aware batch former.
+
+    One or more worker/dispatcher threads call :meth:`next_batch`; any
+    number of caller threads call :meth:`submit`.  ``clock`` is
+    injectable (monotonic seconds) so deadline logic is testable
+    without sleeping.
+    """
+
+    def __init__(self, max_batch: int = 32, slo_ms: float = 50.0,
+                 bucket_sizes: Optional[Sequence[int]] = None,
+                 max_queue: int = 1024, admission: str = "block",
+                 max_wait_ms: Optional[float] = None,
+                 metrics=None, clock=time.monotonic):
+        if admission not in ADMISSION_POLICIES:
+            raise ValueError(f"admission must be one of "
+                             f"{ADMISSION_POLICIES}, got {admission!r}")
+        if max_batch < 1 or max_queue < 1 or slo_ms <= 0:
+            raise ValueError("max_batch/max_queue must be >=1, slo_ms > 0")
+        self.max_batch = int(max_batch)
+        self.slo_ms = float(slo_ms)
+        # batch-forming window: at LOW load a batch must not sit waiting
+        # for companions until its deadline-slack runs out (that would
+        # make p50 == SLO); the oldest request waits at most this long
+        # before the batch closes.  The deadline-slack close below stays
+        # the binding constraint whenever it is tighter.
+        self.max_wait_ms = (float(max_wait_ms) if max_wait_ms is not None
+                            else min(5.0, self.slo_ms / 10.0))
+        self.buckets = (sorted(set(int(b) for b in bucket_sizes))
+                        if bucket_sizes else pow2_buckets(max_batch))
+        self.max_queue = int(max_queue)
+        self.admission = admission
+        self.metrics = metrics
+        self.clock = clock
+        self._pending: Deque[_Request] = deque()
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._space = threading.Condition(self._lock)
+        self._closed = False
+        # bucket -> EMA device ms; the exec budget subtracted from the
+        # oldest deadline when deciding how long a batch may keep filling
+        self._exec_ema_ms: Dict[int, float] = {}
+
+    # -- shape buckets -----------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest configured bucket >= n; oversized requests (> the
+        largest bucket) get the next power of two — they run, but pay
+        their own compile (engine metrics count them as unwarmed)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        b = self.buckets[-1]
+        while b < n:
+            b *= 2
+        return b
+
+    def observe_exec_ms(self, bucket: int, ms: float, alpha: float = 0.3) -> None:
+        prev = self._exec_ema_ms.get(bucket)
+        self._exec_ema_ms[bucket] = (ms if prev is None
+                                     else alpha * ms + (1 - alpha) * prev)
+
+    def _exec_budget_ms(self, rows: int) -> float:
+        """Expected device time for a batch of ``rows`` — the slack we
+        must keep in hand when deciding to wait for more requests.
+        Unmeasured buckets assume a quarter of the SLO."""
+        ema = self._exec_ema_ms.get(self.bucket_for(min(rows, self.max_batch)))
+        return ema if ema is not None else self.slo_ms * 0.25
+
+    # -- submission --------------------------------------------------------
+
+    def submit(self, x: np.ndarray, slo_ms: Optional[float] = None,
+               deadline: Optional[float] = None) -> Future:
+        """Enqueue one request; returns its Future.  Shedding raises
+        ``OverloadedError`` synchronously; a closed batcher fails the
+        future deterministically (never a silent hang)."""
+        x = np.asarray(x)
+        if x.ndim < 1 or x.shape[0] < 1:
+            raise ValueError(f"request must have a leading batch axis, "
+                             f"got shape {x.shape}")
+        fut: Future = Future()
+        now = self.clock()
+        dl = deadline if deadline is not None else now + (
+            slo_ms if slo_ms is not None else self.slo_ms) / 1000.0
+        with self._lock:
+            if self._closed:
+                fut.set_exception(RuntimeError("serving engine is shut down"))
+                return fut
+            if len(self._pending) >= self.max_queue:
+                if self.admission == "shed":
+                    if self.metrics:
+                        self.metrics.inc("shed")
+                    raise OverloadedError(
+                        f"admission queue full ({self.max_queue} requests); "
+                        "policy=shed")
+                while len(self._pending) >= self.max_queue and not self._closed:
+                    self._space.wait(timeout=0.1)
+                if self._closed:
+                    fut.set_exception(
+                        RuntimeError("serving engine is shut down"))
+                    return fut
+            self._pending.append(_Request(x, fut, now, dl))
+            self._nonempty.notify()
+        return fut
+
+    def qsize(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    # -- batch formation ---------------------------------------------------
+
+    def _expire_locked(self, now: float) -> None:
+        """Fail-fast every queued request whose deadline already passed."""
+        if not self._pending:
+            return
+        keep: Deque[_Request] = deque()
+        expired = 0
+        for r in self._pending:
+            if r.deadline < now:
+                expired += 1
+                if not r.future.done():
+                    r.future.set_exception(DeadlineExceededError(
+                        f"request waited {(now - r.t_submit) * 1e3:.1f}ms in "
+                        f"queue, past its {(r.deadline - r.t_submit) * 1e3:.0f}"
+                        "ms deadline"))
+            else:
+                keep.append(r)
+        if expired:
+            self._pending = keep
+            if self.metrics:
+                self.metrics.inc("deadline_missed", expired)
+            self._space.notify_all()
+
+    def _pop_batch_locked(self) -> List[_Request]:
+        batch: List[_Request] = []
+        rows = 0
+        while self._pending:
+            r = self._pending[0]
+            # split at max_batch BEFORE bucketing; a single oversized
+            # request still goes alone (it cannot be split)
+            if batch and rows + r.rows > self.max_batch:
+                break
+            batch.append(self._pending.popleft())
+            rows += r.rows
+            if rows >= self.max_batch:
+                break
+        self._space.notify_all()
+        return batch
+
+    def next_batch(self) -> Optional[List[_Request]]:
+        """Block until a batch closes; None once closed AND drained."""
+        with self._lock:
+            while True:
+                now = self.clock()
+                self._expire_locked(now)
+                if not self._pending:
+                    if self._closed:
+                        return None
+                    # pure event wait — the timeout only bounds how stale
+                    # a missed notify can leave us (defensive, not a poll)
+                    self._nonempty.wait(timeout=0.5)
+                    continue
+                total = sum(r.rows for r in self._pending)
+                if total >= self.max_batch or self._closed:
+                    return self._pop_batch_locked()
+                earliest = min(r.deadline for r in self._pending)
+                oldest = min(r.t_submit for r in self._pending)
+                t_close = min(
+                    oldest + self.max_wait_ms / 1000.0,
+                    earliest - self._exec_budget_ms(total) / 1000.0)
+                if now >= t_close:
+                    return self._pop_batch_locked()
+                # cap the wait so deadline expiry scans keep running even
+                # if no new request arrives to notify us
+                self._nonempty.wait(timeout=min(t_close - now, 0.05))
+
+    # -- shutdown ----------------------------------------------------------
+
+    def close(self, fail_pending: bool = True) -> None:
+        """Idempotent.  With ``fail_pending`` every queued request —
+        including one enqueued concurrently with shutdown — resolves
+        deterministically (the old implementation could leave a future
+        enqueued between shutdown-flag set and worker exit hanging
+        forever under timing skew)."""
+        with self._lock:
+            self._closed = True
+            if fail_pending:
+                while self._pending:
+                    r = self._pending.popleft()
+                    if not r.future.done():
+                        r.future.set_exception(
+                            RuntimeError("serving engine is shut down"))
+            self._nonempty.notify_all()
+            self._space.notify_all()

@@ -1,0 +1,394 @@
+"""Replicated serving engine: dispatch, AOT warmup, hot-swap.
+
+Topology (one Engine):
+
+    callers ──submit──▶ DynamicBatcher ──next_batch──▶ dispatcher thread
+        ──round-robin (per-replica in-flight cap)──▶ replica queues
+        ──▶ replica threads (one per replica, params device_put onto
+            jax.local_devices()[i]) ──▶ futures resolve
+
+Model versions are immutable `_ModelVersion` snapshots: every batch
+reads the CURRENT version exactly once (under the version lock) before
+executing, so a batch can never mix parameters from two versions.
+`swap_model` builds + warms the incoming version first, flips the
+pointer atomically, then blocks until every in-flight batch on the old
+version has drained — the registry's hot-swap contract.
+
+AOT warmup (`load()`): every (bucket, dtype) pair is compiled on every
+replica's device at model-load time, so no user request pays an XLA
+compile.  The compile counter is the jitted forward's own executable
+cache (`_cache_size()`); tests assert it does not grow while serving.
+Models without a jit-able forward (ComputationGraph, arbitrary duck-
+typed `.output` models) fall back to calling `model.output` — warmup
+still pre-triggers their compiles, only the counter is unavailable.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .batcher import DeadlineExceededError, DynamicBatcher, _Request
+from .metrics import ServingMetrics
+
+_SENTINEL = object()
+
+
+def _jitable(model) -> bool:
+    return (hasattr(model, "_apply_layers") and hasattr(model, "params")
+            and hasattr(model, "state"))
+
+
+class _ModelVersion:
+    """Immutable serving snapshot of one model version: the jitted
+    forward + per-replica device-resident params/state, plus the drain
+    bookkeeping for hot-swap."""
+
+    def __init__(self, model, tag: str, devices: Sequence[Any]):
+        import jax
+
+        self.model = model
+        self.tag = tag
+        self.fwd = None
+        self.params: List[Any] = []
+        self.state: List[Any] = []
+        self.active = 0          # batches currently executing on this version
+        self.retired = False
+        self.drained = threading.Event()
+        if _jitable(model):
+            def fwd(params, state, x):
+                y = model._apply_layers(params, state, x, train=False,
+                                        rng=None, mask=None)[0]
+                return y
+            self.fwd = jax.jit(fwd)
+            for d in devices:
+                self.params.append(jax.device_put(model.params, d))
+                self.state.append(jax.device_put(model.state, d))
+
+    def cache_size(self) -> Optional[int]:
+        if self.fwd is None:
+            return None
+        try:
+            return int(self.fwd._cache_size())
+        except Exception:
+            return None
+
+
+class _Replica:
+    def __init__(self, idx: int, device, inflight_cap: int):
+        self.idx = idx
+        self.device = device
+        self.queue: "queue.Queue" = queue.Queue(maxsize=max(1, inflight_cap))
+        self.thread: Optional[threading.Thread] = None
+        self.processed = 0
+
+
+class Engine:
+    """Production inference engine over any model with ``.output(x)``.
+
+    Parameters
+    ----------
+    model: the model to serve (or use :meth:`from_registry`).
+    max_batch / slo_ms / bucket_sizes / max_queue / admission: batching
+        + admission control (see `serving/batcher.py`).
+    replicas: engine replica count; ``-1`` = one per local device.
+        Replica *i* pins its params to ``jax.local_devices()[i % n]``.
+    inflight_per_replica: per-replica dispatch-queue bound — the
+        round-robin dispatcher skips a replica whose queue is full.
+    """
+
+    def __init__(self, model=None, *, registry=None, name: Optional[str] = None,
+                 ref: str = "prod", max_batch: int = 32, slo_ms: float = 50.0,
+                 bucket_sizes: Optional[Sequence[int]] = None,
+                 replicas: int = 1, max_queue: int = 1024,
+                 admission: str = "block", inflight_per_replica: int = 2,
+                 max_wait_ms: Optional[float] = None,
+                 metrics: Optional[ServingMetrics] = None,
+                 clock=time.monotonic):
+        import jax
+
+        if model is None:
+            if registry is None or name is None:
+                raise ValueError("pass a model, or registry= and name=")
+            version, model = registry.resolve(name, ref)
+            tag = f"{name}:v{version}"
+        else:
+            tag = "v0"
+        self.metrics = metrics or ServingMetrics()
+        self.batcher = DynamicBatcher(
+            max_batch=max_batch, slo_ms=slo_ms, bucket_sizes=bucket_sizes,
+            max_queue=max_queue, admission=admission,
+            max_wait_ms=max_wait_ms, metrics=self.metrics, clock=clock)
+        self.clock = clock
+        devices = jax.local_devices()
+        n = len(devices) if replicas in (-1, 0) else int(replicas)
+        if n < 1:
+            raise ValueError(f"replicas must be >=1 or -1, got {replicas}")
+        self._replicas = [
+            _Replica(i, devices[i % len(devices)], inflight_per_replica)
+            for i in range(n)]
+        self._devices = [r.device for r in self._replicas]
+        self._vlock = threading.Lock()
+        self._swap_lock = threading.Lock()
+        self._current = _ModelVersion(model, tag, self._devices)
+        self._warmed: set = set()       # (bucket, dtype_str) pairs
+        self._example_shape: Optional[Tuple[int, ...]] = None
+        self._warm_dtypes: Tuple[str, ...] = ("float32",)
+        self._loaded = False
+        self._shutdown = False
+        self.batch_log: List[dict] = []  # bounded; test/debug hook
+        self._log_lock = threading.Lock()
+        if registry is not None and name is not None:
+            registry.subscribe(
+                name, ref,
+                lambda version, m: self.swap_model(m, tag=f"{name}:v{version}"))
+        for r in self._replicas:
+            r.thread = threading.Thread(target=self._replica_loop, args=(r,),
+                                        daemon=True)
+            r.thread.start()
+        self._dispatcher = threading.Thread(target=self._dispatch_loop,
+                                            daemon=True)
+        self._dispatcher.start()
+
+    @classmethod
+    def from_registry(cls, registry, name: str, ref: str = "prod",
+                      **kwargs) -> "Engine":
+        return cls(registry=registry, name=name, ref=ref, **kwargs)
+
+    # -- warmup ------------------------------------------------------------
+
+    def _infer_example_shape(self) -> Optional[Tuple[int, ...]]:
+        conf = getattr(self._current.model, "conf", None)
+        it = getattr(conf, "input_type", None)
+        if it is None:
+            return None
+        try:
+            return tuple(it.batch_shape(1))[1:]
+        except ValueError:  # variable-length recurrent input
+            return None
+
+    def load(self, input_shape: Optional[Sequence[int]] = None,
+             dtypes: Sequence[str] = ("float32",)) -> "Engine":
+        """AOT warmup: compile every (bucket, dtype) pair on every
+        replica so no user request pays a compile.  ``input_shape`` is
+        the per-example shape; inferred from the model's configured
+        InputType when omitted.  Warmup timings seed the batcher's
+        per-bucket exec EMA (the deadline-slack close)."""
+        shape = tuple(input_shape) if input_shape is not None else (
+            self._infer_example_shape())
+        if shape is None:
+            raise ValueError(
+                "cannot infer the per-example input shape from the model's "
+                "configuration — pass input_shape=(...) explicitly")
+        self._example_shape = shape
+        self._warm_dtypes = tuple(dtypes)
+        self._warm_version(self._current)
+        self._loaded = True
+        return self
+
+    def _warm_version(self, v: _ModelVersion) -> None:
+        if self._example_shape is None:
+            return
+        for dtype in self._warm_dtypes:
+            for b in self.batcher.buckets:
+                x = np.zeros((b,) + self._example_shape, dtype=dtype)
+                t0 = self.clock()
+                for i in range(len(self._replicas)):
+                    np.asarray(self._run_forward(v, i, x))
+                # amortized per-replica steady-ish cost; the first call
+                # includes the compile, so only the LAST replica's time
+                # would be clean — re-run replica 0 once for the EMA seed
+                t0 = self.clock()
+                np.asarray(self._run_forward(v, 0, x))
+                self.batcher.observe_exec_ms(b, (self.clock() - t0) * 1e3)
+                self._warmed.add((b, str(np.dtype(dtype))))
+
+    def compile_cache_size(self) -> Optional[int]:
+        """Number of compiled executables backing the CURRENT version's
+        forward (None for non-jit-able models).  After ``load()`` this
+        must not grow while serving bucket-shaped requests — the
+        zero-compiles-at-serve-time contract."""
+        with self._vlock:
+            return self._current.cache_size()
+
+    # -- request path ------------------------------------------------------
+
+    def output(self, x, slo_ms: Optional[float] = None) -> np.ndarray:
+        """Submit one request (leading batch axis); blocks for the result."""
+        return self.output_async(x, slo_ms=slo_ms).result()
+
+    def output_async(self, x, slo_ms: Optional[float] = None) -> Future:
+        return self.batcher.submit(np.asarray(x), slo_ms=slo_ms)
+
+    # -- dispatch ----------------------------------------------------------
+
+    def _dispatch_loop(self) -> None:
+        rr = 0
+        n = len(self._replicas)
+        while True:
+            batch = self.batcher.next_batch()
+            if batch is None:
+                break
+            placed = False
+            for k in range(n):  # round-robin, skipping full replicas
+                r = self._replicas[(rr + k) % n]
+                try:
+                    r.queue.put_nowait(batch)
+                    rr = (rr + k + 1) % n
+                    placed = True
+                    break
+                except queue.Full:
+                    continue
+            if not placed:  # all at their in-flight cap: backpressure
+                self._replicas[rr].queue.put(batch)
+                rr = (rr + 1) % n
+        for r in self._replicas:
+            r.queue.put(_SENTINEL)
+
+    def _replica_loop(self, replica: _Replica) -> None:
+        while True:
+            item = replica.queue.get()
+            if item is _SENTINEL:
+                break
+            self._execute(item, replica)
+            replica.processed += 1
+
+    def _run_forward(self, v: _ModelVersion, replica_idx: int, xs: np.ndarray):
+        if v.fwd is not None:
+            return v.fwd(v.params[replica_idx], v.state[replica_idx], xs)
+        out = v.model.output(xs)
+        return out[0] if isinstance(out, list) else out
+
+    def _execute(self, batch: List[_Request], replica: _Replica) -> None:
+        now = self.clock()
+        live = []
+        expired = 0
+        for r in batch:  # deadlines re-checked at execution start — the
+            if r.deadline < now:  # batch may have sat in the replica queue
+                expired += 1
+                if not r.future.done():
+                    r.future.set_exception(DeadlineExceededError(
+                        f"deadline passed after "
+                        f"{(now - r.t_submit) * 1e3:.1f}ms"))
+            else:
+                live.append(r)
+        if expired:
+            self.metrics.inc("deadline_missed", expired)
+        if not live:
+            return
+        for r in live:
+            self.metrics.queue_wait.record((now - r.t_submit) * 1e3)
+        xs = (live[0].x if len(live) == 1
+              else np.concatenate([r.x for r in live], axis=0))
+        rows = xs.shape[0]
+        bucket = self.batcher.bucket_for(rows)
+        padded = bucket - rows
+        if padded:
+            pad = np.zeros((padded,) + xs.shape[1:], xs.dtype)
+            xs = np.concatenate([xs, pad], axis=0)
+        if self._loaded and (bucket, str(xs.dtype)) not in self._warmed:
+            self.metrics.inc("unwarmed_serves")
+        with self._vlock:
+            v = self._current
+            v.active += 1
+        t0 = self.clock()
+        try:
+            out = np.asarray(self._run_forward(v, replica.idx, xs))
+        except Exception as e:
+            self.metrics.inc("errors")
+            for r in live:
+                if not r.future.done():
+                    r.future.set_exception(e)
+            return
+        finally:
+            with self._vlock:
+                v.active -= 1
+                if v.retired and v.active == 0:
+                    v.drained.set()
+        device_ms = (self.clock() - t0) * 1e3
+        self.batcher.observe_exec_ms(bucket, device_ms)
+        self.metrics.record_batch(len(live), rows, padded, device_ms)
+        with self._log_lock:
+            self.batch_log.append({"tag": v.tag, "n_requests": len(live),
+                                   "rows": rows, "padded": padded,
+                                   "replica": replica.idx})
+            if len(self.batch_log) > 4096:
+                del self.batch_log[:2048]
+        done = self.clock()
+        ofs = 0
+        for r in live:
+            r.future.set_result(out[ofs:ofs + r.rows])
+            ofs += r.rows
+            self.metrics.e2e.record((done - r.t_submit) * 1e3)
+
+    # -- hot swap ----------------------------------------------------------
+
+    def swap_model(self, model, tag: Optional[str] = None) -> str:
+        """Atomic hot-swap: build + AOT-warm the new version, flip the
+        current pointer, then drain — block until every in-flight batch
+        on the old version completes before releasing it.  In-flight
+        requests keep their version; a batch never mixes two versions.
+        Returns the retired version's tag (rollback = swap back, or an
+        alias move in the registry)."""
+        with self._swap_lock:
+            nv = _ModelVersion(model, tag or f"swap@{time.time():.0f}",
+                               self._devices)
+            if self._loaded:
+                self._warm_version(nv)
+            with self._vlock:
+                old = self._current
+                self._current = nv
+                old.retired = True
+                if old.active == 0:
+                    old.drained.set()
+            old.drained.wait()
+            self.metrics.inc("swaps")
+            return old.tag
+
+    @property
+    def current_tag(self) -> str:
+        with self._vlock:
+            return self._current.tag
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def metrics_snapshot(self) -> dict:
+        snap = self.metrics.snapshot()
+        snap["model"] = self.current_tag
+        snap["replicas"] = len(self._replicas)
+        snap["queue_depth"] = self.batcher.qsize()
+        snap["buckets"] = list(self.batcher.buckets)
+        snap["compile_cache_size"] = self.compile_cache_size()
+        return snap
+
+    def shutdown(self, timeout: float = 5.0) -> None:
+        """Deterministic shutdown: every request — queued, in a replica
+        queue, or submitted concurrently with this call — resolves
+        (result or RuntimeError), never hangs."""
+        if self._shutdown:
+            return
+        self._shutdown = True
+        self.batcher.close(fail_pending=True)
+        self._dispatcher.join(timeout=timeout)
+        for r in self._replicas:
+            if r.thread:
+                r.thread.join(timeout=timeout)
+        # anything still sitting in replica queues (threads died, or the
+        # sentinel raced a late dispatch) fails deterministically
+        for r in self._replicas:
+            while True:
+                try:
+                    item = r.queue.get_nowait()
+                except queue.Empty:
+                    break
+                if item is _SENTINEL:
+                    continue
+                for req in item:
+                    if not req.future.done():
+                        req.future.set_exception(
+                            RuntimeError("serving engine is shut down"))

@@ -1,0 +1,145 @@
+"""Serving observability: fixed-bucket latency histograms + counters.
+
+The reference stack exports serving metrics through its model-server's
+/metrics-style endpoints; here a `ServingMetrics` instance is owned by one
+`serving.Engine` and exported two ways: `snapshot()` (a plain dict, the
+test/API surface) and the `ui/server.py` `/metrics` JSON endpoint.
+
+Histograms are FIXED-bucket (exponential ms boundaries), not reservoirs:
+recording is O(#buckets) worst case, lock-held time is tiny, and snapshots
+are mergeable across engines — the properties a hot serving path needs.
+Percentiles are estimated by linear interpolation inside the winning
+bucket, so p99 on a 17-bucket histogram is approximate by design; tests
+that need exact latencies read `count`/`sum_ms` or time externally.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Sequence
+
+# 0.1 ms .. 10 s — covers a jitted forward on any sane hardware on the
+# left and a pathological queue stall on the right; +inf is implicit
+DEFAULT_BUCKETS_MS: Sequence[float] = (
+    0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0,
+    200.0, 500.0, 1000.0, 2000.0, 5000.0, 10000.0,
+)
+
+
+class LatencyHistogram:
+    """Fixed-boundary histogram over milliseconds (thread-safe)."""
+
+    def __init__(self, buckets_ms: Sequence[float] = DEFAULT_BUCKETS_MS):
+        self.bounds = tuple(sorted(buckets_ms))
+        self._counts = [0] * (len(self.bounds) + 1)  # +1 = overflow
+        self.count = 0
+        self.sum_ms = 0.0
+        self.max_ms = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, ms: float) -> None:
+        i = 0
+        for i, b in enumerate(self.bounds):
+            if ms <= b:
+                break
+        else:
+            i = len(self.bounds)
+        with self._lock:
+            self._counts[i] += 1
+            self.count += 1
+            self.sum_ms += ms
+            if ms > self.max_ms:
+                self.max_ms = ms
+
+    def percentile(self, p: float) -> Optional[float]:
+        """Approximate p-th percentile (0<p<=100) via in-bucket linear
+        interpolation; None when empty.  Overflow-bucket hits report the
+        max seen (there is no upper boundary to interpolate against)."""
+        with self._lock:
+            if not self.count:
+                return None
+            rank = p / 100.0 * self.count
+            seen = 0
+            for i, c in enumerate(self._counts):
+                if not c:
+                    continue
+                if seen + c >= rank:
+                    if i >= len(self.bounds):
+                        return self.max_ms
+                    lo = self.bounds[i - 1] if i else 0.0
+                    hi = self.bounds[i]
+                    frac = (rank - seen) / c
+                    return lo + (hi - lo) * frac
+                seen += c
+            return self.max_ms
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            counts = list(self._counts)
+            count, total, mx = self.count, self.sum_ms, self.max_ms
+        out = {"count": count, "sum_ms": round(total, 3),
+               "max_ms": round(mx, 3),
+               "mean_ms": round(total / count, 3) if count else None,
+               "buckets_ms": list(self.bounds), "counts": counts}
+        for p in (50, 90, 99):
+            v = self.percentile(p)
+            out[f"p{p}_ms"] = round(v, 3) if v is not None else None
+        return out
+
+
+class ServingMetrics:
+    """Per-engine metric set: three latency histograms (queue wait,
+    device time, end-to-end) + batching/admission counters.
+
+    Batch occupancy (padding waste) is the satellite-regression metric:
+    ``padded_rows / (rows + padded_rows)`` should stay near zero when
+    request sizes align with buckets — a drain that overshoots
+    ``max_batch`` before bucketing (the old ``ParallelInference._run``
+    bug) shows up here as waste and as ``max_batch_rows`` > max_batch."""
+
+    def __init__(self, buckets_ms: Sequence[float] = DEFAULT_BUCKETS_MS):
+        self.queue_wait = LatencyHistogram(buckets_ms)
+        self.device_time = LatencyHistogram(buckets_ms)
+        self.e2e = LatencyHistogram(buckets_ms)
+        self._lock = threading.Lock()
+        self._c: Dict[str, int] = {
+            "requests": 0, "rows": 0, "batches": 0, "padded_rows": 0,
+            "shed": 0, "deadline_missed": 0, "errors": 0, "swaps": 0,
+            "unwarmed_serves": 0,
+        }
+        self._batch_rows_max = 0
+        self._t0 = time.monotonic()
+
+    def inc(self, key: str, n: int = 1) -> None:
+        with self._lock:
+            self._c[key] = self._c.get(key, 0) + n
+
+    def record_batch(self, n_requests: int, rows: int, padded_rows: int,
+                     device_ms: float) -> None:
+        with self._lock:
+            self._c["batches"] += 1
+            self._c["requests"] += n_requests
+            self._c["rows"] += rows
+            self._c["padded_rows"] += padded_rows
+            if rows > self._batch_rows_max:
+                self._batch_rows_max = rows
+        self.device_time.record(device_ms)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            c = dict(self._c)
+            rows_max = self._batch_rows_max
+            elapsed = time.monotonic() - self._t0
+        total = c["rows"] + c["padded_rows"]
+        return {
+            "counters": c,
+            "max_batch_rows": rows_max,
+            "batch_occupancy": round(c["rows"] / total, 4) if total else None,
+            "requests_per_sec": round(c["requests"] / elapsed, 2)
+            if elapsed > 0 else None,
+            "uptime_sec": round(elapsed, 3),
+            "queue_wait_ms": self.queue_wait.snapshot(),
+            "device_time_ms": self.device_time.snapshot(),
+            "e2e_ms": self.e2e.snapshot(),
+        }

@@ -1,0 +1,140 @@
+"""Versioned model registry with alias pinning and hot-swap.
+
+The reference ecosystem's model-server keeps one mutable "current
+model"; here versions are immutable once registered and DEPLOYMENT is a
+pointer move:
+
+    reg = ModelRegistry()
+    v1 = reg.load("lenet", "ckpt_v1.zip")     # utils/serializer v1-v4 zips
+    v2 = reg.load("lenet", "ckpt_v2.zip")
+    reg.set_alias("lenet", "prod", v1)        # pin
+    eng = Engine.from_registry(reg, "lenet", "prod").load()
+    reg.set_alias("lenet", "prod", v2)        # hot-swap: drains in-flight
+    reg.set_alias("lenet", "prod", v1)        # rollback = alias move
+
+``set_alias`` notifies subscribed engines synchronously and returns only
+after each engine has warmed the incoming version, flipped its current
+pointer, and drained every in-flight batch on the outgoing one — so when
+it returns, no request is still executing the old version.  Batches
+never mix versions (each batch snapshots exactly one version).
+
+Checkpoints load through ``utils/serializer.load_model`` and therefore
+accept every supported FORMAT_VERSION (1-4), including v4 integrity
+digests — a corrupt file raises instead of serving garbage.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class ModelRegistry:
+    """name -> {version -> model} + name -> {alias -> version}."""
+
+    def __init__(self):
+        self._models: Dict[str, Dict[int, Any]] = {}
+        self._aliases: Dict[str, Dict[str, int]] = {}
+        self._lock = threading.Lock()
+        # (name, alias) -> [callback(version, model)]
+        self._subs: Dict[Tuple[str, str], List[Callable[[int, Any], None]]] = {}
+
+    # -- registration ------------------------------------------------------
+
+    def register(self, name: str, model, version: Optional[int] = None) -> int:
+        """Register an in-memory model; returns its version number
+        (monotonically assigned when not given).  Re-registering an
+        existing (name, version) is an error — versions are immutable."""
+        with self._lock:
+            versions = self._models.setdefault(name, {})
+            if version is None:
+                version = max(versions, default=0) + 1
+            version = int(version)
+            if version in versions:
+                raise ValueError(f"{name} v{version} already registered — "
+                                 "versions are immutable; register a new one")
+            versions[version] = model
+            return version
+
+    def load(self, name: str, path: str,
+             version: Optional[int] = None) -> int:
+        """Load a checkpoint zip (serializer FORMAT_VERSION 1-4) and
+        register it."""
+        from ..utils.serializer import load_model
+
+        return self.register(name, load_model(path), version=version)
+
+    # -- lookup ------------------------------------------------------------
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._models)
+
+    def versions(self, name: str) -> List[int]:
+        with self._lock:
+            return sorted(self._models.get(name, {}))
+
+    def aliases(self, name: str) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._aliases.get(name, {}))
+
+    def resolve(self, name: str, ref: Any = "latest") -> Tuple[int, Any]:
+        """(version, model) for a ref: an int version, ``"latest"``, a
+        ``"v<N>"`` string, or an alias name."""
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise KeyError(f"no model named {name!r} registered")
+            v = self._resolve_version_locked(name, ref)
+            return v, versions[v]
+
+    def _resolve_version_locked(self, name: str, ref: Any) -> int:
+        versions = self._models[name]
+        if isinstance(ref, int):
+            v = ref
+        elif ref == "latest":
+            v = max(versions)
+        elif isinstance(ref, str) and ref.startswith("v") and ref[1:].isdigit():
+            v = int(ref[1:])
+        else:
+            alias = self._aliases.get(name, {})
+            if ref not in alias:
+                raise KeyError(
+                    f"{name}: unknown version ref {ref!r} (have versions "
+                    f"{sorted(versions)}, aliases {sorted(alias)})")
+            v = alias[ref]
+        if v not in versions:
+            raise KeyError(f"{name}: version {v} not registered "
+                           f"(have {sorted(versions)})")
+        return v
+
+    # -- aliases + hot swap ------------------------------------------------
+
+    def set_alias(self, name: str, alias: str, version: int) -> Optional[int]:
+        """Atomically move ``alias`` to ``version`` and hot-swap every
+        subscribed engine (synchronously — returns after old versions
+        drained).  Returns the alias's previous version (None if new).
+        Rollback is just another ``set_alias`` to the old version."""
+        with self._lock:
+            if name not in self._models:
+                raise KeyError(f"no model named {name!r} registered")
+            version = self._resolve_version_locked(name, version)
+            amap = self._aliases.setdefault(name, {})
+            prev = amap.get(alias)
+            amap[alias] = version
+            model = self._models[name][version]
+            subs = list(self._subs.get((name, alias), ()))
+        if prev != version:
+            # callbacks run OUTSIDE the registry lock: an engine's swap
+            # blocks on draining in-flight batches, whose replica threads
+            # must never need this lock
+            for cb in subs:
+                cb(version, model)
+        return prev
+
+    def subscribe(self, name: str, alias: str,
+                  callback: Callable[[int, Any], None]) -> None:
+        """Engine hook: ``callback(version, model)`` fires on every
+        ``set_alias`` move of (name, alias)."""
+        with self._lock:
+            self._subs.setdefault((name, alias), []).append(callback)

@@ -25,12 +25,66 @@ class UIServer:
         self.host = host
         self.enable_remote = enable_remote
         self._storages: List = []
+        self._metrics_providers: List = []
+        self._engine = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
     def attach(self, storage) -> "UIServer":
         self._storages.append(storage)
         return self
+
+    def attach_metrics(self, provider) -> "UIServer":
+        """Export a metrics source on GET /metrics.  ``provider`` is a
+        zero-arg callable returning a JSON-able dict (e.g. a serving
+        Engine's ``metrics_snapshot``) or an object with ``snapshot()``."""
+        self._metrics_providers.append(provider)
+        return self
+
+    def attach_engine(self, engine) -> "UIServer":
+        """Serve inference on POST /predict (JSON {"inputs": [[...]]} →
+        {"outputs": ...}) through a serving Engine, and export its
+        metrics on /metrics."""
+        self._engine = engine
+        return self.attach_metrics(engine.metrics_snapshot)
+
+    def _metrics_json(self) -> str:
+        import json
+        serving = []
+        for p in self._metrics_providers:
+            snap = p() if callable(p) else p.snapshot()
+            serving.append(snap)
+        sessions = {}
+        for storage in self._storages:
+            for sid in storage.list_session_ids():
+                ups = storage.get_updates(sid)
+                last = ups[-1] if ups else {}
+                sessions[sid] = {"updates": len(ups),
+                                 "last_iteration": last.get("iteration"),
+                                 "last_score": last.get("score")}
+        return json.dumps({"serving": serving, "sessions": sessions})
+
+    def _predict_json(self, body: bytes):
+        """(status, payload) for POST /predict.  Admission shed maps to
+        429, a blown deadline to 504 — overload stays visible to HTTP
+        clients instead of turning into opaque 500s."""
+        import json
+        from ..serving import DeadlineExceededError, OverloadedError
+        if self._engine is None:
+            return 503, {"error": "no serving engine attached"}
+        try:
+            payload = json.loads(body)
+            import numpy as np
+            x = np.asarray(payload["inputs"], np.float32)
+            out = self._engine.output(x, slo_ms=payload.get("slo_ms"))
+            return 200, {"outputs": np.asarray(out).tolist(),
+                         "model": self._engine.current_tag}
+        except OverloadedError as e:
+            return 429, {"error": str(e)}
+        except DeadlineExceededError as e:
+            return 504, {"error": str(e)}
+        except (KeyError, ValueError, TypeError) as e:
+            return 400, {"error": f"{type(e).__name__}: {e}"}
 
     def enable_remote_listener(self) -> "UIServer":
         """Accept POSTed stats on /remote into the first attached storage
@@ -69,21 +123,32 @@ class UIServer:
             def log_message(self, *a):  # silence request logging
                 pass
 
+            def _reply(self, code, data, ctype):
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
             def do_GET(self):
                 try:
-                    if self.path.startswith("/train/"):
-                        _, _, si, sid = self.path.split("/", 3)
+                    path = urllib.parse.urlsplit(self.path).path
+                    if path.startswith("/train/"):
+                        _, _, si, sid = path.split("/", 3)
                         body = render_session_html(
                             server._storages[int(si)],
                             urllib.parse.unquote(sid))
-                    else:
+                    elif path == "/metrics":
+                        self._reply(200, server._metrics_json().encode(),
+                                    "application/json")
+                        return
+                    elif path in ("", "/", "/index.html"):
                         body = server._render_index()
-                    data = body.encode()
-                    self.send_response(200)
-                    self.send_header("Content-Type", "text/html; charset=utf-8")
-                    self.send_header("Content-Length", str(len(data)))
-                    self.end_headers()
-                    self.wfile.write(data)
+                    else:  # unknown paths are 404s, not the index page
+                        self._reply(404, b"not found", "text/plain")
+                        return
+                    self._reply(200, body.encode(),
+                                "text/html; charset=utf-8")
                 except Exception as e:  # pragma: no cover - defensive
                     self.send_response(500)
                     self.end_headers()
@@ -91,11 +156,17 @@ class UIServer:
 
             def do_POST(self):
                 try:
+                    import json
+                    n = int(self.headers.get("Content-Length", 0))
+                    if self.path == "/predict":
+                        code, payload = server._predict_json(self.rfile.read(n))
+                        self._reply(code, json.dumps(payload).encode(),
+                                    "application/json")
+                        return
                     if self.path != "/remote":
                         self.send_response(404)
                         self.end_headers()
                         return
-                    n = int(self.headers.get("Content-Length", 0))
                     code = server._handle_remote(self.rfile.read(n))
                     self.send_response(code)
                     self.send_header("Content-Length", "0")

@@ -1,0 +1,255 @@
+"""Serving A/B: legacy poll-drain ParallelInference vs serving.Engine.
+
+Protocol (CPU; the batching logic under test is host-side — run with
+``JAX_PLATFORMS=cpu``, as bench.py's subprocess harness does):
+
+  1. Build the LeNet zoo model (28x28x1, the BASELINE.md conv config).
+  2. Warm BOTH arms: the engine via its AOT ``load()``, the legacy arm
+     by compiling every bucket size + the overshoot sizes its drain bug
+     can produce — the A/B measures steady-state serving, not compiles.
+  3. Drive the SAME synthetic open-loop load through each arm: requests
+     of 1-2 rows at a fixed inter-arrival (an open-loop Poisson-ish
+     trickle, NOT closed-loop — the arrival clock never waits for the
+     server, exactly how production traffic behaves).
+  4. Report per-arm p50/p99 end-to-end latency and throughput
+     (completed / (last completion - first submit)), plus the engine's
+     batch-occupancy accounting.
+
+Why the legacy arm structurally loses: its drain polls
+``queue.get(timeout=5ms)`` PER ITEM, so any arrival inside the window
+re-arms the poll — under a trickle with inter-arrival < 5ms the batch
+only closes when ``max_batch`` ROWS accumulate, putting an
+arrival-rate-dependent (unbounded) head-of-line wait on the oldest
+request.  The new batcher's close is anchored at the OLDEST request's
+submit time (and its deadline slack), so the oldest request's wait is
+bounded regardless of arrival pattern.  The legacy drain also buckets
+on total queued rows (overshooting ``max_batch`` compiles odd-size
+programs) — the serving batcher splits at ``max_batch`` first.
+
+Gates (consumed by bench.py ``serving_throughput``):
+  - throughput_ok: new >= 1.0x legacy (at 2-decimal ratio precision;
+    sub-1% deltas are timer noise on a shared box)
+  - p99_ok: new p99 <= legacy p99 at the same offered load
+
+Last stdout line is the JSON result (the bench subprocess contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import sys
+import threading
+import time
+from concurrent.futures import Future
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class LegacyParallelInference:
+    """The pre-serving implementation, verbatim (fixed-poll drain) —
+    kept here as the A/B baseline now that ``parallel.inference``
+    delegates to the new engine."""
+
+    def __init__(self, model, max_batch: int = 32, queue_timeout_s: float = 0.005,
+                 bucket_sizes: Optional[List[int]] = None):
+        self.model = model
+        self.max_batch = max_batch
+        self.timeout = queue_timeout_s
+        if bucket_sizes is None:
+            bucket_sizes, b = [], 1
+            while b < max_batch:
+                bucket_sizes.append(b)
+                b *= 2
+            bucket_sizes.append(max_batch)
+        self.buckets = sorted(set(bucket_sizes))
+        self._queue: "queue.Queue[Tuple[np.ndarray, Future]]" = queue.Queue()
+        self._shutdown = threading.Event()
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def output_async(self, x: np.ndarray) -> Future:
+        fut: Future = Future()
+        self._queue.put((np.asarray(x), fut))
+        return fut
+
+    def shutdown(self) -> None:
+        self._shutdown.set()
+        self._worker.join(timeout=5)
+        while True:
+            try:
+                _, fut = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if not fut.done():
+                fut.set_exception(RuntimeError("ParallelInference is shut down"))
+
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return n
+
+    def _run(self) -> None:
+        while not self._shutdown.is_set():
+            batch: List[Tuple[np.ndarray, Future]] = []
+            try:
+                batch.append(self._queue.get(timeout=0.05))
+            except queue.Empty:
+                continue
+            try:
+                total = batch[0][0].shape[0]
+                while total < self.max_batch:
+                    try:
+                        item = self._queue.get(timeout=self.timeout)
+                        batch.append(item)
+                        total += item[0].shape[0]
+                    except queue.Empty:
+                        break
+                xs = np.concatenate([b[0] for b in batch], axis=0)
+                padded_n = self._bucket(xs.shape[0])
+                if padded_n > xs.shape[0]:
+                    pad = np.zeros((padded_n - xs.shape[0],) + xs.shape[1:], xs.dtype)
+                    xs = np.concatenate([xs, pad], axis=0)
+                out = self.model.output(xs)
+                if isinstance(out, list):
+                    out = out[0]
+                ofs = 0
+                for x, fut in batch:
+                    n = x.shape[0]
+                    fut.set_result(out[ofs:ofs + n])
+                    ofs += n
+            except Exception as e:
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)
+
+
+def _request_rows(i: int) -> int:
+    return 1 if i % 3 else 2  # avg 1.33 rows/request
+
+
+def run_arm(submit_async, n_requests: int, interarrival_s: float,
+            shape: Tuple[int, ...]) -> dict:
+    """Open-loop driver: the arrival clock never waits for the server."""
+    rng = np.random.default_rng(0)
+    xs = [rng.normal(size=(_request_rows(i),) + shape).astype(np.float32)
+          for i in range(n_requests)]
+    futs: List[Tuple[Future, float]] = []
+    done_lat: List[float] = []
+    errors = [0]
+    lock = threading.Lock()
+    t_start = time.perf_counter()
+    t_last_done = [t_start]
+
+    def on_done(t_submit):
+        def cb(fut):
+            t = time.perf_counter()
+            with lock:
+                if fut.exception() is not None:
+                    errors[0] += 1
+                else:
+                    done_lat.append((t - t_submit) * 1e3)
+                    if t > t_last_done[0]:
+                        t_last_done[0] = t
+        return cb
+
+    for i, x in enumerate(xs):
+        target = t_start + i * interarrival_s
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        t_submit = time.perf_counter()
+        fut = submit_async(x)
+        fut.add_done_callback(on_done(t_submit))
+        futs.append((fut, t_submit))
+    for fut, _ in futs:
+        try:
+            fut.result(timeout=120)
+        except Exception:
+            pass
+    lat = np.sort(np.asarray(done_lat))
+    wall = t_last_done[0] - t_start
+    return {
+        "completed": int(len(done_lat)), "errors": int(errors[0]),
+        "wall_s": round(wall, 4),
+        "throughput_rps": round(len(done_lat) / wall, 2) if wall > 0 else 0.0,
+        "p50_ms": round(float(lat[int(0.50 * (len(lat) - 1))]), 3) if len(lat) else None,
+        "p99_ms": round(float(lat[int(0.99 * (len(lat) - 1))]), 3) if len(lat) else None,
+        "mean_ms": round(float(lat.mean()), 3) if len(lat) else None,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--interarrival-ms", type=float, default=3.0)
+    ap.add_argument("--max-batch", type=int, default=32)
+    args = ap.parse_args()
+
+    import jax
+
+    from deeplearning4j_tpu.models import LeNet
+    from deeplearning4j_tpu.serving import Engine
+
+    n_requests = args.requests or (300 if args.quick else 1500)
+    dt = args.interarrival_ms / 1000.0
+    shape = (28, 28, 1)
+    net = LeNet(height=28, width=28, channels=1, num_classes=10)
+
+    # -- warm both arms (compiles are amortized out of the measurement) --
+    legacy = LegacyParallelInference(net, max_batch=args.max_batch)
+    warm_sizes = list(legacy.buckets) + list(
+        range(args.max_batch + 1, args.max_batch + 3))  # drain-overshoot sizes
+    for n in warm_sizes:
+        net.output(np.zeros((n,) + shape, np.float32))
+
+    engine = Engine(net, max_batch=args.max_batch, slo_ms=200.0,
+                    max_wait_ms=2.5, replicas=2, max_queue=100_000,
+                    admission="block")
+    engine.load(input_shape=shape)
+
+    # -- measure: same open-loop schedule through each arm --------------
+    print(f"serving_ab: {n_requests} requests @ {args.interarrival_ms}ms "
+          f"inter-arrival, max_batch={args.max_batch}, "
+          f"platform={jax.devices()[0].platform}", file=sys.stderr)
+    legacy_stats = run_arm(legacy.output_async, n_requests, dt, shape)
+    legacy.shutdown()
+    new_stats = run_arm(engine.output_async, n_requests, dt, shape)
+    snap = engine.metrics_snapshot()
+    engine.shutdown()
+
+    new_stats["batch_occupancy"] = snap["batch_occupancy"]
+    new_stats["batches"] = snap["counters"]["batches"]
+    new_stats["unwarmed_serves"] = snap["counters"]["unwarmed_serves"]
+    ratio = (new_stats["throughput_rps"] / legacy_stats["throughput_rps"]
+             if legacy_stats["throughput_rps"] else float("inf"))
+    result = {
+        "platform": jax.devices()[0].platform,
+        "quick": args.quick,
+        "n_requests": n_requests,
+        "interarrival_ms": args.interarrival_ms,
+        "max_batch": args.max_batch,
+        "legacy": legacy_stats,
+        "new": new_stats,
+        "throughput_ratio_new_vs_legacy": round(ratio, 4),
+        # 2-decimal precision: sub-1% deltas are timer noise on a shared box
+        "throughput_ok": round(ratio, 2) >= 1.0,
+        "p99_ok": (new_stats["p99_ms"] is not None
+                   and legacy_stats["p99_ms"] is not None
+                   and new_stats["p99_ms"] <= legacy_stats["p99_ms"]),
+        "all_completed": (new_stats["errors"] == 0
+                          and legacy_stats["errors"] == 0),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -22,3 +22,9 @@ import jax  # noqa: E402  (may already be imported by sitecustomize — fine)
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running load/soak tests, deselected in tier-1 (-m 'not slow')")
