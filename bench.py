@@ -35,6 +35,12 @@ stderr, including:
     a real ElasticTrainer loop, hard-gated on zero unrecovered failures,
     corrupt-latest checkpoint fallback, chaos-off bitwise identity, and
     loss parity with the fault-free run (docs/FAULT_TOLERANCE.md)
+  - input_pipeline_overlap: the device-resident input-pipeline A/B gate
+    (scripts/input_pipeline_ab.py) — sync host feeding vs
+    DevicePrefetchIterator (async H2D ring, uint8 wire, on-device
+    normalization), hard-gated on prefetched >= 1.0x sync throughput,
+    bit-identical loss sequences, and a reported input-stall fraction
+    (docs/INPUT_PIPELINE.md)
   - serving_throughput_rps: the production-serving A/B gate
     (scripts/serving_ab.py) — legacy fixed-poll ParallelInference vs the
     new serving.Engine on the same synthetic open-loop LeNet load,
@@ -919,6 +925,56 @@ def bench_serving():
             "p99_ok": True, "throughput_ok": True}
 
 
+def bench_input_pipeline():
+    """Config 13: device-resident input pipeline A/B
+    (scripts/input_pipeline_ab.py; CPU subprocess — the feeding logic
+    under test is host-side).  Sync (host normalizer + per-step blocking
+    H2D in fit_batch) vs DevicePrefetchIterator (uint8 wire, depth-2
+    async H2D ring, jitted on-device normalization) on the same uint8
+    image stream, arms interleaved epoch-for-epoch.  HARD gates (the
+    input-pipeline regression contract): prefetched throughput >= 1.0x
+    sync (median paired-epoch ratio), the loss sequence BIT-IDENTICAL to
+    the sync path (on the gated model AND a full-LeNet leg — the
+    pipeline moves work, never math; this also pins the sync fallback
+    path bitwise), and a reported stall fraction from the prefetcher's
+    request-vs-ready accounting (docs/INPUT_PIPELINE.md).  The headline
+    value is the throughput ratio — a host-side figure, NOT a TPU
+    number; the wire-byte and overlap wins are larger on a real chip."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    script = os.path.join(_REPO, "scripts", "input_pipeline_ab.py")
+    cmd = [sys.executable, script] + (["--quick"] if QUICK else [])
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=1800, cwd=_REPO)
+    if p.returncode != 0:
+        raise RuntimeError(f"input_pipeline_ab failed (rc={p.returncode}): "
+                           f"{p.stderr[-1500:]}")
+    ab = json.loads(p.stdout.strip().splitlines()[-1])
+    if not ab.get("throughput_ok"):
+        raise RuntimeError("input-pipeline throughput gate FAILED "
+                           f"(prefetched must be >= 1.0x sync): {ab}")
+    if not ab.get("loss_bitwise") or not ab.get("lenet_bitwise"):
+        raise RuntimeError("input-pipeline bit-identity gate FAILED (the "
+                           f"prefetched path changed the math): {ab}")
+    if ab.get("stall_fraction") is None:
+        raise RuntimeError(f"input-pipeline stall accounting MISSING: {ab}")
+    return {"metric": "input_pipeline_overlap",
+            "value": ab["throughput_ratio"],
+            "unit": "x (prefetched/sync, cpu)",
+            "platform": ab["platform"],
+            "paired_epoch_ratios": ab["paired_epoch_ratios"],
+            "images_per_sec": {"sync": ab["sync"]["images_per_sec"],
+                               "prefetched":
+                                   ab["prefetched"]["images_per_sec"]},
+            "stall_fraction": ab["stall_fraction"],
+            "stall_stats": ab["stall_stats"],
+            "loss_bitwise": True, "lenet_bitwise": True,
+            "throughput_ok": True}
+
+
 def bench_chaos_recovery():
     """Config 11: chaos-tested fault recovery (scripts/chaos_soak.py; the
     subprocess mechanism, CPU — fault injection needs no accelerator).  A
@@ -989,7 +1045,8 @@ def main() -> None:
                      ("pipeline_schedules", bench_pipeline_schedules),
                      ("grad_compression", bench_grad_compression),
                      ("chaos_recovery", bench_chaos_recovery),
-                     ("serving_throughput", bench_serving)]:
+                     ("serving_throughput", bench_serving),
+                     ("input_pipeline_overlap", bench_input_pipeline)]:
         try:
             t0 = time.perf_counter()
             out = fn()

@@ -181,6 +181,40 @@ def _parse_mesh(spec: str) -> tuple:
     return axes, schedule, compress
 
 
+def _parse_prefetch(spec: str):
+    """'DEPTH[,DEVICE]' → (depth, device_spec|None).  depth 0 = the
+    synchronous path (bitwise-unchanged pre-prefetch behavior); DEVICE is
+    'platform[:index]' or a bare device index.  Every parse failure is a
+    one-line CLI error, not a traceback."""
+    depth_s, _, dev = spec.partition(",")
+    try:
+        depth = int(depth_s)
+        if depth < 0:
+            raise ValueError
+    except ValueError:
+        raise SystemExit(f"bad --prefetch {spec!r}: expected "
+                         "DEPTH[,DEVICE] with DEPTH >= 0, e.g. '2' or "
+                         "'2,tpu:0' (0 = synchronous feeding)")
+    dev = dev.strip() or None
+    if depth == 0 and dev:
+        raise SystemExit(f"bad --prefetch {spec!r}: a device makes no "
+                         "sense with depth 0 (synchronous feeding)")
+    return depth, dev
+
+
+def _resolve_device(spec: str):
+    """'tpu:0' / 'cpu' / '1' → a jax.Device (clean CLI errors)."""
+    import jax
+
+    try:
+        if spec.isdigit():
+            return jax.devices()[int(spec)]
+        plat, _, idx = spec.partition(":")
+        return jax.devices(plat)[int(idx) if idx else 0]
+    except (RuntimeError, IndexError, ValueError) as e:
+        raise SystemExit(f"bad --prefetch device {spec!r}: {e}")
+
+
 def _parse_chaos(spec: str):
     """'kind@step[,kind@step...][,seed=S][,hang=SECONDS]' →
     (FaultSchedule, seed, hang_seconds).  Fault kinds are the
@@ -296,6 +330,27 @@ def cmd_train(args) -> int:
                              f"{type(net).__name__} yet")
         net.set_nan_guard(args.nan_guard)
         print(f"nan guard armed (budget {args.nan_guard})")
+    prefetcher = None
+    if args.prefetch:
+        depth, dev_spec = _parse_prefetch(args.prefetch)
+        if depth > 0:
+            # device-resident input pipeline (docs/INPUT_PIPELINE.md):
+            # batches cross host→device from a background thread, landing
+            # pre-sharded on a mesh run (the trainer's batch placement
+            # then passes them through untouched)
+            from .datasets.device_prefetch import DevicePrefetchIterator
+
+            if mesh_axes and dev_spec:
+                raise SystemExit("--prefetch DEVICE does not combine with "
+                                 "--mesh (batches land on the mesh's batch "
+                                 "sharding)")
+            sharding = trainer.batch_sharding if trainer is not None else None
+            device = _resolve_device(dev_spec) if dev_spec else None
+            prefetcher = it = DevicePrefetchIterator(
+                it, depth=depth, sharding=sharding, device=device)
+            where = ("mesh batch sharding" if sharding is not None else
+                     str(device) if device is not None else "default device")
+            print(f"prefetch: depth {depth} onto {where}")
     if args.elastic_dir:
         # checkpoint-restore recovery (the reference CheckpointListener +
         # Spark task-retry role; docs/FAULT_TOLERANCE.md) — with --chaos,
@@ -332,6 +387,11 @@ def cmd_train(args) -> int:
               f"step {et.global_step} in {args.elastic_dir}")
     print(f"trained {args.epochs} epoch(s), {len(losses)} iterations, "
           f"final loss {losses[-1]:.5f}")
+    if prefetcher is not None:
+        s = prefetcher.stall_stats()
+        print(f"prefetch: stall fraction {s['stall_fraction']:.3f} "
+              f"({s['stalls']} stall(s), avg {s['avg_stall_ms']:.1f}ms) over "
+              f"{s['batches']} batches, depth {s['depth']}")
     if args.dashboard:
         from .ui import render_dashboard
 
@@ -448,6 +508,13 @@ def build_parser() -> argparse.ArgumentParser:
                    "'compress=threshold|bitmap' enables the DCN-tier "
                    "compressed gradient exchange on dcn-axis meshes, "
                    "e.g. 'dcn=2,data=4,compress=threshold'")
+    t.add_argument("--prefetch", metavar="DEPTH[,DEVICE]",
+                   help="device-resident input pipeline "
+                   "(docs/INPUT_PIPELINE.md): keep DEPTH batches already "
+                   "transferred to device ahead of the step (async H2D from "
+                   "a background thread; pre-sharded on --mesh runs); "
+                   "'0' = synchronous feeding (bitwise-unchanged legacy "
+                   "path); optional DEVICE pins placement, e.g. '2,tpu:0'")
     t.add_argument("--nan-guard", type=int, default=None, metavar="BUDGET",
                    help="arm the divergence guard: steps with non-finite "
                    "gradients apply no update; BUDGET consecutive bad steps "

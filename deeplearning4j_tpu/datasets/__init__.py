@@ -1,4 +1,5 @@
 from .dataset import DataSet, MultiDataSet
+from .device_prefetch import DevicePrefetchIterator, device_put_batch
 from .iterators import (
     DataSetIterator,
     ListDataSetIterator,

@@ -2,8 +2,11 @@
 
 Parity with ND4J ``org.nd4j.linalg.dataset.DataSet`` (features, labels,
 featuresMask, labelsMask) and ``MultiDataSet`` (lists of each).  Arrays are
-numpy on the host; device placement happens inside the jit'd step (or via
-double-buffered device puts in AsyncDataSetIterator).
+numpy on the host; device placement happens inside the jit'd step — or
+ahead of it via the double-buffered async puts of
+``device_prefetch.DevicePrefetchIterator``, whose batches carry
+device-resident jax Arrays in the same fields (consumers pass them
+through untouched).
 """
 
 from __future__ import annotations

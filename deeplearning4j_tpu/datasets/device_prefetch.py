@@ -1,0 +1,307 @@
+"""Device-resident input pipeline — double-buffered async H2D prefetch.
+
+AsyncDataSetIterator overlaps host ETL (decode, batching, normalization)
+with device compute, but the batch it hands over is still HOST memory:
+every training step then pays a synchronous host→device copy inside
+``fit_batch`` (``jnp.asarray``) — exactly the infeed stall the TensorFlow
+input-pipeline design (Abadi et al., 2016, §4.2 "Input Operations") and
+the TPU concurrency study (Kumar et al., 2020) identify as the dominant
+overhead at high step rates.  :class:`DevicePrefetchIterator` closes that
+gap: a background thread issues **non-blocking** ``jax.device_put`` calls
+ahead of the consumer, keeping a depth-k ring of batches that are already
+
+  * **on device** (the put is dispatched while the previous step computes,
+    so the transfer rides under compute instead of serializing with it),
+  * **pre-sharded** (pass a ``jax.sharding.Sharding`` and the whole batch
+    pytree lands split across the mesh in one ``device_put(batch,
+    sharding)`` — ``ShardedTrainer``'s per-step placement then passes it
+    through untouched),
+  * **narrow on the wire** (``cast_dtype="bfloat16"`` truncates floating
+    feature arrays on the host side of the copy, halving wire bytes;
+    uint8 pixels already cross at 1 byte/px and scale on chip), and
+  * **already normalized** (``transform=`` a fitted normalizer compiles
+    its statistics into a jitted on-device op — host numpy drops out of
+    the steady-state path entirely).
+
+Input-stall accounting: every ``next()`` measures the gap between "step
+requested a batch" and "a batch was ready".  ``stall_stats()`` returns the
+stall fraction / queue depth snapshot that ``ui.profiler
+.input_pipeline_snapshot()`` and the StatsListener surface, and that the
+``input_pipeline_overlap`` bench config gates on.
+
+The synchronous path is untouched: not wrapping (or CLI ``--prefetch 0``)
+runs exactly the pre-prefetch code, bit for bit.  See
+docs/INPUT_PIPELINE.md.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+import weakref
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from .dataset import DataSet
+from .iterators import DataSetIterator, _ProducerFailure
+
+# live prefetchers, for the profiler/stats snapshot (weak: a dropped
+# iterator must not be kept alive — its producer thread would be too)
+_LIVE: "weakref.WeakSet[DevicePrefetchIterator]" = weakref.WeakSet()
+
+
+def live_pipelines():
+    """Snapshot list over the currently-live prefetch iterators (the
+    ``ui.profiler.input_pipeline_snapshot`` backing store)."""
+    return list(_LIVE)
+
+
+def device_put_batch(batch, placement=None):
+    """Asynchronously transfer a pytree of host arrays to device.
+
+    ``placement`` is a ``jax.sharding.Sharding`` (the whole pytree lands
+    pre-sharded), a ``jax.Device``, or None (default device).  Leaves that
+    are already ``jax.Array`` s on the requested placement pass through
+    untouched — never a device→host→device round trip.  Shared by the
+    prefetcher, ``ShardedTrainer`` consumers, and ``serving.Engine``'s
+    per-replica parameter loads.
+    """
+    import jax
+
+    def put(a):
+        if isinstance(a, jax.Array):
+            if placement is None:
+                return a
+            try:
+                if isinstance(placement, jax.sharding.Sharding):
+                    if a.sharding.is_equivalent_to(placement, a.ndim):
+                        return a
+                elif a.committed and a.devices() == {placement}:
+                    return a
+            except Exception:
+                pass  # conservative: fall through to an explicit put
+        return jax.device_put(a, placement)
+
+    return jax.tree_util.tree_map(put, batch)
+
+
+class DevicePrefetchIterator(DataSetIterator):
+    """Wrap any DataSetIterator with a depth-k ring of device-resident
+    batches (k=2 double-buffers: one batch feeding the current step, one
+    in flight).
+
+    Parameters
+    ----------
+    base: the host-side iterator to wrap (its ``next()`` — including any
+        attached host pre_processor — runs on the producer thread).
+    depth: ring size — batches transferred ahead of the consumer.
+    sharding: optional ``jax.sharding.Sharding``; the batch pytree is
+        placed with ONE ``device_put(batch, sharding)`` so a
+        ``ShardedTrainer`` (pass its ``batch_sharding``) sees pre-sharded
+        input and skips its per-step placement path.
+    device: optional ``jax.Device`` (mutually exclusive with sharding).
+    cast_dtype: optional wire dtype for FLOATING feature arrays — cast on
+        the host side of the copy (``"bfloat16"`` halves wire bytes; the
+        model's compute-dtype cast then runs on chip).  Labels, masks and
+        integer features (uint8 pixels, token ids) are never cast.  Lossy
+        for narrowing casts — the bitwise-parity guarantee vs the sync
+        path holds only with ``cast_dtype=None``.
+    transform: optional device-side batch transform — either a fitted
+        normalizer (``datasets.normalizers``; its ``device_transform()``
+        compiles the statistics into a jitted on-chip op) or any callable
+        DataSet→DataSet over jax arrays.  Runs after the put, on the
+        producer thread (dispatch is async).  If ``transform`` is the very
+        normalizer attached to ``base`` as pre_processor, it is detached
+        from the base for this pipeline — normalization moves on-device
+        instead of running twice.
+    """
+
+    _SENTINEL = object()
+
+    def __init__(self, base: DataSetIterator, depth: int = 2,
+                 sharding=None, device=None,
+                 cast_dtype: Optional[Any] = None,
+                 transform: Optional[Callable] = None):
+        if depth < 1:
+            raise ValueError(f"prefetch depth must be >= 1, got {depth}")
+        if sharding is not None and device is not None:
+            raise ValueError("pass sharding OR device, not both")
+        self._base = base
+        self._depth = depth
+        self._placement = sharding if sharding is not None else device
+        if cast_dtype is None:
+            self._cast = None
+        else:
+            import jax.numpy as jnp
+            # "bfloat16" resolves through jnp (ml_dtypes-backed — plain
+            # numpy has no bfloat16); numpy names resolve directly
+            self._cast = np.dtype(getattr(jnp, str(cast_dtype), cast_dtype))
+        if transform is not None and hasattr(transform, "device_transform"):
+            if getattr(base, "pre_processor", None) is transform:
+                base.pre_processor = None
+            transform = transform.device_transform()
+        self._transform = transform
+        self.batch_size = base.batch_size
+        self._queue: queue.Queue = queue.Queue(maxsize=depth)
+        self._thread: Optional[threading.Thread] = None
+        self._stop: Optional[threading.Event] = None
+        self._peeked = None
+        self._closed = False
+        # stall accounting (cumulative across epochs/resets)
+        self._lock = threading.Lock()
+        self._batches = 0
+        self._stalls = 0
+        self._stall_seconds = 0.0
+        self._first_request: Optional[float] = None
+        self._last_ready: Optional[float] = None
+        _LIVE.add(self)
+        self._start()
+
+    # -- producer ----------------------------------------------------------
+
+    def _place(self, ds: DataSet) -> DataSet:
+        """Host-cast (wire dtype) → async device put → jitted on-device
+        transform.  Runs on the producer thread; device_put and jit
+        dispatch are non-blocking, so by the time the consumer asks, the
+        transfer has been riding under the previous step's compute."""
+        feats = ds.features
+        if self._cast is not None:
+            a = np.asarray(feats)
+            if np.issubdtype(a.dtype, np.floating):
+                feats = a.astype(self._cast)
+        placed = device_put_batch(
+            (feats, ds.labels, ds.features_mask, ds.labels_mask),
+            self._placement)
+        out = DataSet(*placed)
+        if self._transform is not None:
+            out = self._transform(out)
+        return out
+
+    def _start(self) -> None:
+        self._queue = queue.Queue(maxsize=self._depth)
+        stop = threading.Event()
+        self._stop = stop
+        q = self._queue
+
+        def _enqueue(item) -> None:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+
+        def producer():
+            err: Optional[BaseException] = None
+            try:
+                self._base.reset()
+                while not stop.is_set() and self._base.has_next():
+                    _enqueue(self._place(self._base.next()))
+            except BaseException as e:  # noqa: BLE001 — carried, not eaten
+                err = e
+            finally:
+                # exhaustion OR failure both close the stream explicitly;
+                # a raise must reach the consumer, never truncate an epoch
+                _enqueue(self._SENTINEL if err is None
+                         else _ProducerFailure(err))
+
+        self._thread = threading.Thread(
+            target=producer, daemon=True, name="DevicePrefetchIterator")
+        self._thread.start()
+
+    def _teardown(self) -> None:
+        """Stop the producer deadlock-free (it may be blocked on a full
+        queue) and join it — no thread leaks on reset/close mid-stream."""
+        if self._thread is None:
+            return
+        self._stop.set()
+        while self._thread.is_alive():
+            try:
+                self._queue.get(timeout=0.05)
+            except queue.Empty:
+                pass
+        self._thread.join()
+        self._thread = None
+        self._peeked = None
+
+    # -- consumer ----------------------------------------------------------
+
+    def _peek(self):
+        if self._peeked is None:
+            if self._closed:
+                return self._SENTINEL
+            t0 = time.perf_counter()
+            item = self._queue.get()
+            waited = time.perf_counter() - t0
+            with self._lock:
+                if self._first_request is None:
+                    self._first_request = t0
+                self._stall_seconds += waited
+                if waited > 1e-3:
+                    self._stalls += 1
+                self._last_ready = t0 + waited
+            self._peeked = item
+        return self._peeked
+
+    def has_next(self) -> bool:
+        item = self._peek()
+        if isinstance(item, _ProducerFailure):
+            raise item.exc
+        return item is not self._SENTINEL
+
+    def next(self) -> DataSet:
+        item = self._peek()
+        if isinstance(item, _ProducerFailure):
+            raise item.exc
+        if item is self._SENTINEL:
+            raise StopIteration
+        self._peeked = None
+        with self._lock:
+            self._batches += 1
+        return item
+
+    def reset(self) -> None:
+        """Restart the stream: tear the producer down (even mid-stream or
+        after a failure) and spin a fresh pass.  Stall statistics are
+        cumulative across resets — an epoch boundary is not a new run."""
+        self._teardown()
+        self._closed = False
+        self._start()
+
+    def close(self) -> None:
+        """Tear down without restarting (mid-stream teardown); the
+        iterator reports exhausted until ``reset()``."""
+        self._teardown()
+        self._closed = True
+
+    def total_examples(self):
+        return self._base.total_examples()
+
+    # -- input-stall accounting --------------------------------------------
+
+    def stall_stats(self) -> dict:
+        """Snapshot of the request-vs-ready accounting.
+
+        ``stall_fraction`` is the share of the consumer's wall clock (first
+        request → last batch ready) spent waiting on the pipeline: ~0 means
+        input is fully hidden under compute; → 1 means the step is
+        input-bound (grow ``depth``, move ETL on-device, or shrink wire
+        bytes).  The first batch always stalls — the ring starts empty."""
+        with self._lock:
+            wall = ((self._last_ready - self._first_request)
+                    if self._first_request is not None
+                    and self._last_ready is not None else 0.0)
+            stall = self._stall_seconds
+            n = self._batches
+            return {
+                "depth": self._depth,
+                "queue_depth": self._queue.qsize(),
+                "batches": n,
+                "stalls": self._stalls,
+                "stall_seconds": round(stall, 6),
+                "stall_fraction": round(stall / wall, 6) if wall > 0 else (
+                    1.0 if stall > 0 else 0.0),
+                "avg_stall_ms": round(stall / n * 1e3, 3) if n else 0.0,
+            }

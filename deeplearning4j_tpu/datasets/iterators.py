@@ -116,12 +116,24 @@ class ListDataSetIterator(DataSetIterator):
         return sum(b.num_examples() for b in self._batches)
 
 
+class _ProducerFailure:
+    """Exception carrier for background-producer iterators: a raise on the
+    producer thread is enqueued instead of a batch and re-raised in
+    ``next()``/``has_next()`` on the CONSUMER thread — never swallowed
+    into a silently truncated epoch.  Shared with
+    ``device_prefetch.DevicePrefetchIterator``."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 class AsyncDataSetIterator(DataSetIterator):
     """Background-thread prefetch (reference AsyncDataSetIterator.java:30:
     'used to load batches in the background while training proceeds').
 
     ``prefetch`` matches the reference's queue capacity (default 2×).
-    The producer thread fills a bounded queue; a sentinel marks exhaustion.
+    The producer thread fills a bounded queue; a sentinel marks exhaustion;
+    a producer exception is enqueued and re-raised on the consumer thread.
     """
 
     _SENTINEL = object()
@@ -142,24 +154,27 @@ class AsyncDataSetIterator(DataSetIterator):
         self._stop = stop
         q = self._queue
 
+        def _enqueue(item) -> None:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return
+                except queue.Full:
+                    continue
+
         def producer():
+            err = None
             try:
                 self._base.reset()
                 while self._base.has_next() and not stop.is_set():
-                    item = self._base.next()
-                    while not stop.is_set():
-                        try:
-                            q.put(item, timeout=0.1)
-                            break
-                        except queue.Full:
-                            continue
+                    _enqueue(self._base.next())
+            except BaseException as e:  # noqa: BLE001 — carried to consumer
+                # a raise in base.next() used to hit the finally, enqueue
+                # the sentinel, and truncate the epoch SILENTLY; carry it
+                err = e
             finally:
-                while not stop.is_set():
-                    try:
-                        q.put(self._SENTINEL, timeout=0.1)
-                        break
-                    except queue.Full:
-                        continue
+                _enqueue(self._SENTINEL if err is None
+                         else _ProducerFailure(err))
 
         self._thread = threading.Thread(target=producer, daemon=True)
         self._thread.start()
@@ -184,10 +199,16 @@ class AsyncDataSetIterator(DataSetIterator):
         return self._peeked
 
     def has_next(self) -> bool:
-        return self._peek() is not self._SENTINEL
+        item = self._peek()
+        if isinstance(item, _ProducerFailure):
+            # stays peeked: every subsequent call re-raises until reset()
+            raise item.exc
+        return item is not self._SENTINEL
 
     def next(self) -> DataSet:
         item = self._peek()
+        if isinstance(item, _ProducerFailure):
+            raise item.exc
         if item is self._SENTINEL:
             raise StopIteration
         self._peeked = None

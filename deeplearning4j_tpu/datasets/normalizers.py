@@ -11,10 +11,12 @@ its training-time statistics).
 TPU inversion: normalizers here are FUNCTIONAL — ``pre_process`` returns
 a new DataSet (the reference mutates INDArrays in place).  Statistics are
 accumulated with a streaming one-pass sum/sum-of-squares in f64, so
-fitting an iterator never materializes the corpus.  Transforms are plain
-numpy on host (they run in the input pipeline, overlapped with device
-compute by AsyncDataSetIterator) — the arrays upload after normalization
-exactly once.
+fitting an iterator never materializes the corpus.  Transforms run as
+plain numpy on host by default (the setPreProcessor path, overlapped
+with device compute by AsyncDataSetIterator) — or as a jitted ON-DEVICE
+op via ``device_transform()`` when attached to a
+``DevicePrefetchIterator`` (docs/INPUT_PIPELINE.md), where the batch
+uploads raw/narrow and normalizes on chip.
 """
 
 from __future__ import annotations
@@ -160,6 +162,36 @@ class AbstractNormalizer:
                        ds.features_mask, ds.labels_mask)
 
     __call__ = pre_process
+
+    def device_transform(self):
+        """Jit-compiled DataSet→DataSet transform for DEVICE-resident
+        batches (the DevicePrefetchIterator hook): the fitted statistics
+        become constants of a jitted on-chip op, so normalization runs on
+        the TPU instead of host numpy — and overlaps with training via the
+        prefetch ring.  Masks pass through; labels transform only when
+        ``fit_labels`` was set.
+
+        Caveats (docs/INPUT_PIPELINE.md): statistics are still FITTED on
+        host (``fit`` scans raw numpy batches in f64) — re-fitting after
+        more data requires building a fresh device transform; and the
+        on-chip math runs in f32 (host numpy upcasts to f64 before the
+        final f32 cast), so outputs can differ from ``pre_process`` by
+        ~1 ulp unless the transform is exact (e.g. power-of-two pixel
+        scales)."""
+        self._check_fitted()
+        import jax
+
+        jx = jax.jit(self.transform)
+        jy = jax.jit(self.transform_labels) if self.fit_labels else None
+
+        def apply(ds: DataSet) -> DataSet:
+            labels = ds.labels
+            if jy is not None and labels is not None:
+                labels = jax.tree_util.tree_map(jy, labels)
+            return DataSet(jx(ds.features), labels,
+                           ds.features_mask, ds.labels_mask)
+
+        return apply
 
     def revert(self, ds: DataSet) -> DataSet:
         self._check_fitted()

@@ -35,6 +35,17 @@ from ..optimize.score import LazyScore, materialize_scores
 Array = jax.Array
 
 
+def _as_device(a):
+    """Device-array passthrough for batch leaves: an already-device-resident
+    array (DevicePrefetchIterator output, a pre-sharded mesh batch, a
+    reused benchmark batch) enters the step untouched — no fresh host
+    staging, no re-placement, and in particular never a device→host→device
+    round trip.  Host arrays take the ordinary ``jnp.asarray`` upload."""
+    if a is None or isinstance(a, jax.Array):
+        return a
+    return jnp.asarray(a)
+
+
 class DivergenceError(RuntimeError):
     """The opt-in divergence guard exhausted its bad-step budget: too many
     consecutive steps produced non-finite gradients/loss, so skipping
@@ -494,10 +505,10 @@ class MultiLayerNetwork:
         if self._jit_step_guarded is None:
             self._jit_step_guarded = self._make_step_guarded()
         self._rng, sub = jax.random.split(self._rng)
-        x = jnp.asarray(ds.features)
-        y = None if ds.labels is None else jax.tree_util.tree_map(jnp.asarray, ds.labels)
-        m = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
-        lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        x = _as_device(ds.features)
+        y = None if ds.labels is None else jax.tree_util.tree_map(_as_device, ds.labels)
+        m = _as_device(ds.features_mask)
+        lm = _as_device(ds.labels_mask)
         self.params, self.state, self.opt_state, loss, ok = self._jit_step_guarded(
             self.params, self.state, self.opt_state,
             self._iter_scalar(1), x, y, sub, m, lm)
@@ -692,11 +703,13 @@ class MultiLayerNetwork:
         if self._jit_step is None:
             self._jit_step = self._make_step()
         self._rng, sub = jax.random.split(self._rng)
-        x = jnp.asarray(ds.features)
+        # device-resident batches (DevicePrefetchIterator / pre-sharded
+        # mesh input) pass through _as_device untouched
+        x = _as_device(ds.features)
         # labels may be a pytree (e.g. Yolo2OutputLayer's dict targets)
-        y = None if ds.labels is None else jax.tree_util.tree_map(jnp.asarray, ds.labels)
-        m = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
-        lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        y = None if ds.labels is None else jax.tree_util.tree_map(_as_device, ds.labels)
+        m = _as_device(ds.features_mask)
+        lm = _as_device(ds.labels_mask)
         self.params, self.state, self.opt_state, loss = self._jit_step(
             self.params, self.state, self.opt_state,
             self._iter_scalar(1), x, y, sub, m, lm)
@@ -767,7 +780,7 @@ class MultiLayerNetwork:
                                      "all batches or none")
                 return None
             return jax.tree_util.tree_map(
-                lambda *leaves: jnp.stack([jnp.asarray(a) for a in leaves]),
+                lambda *leaves: jnp.stack([_as_device(a) for a in leaves]),
                 *vals)
 
         self._rng, sub = jax.random.split(self._rng)
@@ -937,7 +950,10 @@ class MultiLayerNetwork:
     def fit(self, data, epochs: int = 1) -> List[float]:
         """Train over a DataSetIterator / DataSet / (x, y) for N epochs
         (reference fit(DataSetIterator):1165; async prefetch is the
-        iterator's job — wrap with AsyncDataSetIterator for parity)."""
+        iterator's job — wrap with AsyncDataSetIterator for host-side
+        parity, or DevicePrefetchIterator to keep batches already
+        transferred/normalized on device: fit_batch accepts its
+        device-resident pytrees without re-staging them)."""
         it = self._as_iterator(data)
         losses: List[float] = []
         synced = 0

@@ -211,17 +211,27 @@ class ShardedTrainer:
 
     # -- batch placement ---------------------------------------------------
 
+    @staticmethod
+    def _to_host_array(a):
+        """Zero-copy host view: a numpy array passes through IDENTICALLY
+        (``np.asarray`` on an ndarray subclass or list would materialize a
+        fresh buffer — a redundant host copy of the whole batch, paid
+        every step before the real H2D transfer)."""
+        return a if type(a) is np.ndarray else np.asarray(a)
+
     def _shard_batch_arr(self, a):
         if a is None:
             return None
         if isinstance(a, jax.Array):
             # already on device: re-place only if the sharding differs —
             # never round-trip through host (a 224² imagenet batch is ~77MB;
-            # re-uploading it every step would dominate the step time)
+            # re-uploading it every step would dominate the step time).
+            # DevicePrefetchIterator batches placed with this trainer's
+            # ``batch_sharding`` hit the pass-through.
             if a.sharding.is_equivalent_to(self.batch_sharding, a.ndim):
                 return a
             return jax.device_put(a, self.batch_sharding)
-        arr = np.asarray(a)
+        arr = self._to_host_array(a)
         dp = self.mesh.shape.get(self.data_axis, 1) \
             * self.mesh.shape.get(self.dcn_axis, 1)
         if arr.shape[0] % dp != 0:

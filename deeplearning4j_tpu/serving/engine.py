@@ -66,9 +66,14 @@ class _ModelVersion:
                                         rng=None, mask=None)[0]
                 return y
             self.fwd = jax.jit(fwd)
+            # replica loads ride the shared async-put helper: params
+            # already resident on the target device pass through instead
+            # of re-staging through host (same seam the input pipeline's
+            # DevicePrefetchIterator uses for batches)
+            from ..datasets.device_prefetch import device_put_batch
             for d in devices:
-                self.params.append(jax.device_put(model.params, d))
-                self.state.append(jax.device_put(model.state, d))
+                self.params.append(device_put_batch(model.params, d))
+                self.state.append(device_put_batch(model.state, d))
 
     def cache_size(self) -> Optional[int]:
         if self.fwd is None:

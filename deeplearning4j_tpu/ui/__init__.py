@@ -16,10 +16,11 @@ from .storage import FileStatsStorage, InMemoryStatsStorage, SqliteStatsStorage
 from .render import render_dashboard, render_embedding_html
 from .remote import RemoteStatsRouter
 from .server import UIServer
-from .profiler import profile_trace
+from .profiler import input_pipeline_snapshot, profile_trace
 
 __all__ = [
     "StatsListener",
     "InMemoryStatsStorage", "FileStatsStorage", "SqliteStatsStorage",
     "render_dashboard", "render_embedding_html", "RemoteStatsRouter", "UIServer", "profile_trace",
+    "input_pipeline_snapshot",
 ]

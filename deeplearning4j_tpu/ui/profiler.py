@@ -8,7 +8,22 @@ op-level MXU utilization) around any training region."""
 from __future__ import annotations
 
 import contextlib
-from typing import Iterator
+from typing import Iterator, List
+
+
+def input_pipeline_snapshot() -> List[dict]:
+    """Stall-fraction / queue-depth snapshots of every live
+    DevicePrefetchIterator (datasets/device_prefetch.py) — the
+    input-pipeline counterpart of the XLA trace: stall_fraction ~0 means
+    input feeding is fully hidden under device compute, → 1 means the
+    step is infeed-bound (docs/INPUT_PIPELINE.md has the interpretation
+    table).  Collected by StatsListener each iteration; empty list when
+    no prefetcher is active."""
+    try:
+        from ..datasets.device_prefetch import live_pipelines
+    except Exception:   # pragma: no cover — partial install
+        return []
+    return [p.stall_stats() for p in live_pipelines()]
 
 
 @contextlib.contextmanager

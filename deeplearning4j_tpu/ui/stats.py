@@ -50,13 +50,15 @@ class StatsListener(TrainingListener):
 
     def __init__(self, storage, session_id: Optional[str] = None,
                  update_frequency: int = 1, collect_histograms: bool = True,
-                 histogram_bins: int = 20, collect_memory: bool = True):
+                 histogram_bins: int = 20, collect_memory: bool = True,
+                 collect_input_stats: bool = True):
         self.storage = storage
         self.session_id = session_id or f"session_{int(time.time())}"
         self.update_frequency = max(1, update_frequency)
         self.collect_histograms = collect_histograms
         self.histogram_bins = histogram_bins
         self.collect_memory = collect_memory
+        self.collect_input_stats = collect_input_stats
         self._last_time: Optional[float] = None
         self._last_params: Optional[List[Dict[str, np.ndarray]]] = None
         self._start_time = time.time()
@@ -139,6 +141,14 @@ class StatsListener(TrainingListener):
             mem = self._memory()
             if mem:
                 record["memory"] = mem
+        if self.collect_input_stats:
+            # input-pipeline health rides the same record stream: stall
+            # fraction ~0 = feeding hidden under compute, → 1 = the step
+            # is infeed-bound (docs/INPUT_PIPELINE.md)
+            from .profiler import input_pipeline_snapshot
+            snap = input_pipeline_snapshot()
+            if snap:
+                record["input_pipeline"] = snap
         self.storage.put_update(self.session_id, record)
 
     def epoch_done(self, model, epoch: int) -> None:

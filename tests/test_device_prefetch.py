@@ -1,0 +1,413 @@
+"""Device-resident input pipeline: DevicePrefetchIterator + satellites.
+
+Covers the ISSUE-5 contract: bitwise loss identity vs the synchronous
+path, reset/exhaustion/mid-stream teardown without thread leaks,
+producer-exception propagation (prefetcher AND the AsyncDataSetIterator
+regression), sharded placement (``.sharding`` equals the requested spec),
+depth-1 vs depth-4 behavior, on-device normalization, wire-dtype casting,
+the zero-copy consumer paths, and the stall-accounting surfaces
+(profiler snapshot + StatsListener record)."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.datasets import (
+    AsyncDataSetIterator,
+    DataSet,
+    DevicePrefetchIterator,
+    ImagePreProcessingScaler,
+    ListDataSetIterator,
+    NormalizerStandardize,
+    device_put_batch,
+)
+
+
+def _pipeline_threads():
+    return [t for t in threading.enumerate()
+            if t.name == "DevicePrefetchIterator" and t.is_alive()]
+
+
+def _batches(n=6, batch=16, features=8, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n * batch, features)).astype(np.float32)
+    y = np.eye(classes, dtype=np.float32)[rng.integers(0, classes, n * batch)]
+    return DataSet(x, y).batch_by(batch)
+
+
+def _mlp(seed=7, features=8, classes=3):
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import (
+        MultiLayerNetwork, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.updaters import Sgd
+
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(lr=0.1))
+            .layer(Dense(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=classes, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(features)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+class _Boom(ListDataSetIterator):
+    """Raises mid-epoch on the producer thread."""
+
+    def __init__(self, batches, fail_at=2):
+        super().__init__(batches)
+        self._fail_at = fail_at
+
+    def next(self):
+        if self._pos >= self._fail_at:
+            raise RuntimeError("boom in base.next()")
+        return super().next()
+
+
+class TestPrefetchCore:
+    @pytest.mark.parametrize("depth", [1, 4])
+    def test_bitwise_loss_identity_vs_sync(self, depth):
+        """The pipeline moves work, never math: multi-epoch fit through
+        the prefetcher reproduces the synchronous loss sequence bit for
+        bit on a fixed seed — at minimum depth (pure double-buffer
+        degenerate: one in flight) and ahead-of-consumer depth alike."""
+        batches = _batches()
+        sync = [float(s) for s in
+                _mlp().fit(ListDataSetIterator(batches), epochs=3)]
+        it = DevicePrefetchIterator(ListDataSetIterator(batches), depth=depth)
+        pre = [float(s) for s in _mlp().fit(it, epochs=3)]
+        it.close()
+        assert pre == sync
+
+    def test_batches_are_device_resident(self):
+        it = DevicePrefetchIterator(ListDataSetIterator(_batches()))
+        ds = it.next()
+        assert isinstance(ds.features, jax.Array)
+        assert isinstance(ds.labels, jax.Array)
+        np.testing.assert_array_equal(np.asarray(ds.features),
+                                      _batches()[0].features)
+        it.close()
+
+    def test_depth_bounds_ring(self):
+        """depth-1 holds at most one ready batch; depth-4 runs ahead."""
+        batches = _batches(n=6)
+        it1 = DevicePrefetchIterator(ListDataSetIterator(batches), depth=1)
+        it4 = DevicePrefetchIterator(ListDataSetIterator(batches), depth=4)
+        deadline = time.time() + 5.0
+        while it4._queue.qsize() < 4 and time.time() < deadline:
+            time.sleep(0.01)
+        assert it1._queue.maxsize == 1
+        assert it4._queue.qsize() == 4   # producer ran 4 ahead
+        assert it1._queue.qsize() <= 1
+        assert [d.features.shape for d in it1] == \
+               [d.features.shape for d in it4]
+        it1.close()
+        it4.close()
+
+    def test_exhaustion_stops_producer_and_reset_restarts(self):
+        batches = _batches(n=3)
+        it = DevicePrefetchIterator(ListDataSetIterator(batches), depth=2)
+        first = [np.asarray(it.next().features) for _ in range(3)]
+        assert not it.has_next()
+        it._thread.join(timeout=5.0)
+        assert not it._thread.is_alive()   # no leaked producer
+        it.reset()
+        again = [np.asarray(d.features) for d in it]
+        for a, b in zip(first, again):
+            np.testing.assert_array_equal(a, b)
+        it.close()
+
+    def test_midstream_teardown_no_thread_leak(self):
+        before = len(_pipeline_threads())
+        it = DevicePrefetchIterator(ListDataSetIterator(_batches(n=50)),
+                                    depth=2)
+        it.next()   # mid-stream, producer blocked on a full ring
+        it.close()
+        assert len(_pipeline_threads()) == before
+        assert not it.has_next()   # closed reports exhausted, no hang
+        it.reset()                 # and reset revives it
+        assert it.has_next()
+        it.close()
+
+    def test_producer_exception_reraised_on_consumer(self):
+        it = DevicePrefetchIterator(_Boom(_batches(), fail_at=2), depth=2)
+        assert np.asarray(it.next().features).shape == (16, 8)
+        it.next()
+        with pytest.raises(RuntimeError, match="boom in base.next"):
+            it.next()
+        # stays raising (not a silent truncation), until reset
+        with pytest.raises(RuntimeError, match="boom in base.next"):
+            it.has_next()
+        it.close()
+
+    def test_rejects_bad_args(self):
+        base = ListDataSetIterator(_batches())
+        with pytest.raises(ValueError, match="depth"):
+            DevicePrefetchIterator(base, depth=0)
+        mesh = jax.sharding.Mesh(np.asarray(jax.devices()), ("data",))
+        with pytest.raises(ValueError, match="sharding OR device"):
+            DevicePrefetchIterator(base, sharding=NamedSharding(mesh, P()),
+                                   device=jax.devices()[0])
+
+
+class TestAsyncExceptionRegression:
+    def test_producer_raise_is_not_swallowed(self):
+        """Regression: a raise in base.next() used to enqueue the sentinel
+        and silently truncate the epoch; it must re-raise on the consumer
+        thread in next()/has_next()."""
+        it = AsyncDataSetIterator(_Boom(_batches(), fail_at=2), prefetch=2)
+        seen = 0
+        with pytest.raises(RuntimeError, match="boom in base.next"):
+            while it.has_next():
+                it.next()
+                seen += 1
+        assert seen == 2
+        with pytest.raises(RuntimeError, match="boom in base.next"):
+            it.has_next()   # sticky until reset, never a silent stop
+
+    def test_reset_clears_failure(self):
+        base = _Boom(_batches(n=3), fail_at=2)
+        it = AsyncDataSetIterator(base, prefetch=2)
+        with pytest.raises(RuntimeError):
+            while it.has_next():
+                it.next()
+        base._fail_at = 99
+        it.reset()
+        assert len(list(it)) == 3
+
+    def test_clean_epoch_still_clean(self):
+        it = AsyncDataSetIterator(ListDataSetIterator(_batches(n=4)))
+        assert len(list(it)) == 4
+
+
+class TestShardedPlacement:
+    def test_batch_lands_presharded(self):
+        from deeplearning4j_tpu.parallel import build_mesh
+
+        mesh = build_mesh({"data": len(jax.devices())})
+        spec = NamedSharding(mesh, P("data"))
+        it = DevicePrefetchIterator(ListDataSetIterator(_batches(batch=16)),
+                                    depth=2, sharding=spec)
+        ds = it.next()
+        for leaf in (ds.features, ds.labels):
+            assert isinstance(leaf, jax.Array)
+            assert leaf.sharding.is_equivalent_to(spec, leaf.ndim)
+        it.close()
+
+    def test_sharded_trainer_passthrough_and_parity(self):
+        """ShardedTrainer fed pre-sharded prefetch batches: the per-step
+        placement passes them through (identity) and losses match the
+        host-fed sharded run bit for bit."""
+        from deeplearning4j_tpu.parallel import ShardedTrainer, build_mesh
+
+        batches = _batches(n=4, batch=16)
+        mesh = build_mesh({"data": len(jax.devices())})
+        ref = ShardedTrainer(_mlp(), mesh)
+        ref_losses = [float(ref.fit_batch(ds)) for ds in batches]
+
+        trainer = ShardedTrainer(_mlp(), mesh)
+        it = DevicePrefetchIterator(ListDataSetIterator(batches), depth=2,
+                                    sharding=trainer.batch_sharding)
+        pre_losses = []
+        while it.has_next():
+            ds = it.next()
+            placed = trainer.shard_dataset(ds)
+            assert placed.features is ds.features   # no re-placement
+            pre_losses.append(float(trainer.fit_batch(ds)))
+        it.close()
+        assert pre_losses == ref_losses
+
+    def test_shard_batch_arr_zero_copy_host(self):
+        """Satellite: a numpy batch reaches placement with NO redundant
+        host copy (np.asarray materializing a fresh buffer)."""
+        from deeplearning4j_tpu.parallel import ShardedTrainer, build_mesh
+
+        trainer = ShardedTrainer(_mlp(), build_mesh({"data": 1},
+                                                    devices=jax.devices()[:1]))
+        a = np.ones((8, 8), np.float32)
+        assert trainer._to_host_array(a) is a
+        # non-ndarray inputs still materialize
+        assert isinstance(trainer._to_host_array([[1.0, 2.0]]), np.ndarray)
+
+    def test_device_put_batch_passthrough(self):
+        dev = jax.devices()[0]
+        placed = device_put_batch({"x": np.ones(4, np.float32)}, dev)
+        again = device_put_batch(placed, dev)
+        assert again["x"] is placed["x"]
+        default = device_put_batch(placed["x"])
+        assert default is placed["x"]
+
+
+class TestOnDeviceTransform:
+    def test_scaler_runs_on_device_bitwise_exact(self):
+        """Power-of-two pixel scale: the jitted on-chip op reproduces the
+        host numpy path bit for bit (the A/B's parity construction)."""
+        rng = np.random.default_rng(0)
+        u8 = rng.integers(0, 256, (4 * 8, 6, 6, 3)).astype(np.uint8)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+        scaler = ImagePreProcessingScaler(max_pixel=256.0)
+        it = DevicePrefetchIterator(
+            ListDataSetIterator(DataSet(u8, y).batch_by(8)),
+            transform=ImagePreProcessingScaler(max_pixel=256.0))
+        got = np.concatenate([np.asarray(d.features) for d in it])
+        it.close()
+        np.testing.assert_array_equal(got, scaler.transform(u8))
+
+    def test_standardize_device_transform_close(self):
+        """Fitted statistics compile into the on-chip op; f32 on-chip math
+        tracks the host f64-temp path to float tolerance (the documented
+        ~ulp caveat, docs/INPUT_PIPELINE.md)."""
+        batches = _batches(n=4)
+        norm = NormalizerStandardize()
+        norm.fit(ListDataSetIterator(batches))
+        it = DevicePrefetchIterator(ListDataSetIterator(batches),
+                                    transform=norm)
+        host = np.concatenate([norm.transform(b.features) for b in batches])
+        got = np.concatenate([np.asarray(d.features) for d in it])
+        it.close()
+        np.testing.assert_allclose(got, host, rtol=1e-6, atol=1e-6)
+
+    def test_wrapping_moves_attached_normalizer_on_device(self):
+        """transform= the base's own pre_processor: it is detached from
+        the base (no double normalization) and applied on-chip."""
+        batches = _batches(n=3)
+        norm = NormalizerStandardize().fit(ListDataSetIterator(batches))
+        base = ListDataSetIterator(batches).set_pre_processor(norm)
+        it = DevicePrefetchIterator(base, transform=norm)
+        assert base.pre_processor is None
+        got = np.asarray(it.next().features)
+        np.testing.assert_allclose(got, norm.transform(batches[0].features),
+                                   rtol=1e-6, atol=1e-6)
+        it.close()
+
+    def test_cast_dtype_bf16_wire(self):
+        """cast_dtype narrows FLOAT features on the wire; labels/masks and
+        integer features are untouched; the net still trains."""
+        import jax.numpy as jnp
+
+        batches = _batches(n=2)
+        it = DevicePrefetchIterator(ListDataSetIterator(batches),
+                                    cast_dtype="bfloat16")
+        ds = it.next()
+        assert ds.features.dtype == jnp.bfloat16
+        assert ds.labels.dtype == jnp.float32
+        loss = float(_mlp().fit_batch(ds))
+        assert np.isfinite(loss)
+        it.close()
+        u8 = DataSet(np.zeros((4, 3), np.uint8), np.eye(2, dtype=np.float32)[[0, 1, 0, 1]])
+        it2 = DevicePrefetchIterator(ListDataSetIterator([u8]),
+                                     cast_dtype="bfloat16")
+        assert it2.next().features.dtype == np.uint8
+        it2.close()
+
+
+class TestStallAccounting:
+    def test_stats_shape_and_profiler_snapshot(self):
+        from deeplearning4j_tpu.ui import input_pipeline_snapshot
+
+        it = DevicePrefetchIterator(ListDataSetIterator(_batches(n=3)))
+        list(it)
+        s = it.stall_stats()
+        assert s["batches"] == 3 and s["depth"] == 2
+        assert 0.0 <= s["stall_fraction"] <= 1.0
+        snaps = input_pipeline_snapshot()
+        assert any(snap["batches"] == 3 for snap in snaps)
+        it.close()
+
+    def test_slow_producer_counts_stalls(self):
+        class Slow(ListDataSetIterator):
+            def next(self):
+                time.sleep(0.02)
+                return super().next()
+
+        it = DevicePrefetchIterator(Slow(_batches(n=4)), depth=1)
+        list(it)
+        s = it.stall_stats()
+        assert s["stalls"] >= 3
+        assert s["stall_fraction"] > 0.3
+        it.close()
+
+    def test_stats_listener_records_input_pipeline(self):
+        from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener
+
+        storage = InMemoryStatsStorage()
+        net = _mlp()
+        net.set_listeners(StatsListener(storage, session_id="pf",
+                                        collect_histograms=False))
+        it = DevicePrefetchIterator(ListDataSetIterator(_batches(n=3)))
+        net.fit(it, epochs=1)
+        it.close()
+        recs = [r for r in storage.get_updates("pf")
+                if "input_pipeline" in r]
+        assert recs
+        assert recs[-1]["input_pipeline"][0]["depth"] == 2
+
+
+class TestFitBatchDevicePassthrough:
+    def test_fit_batch_accepts_device_resident_pytrees(self):
+        """fit_batch / fit_batches take jax Arrays without re-staging —
+        and produce the same losses as host-fed steps."""
+        import jax.numpy as jnp
+
+        batches = _batches(n=4)
+        host = _mlp()
+        host_losses = [float(host.fit_batch(ds)) for ds in batches]
+        dev = _mlp()
+        dev_batches = [DataSet(jnp.asarray(d.features), jnp.asarray(d.labels))
+                       for d in batches]
+        dev_losses = [float(dev.fit_batch(ds)) for ds in dev_batches]
+        assert dev_losses == host_losses
+        fused = _mlp()
+        fused_losses = [float(s) for s in fused.fit_batches(dev_batches)]
+        assert fused_losses == host_losses
+
+
+class TestCliPrefetch:
+    def test_parse_prefetch(self):
+        from deeplearning4j_tpu.cli import _parse_prefetch
+
+        assert _parse_prefetch("2") == (2, None)
+        assert _parse_prefetch("4,cpu:0") == (4, "cpu:0")
+        assert _parse_prefetch("0") == (0, None)
+        with pytest.raises(SystemExit):
+            _parse_prefetch("-1")
+        with pytest.raises(SystemExit):
+            _parse_prefetch("x")
+        with pytest.raises(SystemExit):
+            _parse_prefetch("0,cpu:0")
+
+    def test_train_with_prefetch(self, tmp_path, capsys):
+        from deeplearning4j_tpu.cli import main
+
+        data = tmp_path / "d.npz"
+        rng = np.random.default_rng(0)
+        np.savez(data, x=rng.normal(size=(64, 4)).astype(np.float32),
+                 y=rng.integers(0, 3, 64))
+        cfg = tmp_path / "conf.json"
+        import json
+
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import (
+            NeuralNetConfiguration,
+        )
+
+        conf = (NeuralNetConfiguration.builder().seed(1)
+                .layer(Dense(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        cfg.write_text(json.dumps(conf.to_dict()))
+        rc = main(["train", "--config", str(cfg), "--data", str(data),
+                   "--epochs", "2", "--batch-size", "16",
+                   "--prefetch", "2"])
+        assert rc == 0
+        out = capsys.readouterr().out
+        assert "prefetch: depth 2" in out
+        assert "stall fraction" in out
