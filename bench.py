@@ -35,6 +35,13 @@ stderr, including:
     a real ElasticTrainer loop, hard-gated on zero unrecovered failures,
     corrupt-latest checkpoint fallback, chaos-off bitwise identity, and
     loss parity with the fault-free run (docs/FAULT_TOLERANCE.md)
+  - multihost_chaos_recovered: the PROCESS-scale chaos gate
+    (scripts/chaos_soak.py --multiproc) — PodLauncher forks 2 workers x
+    4 virtual devices sharing one checkpoint store, SIGKILLs one and
+    SIGSTOPs the other mid-run; hard-gated on zero unrecovered workers,
+    both proc-fault recoveries completing training, chaos-off 2-process
+    bit-identity with the single-process baseline, bit-exact trajectory
+    replay after resume, and zero orphan worker processes
   - input_pipeline_overlap: the device-resident input-pipeline A/B gate
     (scripts/input_pipeline_ab.py) — sync host feeding vs
     DevicePrefetchIterator (async H2D ring, uint8 wire, on-device
@@ -1026,6 +1033,66 @@ def bench_chaos_recovery():
             "final_loss": soak["final_loss"]}
 
 
+def bench_multihost_chaos():
+    """Config 14: process-scale chaos recovery (scripts/chaos_soak.py
+    --multiproc; CPU subprocesses — process lifecycle needs no
+    accelerator).  The PodLauncher forks 2 workers x 4 virtual devices
+    (the tests/test_multiprocess.py topology) sharing one checkpoint
+    store; worker 1 is SIGKILLed mid-run (proc_kill) and worker 0
+    SIGSTOPped (proc_hang → heartbeat expiry).  HARD gates (the
+    pod-elasticity contract): zero unrecovered workers, ≥1 proc_kill AND
+    ≥1 proc_hang recovery each ending in training completion, the
+    chaos-off 2-process run BIT-IDENTICAL to the single-process baseline
+    loss sequence, every chaos-arm loss bit-equal to the baseline at its
+    global step (restarted workers replay the exact trajectory from the
+    shared checkpoints — only process 0 writes), and ZERO orphan worker
+    processes surviving the run.  The reported value is the worker
+    restart count — fixed by the deterministic self-injected schedule."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    script = os.path.join(_REPO, "scripts", "chaos_soak.py")
+    cmd = [sys.executable, script, "--multiproc"] + \
+        (["--quick"] if QUICK else [])
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=1800, cwd=_REPO)
+    if p.returncode != 0:
+        raise RuntimeError(f"multiproc chaos_soak failed (rc={p.returncode})"
+                           f": {p.stdout[-500:]} {p.stderr[-1000:]}")
+    soak = json.loads(p.stdout.strip().splitlines()[-1])
+    if soak.get("unrecovered") != 0 or soak.get("deadline_hit"):
+        raise RuntimeError(f"multiproc soak had UNRECOVERED workers: {soak}")
+    if soak.get("proc_kill_recovered", 0) < 1 \
+            or soak.get("proc_hang_recovered", 0) < 1:
+        raise RuntimeError("multiproc soak missed a proc fault recovery "
+                           f"(kill+hang both required): {soak}")
+    if not soak.get("off_bitwise"):
+        raise RuntimeError("chaos-off 2-process run is not bit-identical "
+                           f"to the single-process baseline: {soak}")
+    if not soak.get("chaos_loss_bitwise"):
+        raise RuntimeError("chaos-arm losses diverged from the baseline "
+                           f"trajectory: {soak}")
+    if soak.get("leaked", 1) != 0 or soak.get("off_leaked", 1) != 0:
+        raise RuntimeError(f"orphan worker process survived the soak: {soak}")
+    if not soak.get("writer_guard_ok") or not soak.get("completion_steps_ok"):
+        raise RuntimeError(f"multihost checkpoint/completion gate: {soak}")
+    if not soak.get("soak_ok"):
+        raise RuntimeError(f"multiproc soak gate FAILED: {soak}")
+    return {"metric": "multihost_chaos_recovered",
+            "value": soak["restarts"], "unit": "worker restarts",
+            "platform": soak["platform"],
+            "workers": soak["workers"],
+            "devices_per_worker": soak["devices_per_worker"],
+            "proc_kill_recovered": soak["proc_kill_recovered"],
+            "proc_hang_recovered": soak["proc_hang_recovered"],
+            "membership_epoch": soak["membership_epoch"],
+            "resume_tail_steps": soak["resume_tail_steps"],
+            "off_bitwise": True, "chaos_loss_bitwise": True,
+            "leaked": 0, "wall_seconds": soak["wall_seconds"]}
+
+
 def main() -> None:
     import jax
 
@@ -1045,6 +1112,7 @@ def main() -> None:
                      ("pipeline_schedules", bench_pipeline_schedules),
                      ("grad_compression", bench_grad_compression),
                      ("chaos_recovery", bench_chaos_recovery),
+                     ("multihost_chaos_recovery", bench_multihost_chaos),
                      ("serving_throughput", bench_serving),
                      ("input_pipeline_overlap", bench_input_pipeline)]:
         try:

@@ -14,6 +14,8 @@ configs).  Usage:
         --output preds.npz
     python -m deeplearning4j_tpu serve --model model.zip --max-batch 32 \\
         --slo-ms 50 --replicas -1 --admission shed --port 9000
+    python -m deeplearning4j_tpu launch --nprocs 2 --devices-per-proc 4 \\
+        -- train --zoo lenet --data mnist --elastic-dir ckpts
     python -m deeplearning4j_tpu summary --model model.zip
 
 ``--data`` accepts a built-in name (mnist / cifar10 / iris / emnist /
@@ -263,6 +265,17 @@ def _parse_chaos(spec: str):
 def cmd_train(args) -> int:
     from .datasets import DataSet, ListDataSetIterator
     from .optimize import ScoreIterationListener
+    from .parallel.launcher import Heartbeat, maybe_bootstrap_from_env
+
+    # under `launch`: join the jax.distributed cluster when the launcher
+    # exported a coordinator (bounded timeout — a dead coordinator is a
+    # CoordinatorUnreachableError, not a hang), and beat the shared
+    # membership so the launcher can tell wedged from working
+    if maybe_bootstrap_from_env():
+        from .parallel import distributed
+        print(f"distributed: process {distributed.process_index()}/"
+              f"{distributed.process_count()}")
+    heartbeat = Heartbeat.start_from_env()
 
     net = _build_model(args)
     xs, ys = _load_data(args.data, train=True, num_classes=_num_classes_of(net))
@@ -296,7 +309,13 @@ def cmd_train(args) -> int:
         storage = InMemoryStatsStorage()
         listeners.append(StatsListener(storage, session_id="cli_train"))
     net.set_listeners(*listeners)
-    if args.chaos and not args.elastic_dir:
+    # the launcher injects per-worker chaos via env (cleared on relaunch,
+    # so a scheduled proc_kill fires once per run, not per incarnation)
+    import os as _os
+
+    from .parallel.distributed import ENV_CHAOS
+    chaos_spec = args.chaos or _os.environ.get(ENV_CHAOS) or None
+    if chaos_spec and not args.elastic_dir:
         raise SystemExit("--chaos needs --elastic-dir (faults are injected "
                          "into the ElasticTrainer recovery loop)")
     trainer = None
@@ -367,8 +386,8 @@ def cmd_train(args) -> int:
 
         inner = trainer if trainer is not None else _Plain(net)
         injector = None
-        if args.chaos:
-            sched, seed, hang = _parse_chaos(args.chaos)
+        if chaos_spec:
+            sched, seed, hang = _parse_chaos(chaos_spec)
             injector = inner = ChaosInjector(inner, sched,
                                              hang_seconds=hang, seed=seed)
             print(f"chaos armed: {sched.pending()} fault(s) scheduled")
@@ -378,6 +397,13 @@ def cmd_train(args) -> int:
             step_timeout=args.step_timeout, backoff_base=0.5, jitter_seed=0)
         if injector is not None:
             injector.attach_checkpoints(trainer.ckpt)
+        if heartbeat is not None:
+            heartbeat.set_step_fn(lambda: trainer.global_step)
+        # host (re)join: a relaunched worker resumes from the cluster's
+        # newest checkpoint instead of step 0
+        resumed = trainer.resume()
+        if resumed:
+            print(f"resumed from checkpoint @ step {resumed}")
     losses = (trainer.fit(it, epochs=args.epochs) if trainer
               else net.fit(it, epochs=args.epochs))
     if args.elastic_dir:
@@ -398,8 +424,13 @@ def cmd_train(args) -> int:
         render_dashboard(storage, args.dashboard)
         print(f"dashboard: {args.dashboard}")
     if args.output:
-        net.save(args.output)
-        print(f"saved: {args.output}")
+        from .parallel.distributed import resolve_process_index
+        out_path = args.output.replace("{process}",
+                                       str(resolve_process_index()))
+        net.save(out_path)
+        print(f"saved: {out_path}")
+    if heartbeat is not None:
+        heartbeat.stop()
     return 0
 
 
@@ -474,6 +505,102 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def _parse_chaos_worker(specs):
+    """['1:proc_kill@10', ...] → {worker: chaos spec}, validating both the
+    worker index syntax and the embedded chaos spec (clean CLI errors)."""
+    out = {}
+    for item in specs or []:
+        worker_s, sep, spec = item.partition(":")
+        try:
+            worker = int(worker_s)
+            if worker < 0 or not sep or not spec:
+                raise ValueError
+        except ValueError:
+            raise SystemExit(f"bad --chaos-worker {item!r}: expected "
+                             "WORKER:SPEC, e.g. '1:proc_kill@10'")
+        if worker in out:
+            raise SystemExit(f"bad --chaos-worker {item!r}: duplicate "
+                             f"worker {worker}")
+        _parse_chaos(spec)   # validate eagerly; workers re-parse from env
+        out[worker] = spec
+    return out
+
+
+def cmd_launch(args) -> int:
+    """Pod-scale launcher (docs/FAULT_TOLERANCE.md "Process-scale"): fork
+    N worker processes running the command after ``--`` (or join an
+    existing cluster with --join), monitor heartbeats, and relaunch
+    workers that die or hang — host leave/join with membership epochs.
+    """
+    import os
+
+    rest = list(args.worker_args or [])
+    if rest and rest[0] == "--":
+        rest = rest[1:]
+    if not rest:
+        raise SystemExit("launch needs a worker command after '--', e.g. "
+                         "launch --nprocs 2 -- train --zoo lenet ...")
+    if rest[0] not in ("train", "evaluate", "predict", "serve", "summary"):
+        raise SystemExit(f"launch worker command must be a "
+                         f"deeplearning4j_tpu subcommand, got {rest[0]!r}")
+    if args.join:
+        # join mode: THIS process becomes worker --process-id of an
+        # existing cluster (one `launch --join` per host on a real pod)
+        from .parallel.distributed import (
+            ENV_CONNECT_TIMEOUT, ENV_COORDINATOR, ENV_NUM_PROCESSES,
+            ENV_PROCESS_ID, ENV_RUN_DIR,
+        )
+        if args.process_id is None:
+            raise SystemExit("launch --join needs --process-id")
+        if args.coordinator:
+            os.environ[ENV_COORDINATOR] = args.coordinator
+        os.environ[ENV_PROCESS_ID] = str(args.process_id)
+        os.environ[ENV_NUM_PROCESSES] = str(args.nprocs)
+        os.environ[ENV_CONNECT_TIMEOUT] = str(args.connect_timeout)
+        if args.run_dir:
+            os.environ[ENV_RUN_DIR] = args.run_dir
+        return main(rest)
+    import sys as _sys
+
+    from .parallel.launcher import PodLauncher
+
+    run_dir = args.run_dir
+    if not run_dir:
+        import tempfile
+        run_dir = tempfile.mkdtemp(prefix="dl4j_tpu_launch_")
+    chaos = _parse_chaos_worker(args.chaos_worker)
+    launcher = PodLauncher(
+        [_sys.executable, "-m", "deeplearning4j_tpu"] + rest,
+        num_workers=args.nprocs, run_dir=run_dir,
+        devices_per_worker=args.devices_per_proc,
+        chaos=chaos or None,
+        bootstrap=args.bootstrap,
+        heartbeat_timeout=args.heartbeat_timeout,
+        max_restarts=args.max_restarts,
+        deadline_s=args.deadline,
+        connect_timeout_s=args.connect_timeout,
+        megascale_slices=args.megascale_slices)
+    print(f"launch: {args.nprocs} worker(s) x "
+          f"{args.devices_per_proc or 'default'} device(s), "
+          f"bootstrap={args.bootstrap}, run dir {run_dir}"
+          + (f", chaos {chaos}" if chaos else ""))
+    report = launcher.run()
+    print(f"launch: completed={report['completed']} "
+          f"restarts={report['restarts']} "
+          f"epoch={report['epoch']} leaked={report['leaked_killed']} "
+          f"wall={report['wall_seconds']}s")
+    for e in report["events"]:
+        print(f"  [{e['t']:8.2f}s] {e['kind']}"
+              + (f" worker {e['worker']}" if 'worker' in e else "")
+              + (f" ({e['cause']}, rc={e.get('rc')})"
+                 if e['kind'] in ('leave', 'unrecovered') else ""))
+    if report["unrecovered"]:
+        print(f"launch: UNRECOVERED workers {report['unrecovered']} — "
+              f"logs under {run_dir}/logs")
+        return 1
+    return 0
+
+
 def cmd_summary(args) -> int:
     net = _load_model(args.model)
     from .nn.conf.memory import memory_report
@@ -534,8 +661,62 @@ def build_parser() -> argparse.ArgumentParser:
                    "--elastic-dir): 'kind@step[,kind@step...]"
                    "[,seed=S][,hang=SECONDS]', kinds: device_loss/"
                    "ckpt_write_crash/ckpt_truncate/ckpt_bitflip/hung_step/"
-                   "nan_grads")
+                   "nan_grads/proc_kill/proc_hang (the proc_* kinds take "
+                   "down THIS worker process — only meaningful under "
+                   "`launch`, which restarts it)")
     t.set_defaults(fn=cmd_train)
+
+    ln = sub.add_parser(
+        "launch", help="multi-process pod launcher: fork N workers (or "
+        "join a cluster) with heartbeat membership + host join/leave "
+        "recovery (docs/FAULT_TOLERANCE.md)")
+    ln.add_argument("--nprocs", type=int, default=2,
+                    help="number of worker processes (cluster size)")
+    ln.add_argument("--devices-per-proc", type=int, default=None,
+                    metavar="K", help="per-process device visibility: each "
+                    "worker sees K devices (CPU: K virtual devices via "
+                    "XLA_FLAGS)")
+    ln.add_argument("--bootstrap", choices=("replica", "distributed"),
+                    default="replica",
+                    help="'distributed' = workers form a jax.distributed "
+                    "cluster (global mesh; needs backend support — see "
+                    "probe_multiprocess_support); 'replica' = independent "
+                    "replicas per worker, no cross-process collectives "
+                    "(default; the single-box CPU mode)")
+    ln.add_argument("--run-dir", help="shared run directory for heartbeats/"
+                    "membership/logs (default: a fresh temp dir)")
+    ln.add_argument("--heartbeat-timeout", type=float, default=5.0,
+                    metavar="S", help="a worker whose heartbeat is older "
+                    "than this is declared hung, killed, and relaunched")
+    ln.add_argument("--max-restarts", type=int, default=2,
+                    help="per-worker relaunch budget (host rejoin)")
+    ln.add_argument("--deadline", type=float, default=600.0, metavar="S",
+                    help="overall run deadline; survivors are reaped "
+                    "(no orphan worker outlives the launcher)")
+    ln.add_argument("--connect-timeout", type=float, default=60.0,
+                    metavar="S", help="coordinator bootstrap budget; a dead "
+                    "coordinator raises CoordinatorUnreachableError instead "
+                    "of hanging")
+    ln.add_argument("--megascale-slices", type=int, default=None,
+                    metavar="N", help="export MEGASCALE_NUM_SLICES=N to "
+                    "workers (feeds detect_num_slices → "
+                    "ShardedTrainer.two_tier / build_two_tier_mesh); "
+                    "distributed bootstrap defaults it to --nprocs")
+    ln.add_argument("--chaos-worker", action="append", metavar="I:SPEC",
+                    help="arm worker I with a --chaos spec (repeatable), "
+                    "e.g. '1:proc_kill@10' — injected only into the FIRST "
+                    "incarnation, so the relaunched worker survives")
+    ln.add_argument("--join", action="store_true",
+                    help="join an existing cluster as one worker instead "
+                    "of forking (one `launch --join` per host on a pod)")
+    ln.add_argument("--process-id", type=int, default=None,
+                    help="this host's index (with --join)")
+    ln.add_argument("--coordinator", metavar="HOST:PORT",
+                    help="coordinator address (with --join)")
+    ln.add_argument("worker_args", nargs=argparse.REMAINDER,
+                    help="-- followed by the worker subcommand, e.g. "
+                    "-- train --zoo lenet --data mnist --elastic-dir ckpts")
+    ln.set_defaults(fn=cmd_launch)
 
     e = sub.add_parser("evaluate", help="evaluate a saved model")
     e.add_argument("--model", required=True)

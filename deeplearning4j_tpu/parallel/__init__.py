@@ -25,6 +25,12 @@ mechanism: a jax.sharding.Mesh + GSPMD-partitioned jit programs.
     (checkpoint-restore recovery with backoff+jitter, step watchdog,
     divergence guard) — chaos-tested by deterministic fault injection
     (chaos.py, scripts/chaos_soak.py, docs/FAULT_TOLERANCE.md)
+  reference Spark TrainingMaster cluster entry point
+    (VoidConfiguration controller address + shard index) → the pod-scale
+    elastic runtime (launcher.py + CLI ``launch``): a multi-process
+    launcher with heartbeat membership epochs, host join/leave recovery
+    (relaunch + ElasticTrainer.resume from the shared checkpoint store),
+    and process-kill chaos (FaultKind.PROC_KILL/PROC_HANG)
   TP / PP / SP — absent in the reference — are first-class here.
 
 Inference serving moved to the ``serving/`` subsystem (deadline-aware
@@ -35,7 +41,7 @@ back-compat shim over one ``serving.Engine``.
 
 from .mesh import (
     build_mesh, build_two_tier_mesh, replicated, shard_batch,
-    infer_param_shardings,
+    infer_param_shardings, surviving_mesh,
 )
 from .trainer import ShardedTrainer
 from .inference import ParallelInference
@@ -47,13 +53,20 @@ from .pipeline import (
 )
 from .transformer import ShardedTransformerLM
 from .elastic import (
-    CheckpointManager, ElasticTrainer, FailureDetector, StepHangError,
+    CheckpointManager, ElasticTrainer, FailureDetector,
+    RecoverableInfraError, StepHangError,
 )
 from .chaos import (
     ChaosInjector, FaultKind, FaultSchedule, bitflip_file, truncate_file,
 )
 from .moe import MoE, init_moe_params, moe_forward_dense, moe_forward_ep
 from .distributed import (
-    detect_num_slices, initialize, is_coordinator, local_batch_slice,
-    process_count, process_index,
+    CoordinatorUnreachableError, detect_num_slices, initialize,
+    is_coordinator, local_batch_slice, probe_multiprocess_support,
+    process_count, process_index, resolve_process_index,
+    validate_coordinator_address,
+)
+from .launcher import (
+    Heartbeat, HostLostError, Membership, MembershipChangedError,
+    PodLauncher, ProcessFailureDetector, maybe_bootstrap_from_env,
 )

@@ -60,9 +60,22 @@ class FaultKind:
     HUNG_STEP = "hung_step"
     #: the step's batch is poisoned with NaN features → NaN gradients
     NAN_GRADS = "nan_grads"
+    #: SIGKILL this worker PROCESS at the scheduled step (host loss /
+    #: preemption) — recovery is the LAUNCHER's job: it observes the
+    #: death, bumps the membership epoch, and relaunches the worker,
+    #: which resumes from the newest checkpoint (ElasticTrainer.resume)
+    PROC_KILL = "proc_kill"
+    #: SIGSTOP this worker — the process stays alive but its heartbeats
+    #: stop, exercising the heartbeat-expiry path: the launcher must
+    #: declare it dead, SIGKILL it, and relaunch
+    PROC_HANG = "proc_hang"
 
     ALL = (DEVICE_LOSS, CKPT_WRITE_CRASH, CKPT_TRUNCATE, CKPT_BITFLIP,
-           HUNG_STEP, NAN_GRADS)
+           HUNG_STEP, NAN_GRADS, PROC_KILL, PROC_HANG)
+
+    #: kinds that take down the whole PROCESS — only meaningful under a
+    #: multi-process launcher (in-process soaks must not schedule them)
+    PROCESS_KINDS = (PROC_KILL, PROC_HANG)
 
 
 def truncate_file(path: str, keep_fraction: float = 0.5) -> None:
@@ -251,7 +264,35 @@ class ChaosInjector:
             elif kind == FaultKind.NAN_GRADS:
                 self._log(self.step, kind, "poisoning batch features")
                 ds = _poison_dataset(ds)
+            elif kind in FaultKind.PROCESS_KINDS:
+                self._kill_self(kind)
         return self.trainer.fit_batch(ds)
+
+    def _kill_self(self, kind: str) -> None:
+        """Take down THIS worker process — SIGKILL (proc_kill) or SIGSTOP
+        (proc_hang).  Self-injection makes the death exactly
+        step-deterministic (no launcher-side polling race): the schedule
+        says step k, the process is gone before step k runs.  The signal
+        fires before any file I/O of the step, so checkpoints on disk stay
+        atomic-rename-clean."""
+        import signal
+        sig = (signal.SIGKILL if kind == FaultKind.PROC_KILL
+               else getattr(signal, "SIGSTOP", None))
+        if sig is None:
+            raise RuntimeError(f"{kind} unsupported on this platform "
+                               "(no SIGSTOP)")
+        self._log(self.step, kind,
+                  f"{'SIGKILL' if kind == FaultKind.PROC_KILL else 'SIGSTOP'}"
+                  f" pid {os.getpid()}")
+        # flush logging before the process vanishes mid-statement
+        for h in logging.getLogger().handlers + logger.handlers:
+            try:
+                h.flush()
+            except Exception:
+                pass
+        os.kill(os.getpid(), sig)
+        # SIGSTOP parks the process here until the launcher SIGKILLs (or
+        # SIGCONTs) it; SIGKILL never returns
 
 
 def _poison_dataset(ds):

@@ -18,32 +18,214 @@ reference's SharedTraining data-locality model without the Aeron plumbing.
 from __future__ import annotations
 
 import logging
-from typing import Optional
+import os
+import socket
+import time
+from typing import Optional, Tuple
 
 import jax
 
 logger = logging.getLogger("deeplearning4j_tpu")
 
+#: env contract between the ``launch`` subcommand and its workers — the
+#: launcher sets these; ``resolve_process_index`` / ``CheckpointManager``
+#: / ``Heartbeat.start_from_env`` read them without needing jax.distributed
+ENV_PROCESS_ID = "DL4J_TPU_PROCESS_ID"
+ENV_NUM_PROCESSES = "DL4J_TPU_NUM_PROCESSES"
+ENV_RUN_DIR = "DL4J_TPU_RUN_DIR"
+ENV_COORDINATOR = "DL4J_TPU_COORDINATOR"
+ENV_CHAOS = "DL4J_TPU_CHAOS"
+ENV_INCARNATION = "DL4J_TPU_INCARNATION"
+ENV_CONNECT_TIMEOUT = "DL4J_TPU_CONNECT_TIMEOUT"
+
+
+class CoordinatorUnreachableError(ConnectionError):
+    """``initialize()`` could not reach the coordinator within its bounded
+    connect budget — the address is wrong, the coordinator process died,
+    or the network path is down.  Raised INSTEAD of the indefinite hang
+    jax's barrier would otherwise sit in, so launchers/restart loops can
+    back off and retry (or re-elect) deterministically."""
+
+
+def validate_coordinator_address(address: str) -> Tuple[str, int]:
+    """'host:port' → (host, port), with every malformed shape rejected up
+    front as ValueError (the failure would otherwise surface minutes later
+    as an opaque RPC timeout inside the barrier)."""
+    if not isinstance(address, str) or ":" not in address:
+        raise ValueError(f"coordinator_address must be 'host:port', got "
+                         f"{address!r}")
+    host, _, port_s = address.rpartition(":")
+    host = host.strip("[]")  # [v6::addr]:port
+    if not host:
+        raise ValueError(f"coordinator_address {address!r} has no host")
+    try:
+        port = int(port_s)
+    except ValueError:
+        raise ValueError(f"coordinator_address {address!r} has a non-integer "
+                         f"port {port_s!r}")
+    if not (0 < port < 65536):
+        raise ValueError(f"coordinator_address {address!r} port out of range "
+                         f"(1-65535)")
+    return host, port
+
+
+def _probe_coordinator(host: str, port: int, timeout_s: float) -> None:
+    """Bounded TCP connect-with-retry to the coordinator before handing
+    control to jax's barrier.  jax.distributed's own connect loop blocks
+    with a very coarse deadline (and some jaxlib builds hang outright on a
+    dead coordinator); a plain socket probe gives a crisp, configurable
+    failure in seconds."""
+    deadline = time.monotonic() + timeout_s
+    delay = 0.1
+    last_err: Optional[Exception] = None
+    while time.monotonic() < deadline:
+        try:
+            with socket.create_connection(
+                    (host, port),
+                    timeout=max(0.1, min(2.0, deadline - time.monotonic()))):
+                return
+        except OSError as exc:
+            last_err = exc
+            time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
+            delay = min(1.0, delay * 2)
+    raise CoordinatorUnreachableError(
+        f"coordinator {host}:{port} unreachable after {timeout_s:.1f}s "
+        f"of connect retries (last error: {last_err})")
+
 
 def initialize(coordinator_address: Optional[str] = None,
                num_processes: Optional[int] = None,
-               process_id: Optional[int] = None) -> None:
+               process_id: Optional[int] = None,
+               timeout_s: Optional[float] = None,
+               local_device_ids=None) -> None:
     """Join (or form) the multi-host runtime.
 
     On Cloud TPU pods all arguments auto-detect (metadata server); on other
     clusters pass ``coordinator_address='host:port'``, ``num_processes``
     and this host's ``process_id`` — the direct analog of the reference's
-    VoidConfiguration controller address + shard index."""
+    VoidConfiguration controller address + shard index.
+
+    ``timeout_s`` bounds the coordinator bootstrap: non-coordinator
+    processes first TCP-probe the address with retries, and the barrier
+    itself runs under jax's ``initialization_timeout`` — a dead or wrong
+    coordinator raises :class:`CoordinatorUnreachableError` within the
+    budget instead of hanging the worker forever (default 60s)."""
     if num_processes is not None and process_id is not None:
         if not (0 <= process_id < num_processes):
             raise ValueError(f"process_id {process_id} out of range "
                              f"[0, {num_processes})")
-    jax.distributed.initialize(coordinator_address=coordinator_address,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    host = port = None
+    if coordinator_address is not None:
+        host, port = validate_coordinator_address(coordinator_address)
+    timeout_s = 60.0 if timeout_s is None else float(timeout_s)
+    if timeout_s <= 0:
+        raise ValueError(f"timeout_s must be > 0, got {timeout_s}")
+    if host is not None and process_id not in (None, 0):
+        # process 0 HOSTS the coordinator service — only joiners probe
+        _probe_coordinator(host, port, timeout_s)
+    try:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+            local_device_ids=local_device_ids,
+            initialization_timeout=max(1, int(timeout_s)))
+    except CoordinatorUnreachableError:
+        raise
+    except Exception as exc:
+        text = f"{type(exc).__name__}: {exc}"
+        if any(m in text for m in ("DEADLINE_EXCEEDED", "UNAVAILABLE",
+                                   "Connection", "connect", "timed out",
+                                   "Barrier timed out")):
+            raise CoordinatorUnreachableError(
+                f"coordinator bootstrap at {coordinator_address} failed "
+                f"within {timeout_s:.1f}s: {text}") from exc
+        raise
     logger.info("distributed initialized: process %d/%d, %d local / %d "
                 "global devices", jax.process_index(), jax.process_count(),
                 jax.local_device_count(), jax.device_count())
+
+
+def resolve_process_index(explicit: Optional[int] = None) -> int:
+    """This host's process index WITHOUT requiring jax.distributed: an
+    explicit value wins, then the launcher's ``DL4J_TPU_PROCESS_ID`` env
+    (set for every forked worker), then ``jax.process_index()`` (1-process
+    default 0).  Lets host-role decisions (who writes checkpoints, who
+    serves the UI) work identically under the launcher's replica mode,
+    real jax.distributed pods, and plain single-process runs."""
+    if explicit is not None:
+        return int(explicit)
+    env = os.environ.get(ENV_PROCESS_ID)
+    if env is not None:
+        try:
+            return int(env)
+        except ValueError:
+            raise ValueError(f"{ENV_PROCESS_ID}={env!r} is not an integer")
+    try:
+        return jax.process_index()
+    except Exception:   # backend not initializable here — single process
+        return 0
+
+
+_MP_SUPPORT: Optional[Tuple[bool, str]] = None
+
+
+def probe_multiprocess_support(timeout_s: float = 120.0) -> Tuple[bool, str]:
+    """(supported, reason): can THIS jaxlib run cross-process collectives?
+
+    Spawns two 1-device subprocesses that form a jax.distributed cluster
+    on localhost and psum across the process boundary.  Some jaxlib CPU
+    clients lack multiprocess execution entirely ("...aren't implemented
+    on the CPU backend") — an environment capability, not a framework
+    property, so tests probe it ONCE (cached) and skip only the cases
+    that genuinely need cross-process collectives; launcher/membership
+    logic runs everywhere."""
+    global _MP_SUPPORT
+    if _MP_SUPPORT is not None:
+        return _MP_SUPPORT
+    import subprocess
+    import sys
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = (
+        "import os, sys\n"
+        "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+        "os.environ.pop('XLA_FLAGS', None)\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        f"jax.distributed.initialize('127.0.0.1:{port}', 2, "
+        "int(sys.argv[1]), initialization_timeout=60)\n"
+        "import jax.numpy as jnp\n"
+        "out = jax.pmap(lambda x: jax.lax.psum(x, 'i'), axis_name='i')(\n"
+        "    jnp.ones((jax.local_device_count(),)))\n"
+        "assert float(out[0]) == jax.device_count(), out\n")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs = [subprocess.Popen([sys.executable, "-c", script, str(i)],
+                              env=env, stdout=subprocess.PIPE,
+                              stderr=subprocess.PIPE) for i in range(2)]
+    ok, reason = True, ""
+    for p in procs:
+        try:
+            _, err = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            ok, reason = False, "multiprocess probe timed out"
+            break
+        if p.returncode != 0:
+            ok = False
+            if b"aren't implemented on the CPU backend" in err:
+                reason = "jaxlib CPU backend lacks multiprocess execution"
+            else:
+                reason = (f"probe worker rc={p.returncode}: "
+                          f"{err.decode(errors='replace')[-400:]}")
+            break
+    _MP_SUPPORT = (ok, reason)
+    logger.info("multiprocess backend probe: supported=%s %s", ok, reason)
+    return _MP_SUPPORT
 
 
 def process_index() -> int:

@@ -20,12 +20,23 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 import time
 from typing import Any, Callable, List, Optional
 
 import jax
 
+from .distributed import resolve_process_index
+
 logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class RecoverableInfraError(RuntimeError):
+    """Base class for failures the elastic stack treats as recoverable
+    *by construction* (host lost, membership change, hung step) — the
+    FailureDetector recognizes the type, not a message marker, so
+    subclasses anywhere in the stack opt into recovery without touching
+    the marker list."""
 
 
 class _HostSnapshot:
@@ -67,22 +78,66 @@ class CheckpointManager:
     100MB of params) with training: the device→host snapshot happens on
     the caller's thread (it must — the next step donates those buffers),
     then a single background writer thread serializes and atomically
-    renames.  The orbax-style pattern, stdlib-only."""
+    renames.  The orbax-style pattern, stdlib-only.
 
-    def __init__(self, directory: str, keep_last: int = 3):
+    Multi-host: every host of a pod job shares one checkpoint directory,
+    and params are replicated across hosts — N hosts writing the same
+    ``checkpoint_X.zip.tmp`` race each other's rename.  ``role`` decides
+    who writes:
+
+    - ``"auto"`` (default): only the host with process index 0 writes
+      (the index resolves from an explicit ``process_id``, the launcher's
+      ``DL4J_TPU_PROCESS_ID`` env, or ``jax.process_index()``); every
+      other host's ``save``/``save_async``/prune are no-ops, while
+      restore/list stay available everywhere — a rejoining host restores
+      the coordinator's checkpoints.
+    - ``"writer"`` / ``"reader"``: force the role regardless of index.
+    - ``"per_host"``: every host writes its OWN shard under a distinct
+      name (``checkpoint_X.h<process>.zip``) and lists only its own —
+      for host-local state that is NOT replicated."""
+
+    _NAME_RE = re.compile(r"^checkpoint_(\d+)(?:\.h(\d+))?\.zip$")
+
+    def __init__(self, directory: str, keep_last: int = 3,
+                 role: str = "auto", process_id: Optional[int] = None):
+        if role not in ("auto", "writer", "reader", "per_host"):
+            raise ValueError(f"role must be auto/writer/reader/per_host, "
+                             f"got {role!r}")
         self.directory = directory
         self.keep_last = keep_last
+        self.role = role
+        self.process_id = resolve_process_index(process_id)
+        self._suffix = f".h{self.process_id}" if role == "per_host" else ""
         self._executor = None
         self._pending = None
         os.makedirs(directory, exist_ok=True)
-        self._clean_stale_tmp()
+        if self.is_writer:
+            self._clean_stale_tmp()
+
+    @property
+    def is_writer(self) -> bool:
+        if self.role == "reader":
+            return False
+        if self.role in ("writer", "per_host"):
+            return True
+        return self.process_id == 0
 
     def _clean_stale_tmp(self) -> None:
         """Remove ``checkpoint_*.zip.tmp`` left by a crash mid-(async-)write.
         The atomic-rename protocol means a .tmp is never the newest valid
-        state — without this they leak forever, one per crash."""
+        state — without this they leak forever, one per crash.  Only this
+        manager's OWN temp names are touched (suffix-matched): a rejoining
+        host must never delete the temp another host is actively writing."""
         for fn in os.listdir(self.directory):
-            if fn.startswith("checkpoint_") and fn.endswith(".zip.tmp"):
+            if not (fn.startswith("checkpoint_") and fn.endswith(".zip.tmp")):
+                continue
+            m = self._NAME_RE.match(fn[:-len(".tmp")])
+            if m is None:
+                continue   # foreign name — not ours to judge
+            host = m.group(2)
+            own = (host is not None and int(host) == self.process_id
+                   if self.role == "per_host" else host is None)
+            if own:
                 try:
                     os.remove(os.path.join(self.directory, fn))
                     logger.info("removed stale checkpoint temp file %s", fn)
@@ -90,9 +145,14 @@ class CheckpointManager:
                     pass
 
     def _path(self, step: int) -> str:
-        return os.path.join(self.directory, f"checkpoint_{step:010d}.zip")
+        return os.path.join(self.directory,
+                            f"checkpoint_{step:010d}{self._suffix}.zip")
 
-    def save(self, net, step: int) -> str:
+    def save(self, net, step: int) -> Optional[str]:
+        if not self.is_writer:
+            logger.debug("checkpoint save @%d skipped on non-writer host %d",
+                         step, self.process_id)
+            return None
         path = self._path(step)
         # temp-file + atomic rename: a crash mid-write must never leave a
         # truncated zip as the latest (restore would load garbage)
@@ -104,10 +164,13 @@ class CheckpointManager:
 
     def save_async(self, net, step: int):
         """Snapshot now, write in the background; returns a Future of the
-        final path.  At most one write is in flight — a second call first
-        waits for the previous write (backpressure beats unbounded host
-        copies of the full model)."""
+        final path (``None`` on non-writer hosts — no snapshot is taken).
+        At most one write is in flight — a second call first waits for the
+        previous write (backpressure beats unbounded host copies of the
+        full model)."""
         from concurrent.futures import ThreadPoolExecutor
+        if not self.is_writer:
+            return None
         if self._executor is None:
             self._executor = ThreadPoolExecutor(
                 max_workers=1, thread_name_prefix="ckpt-writer")
@@ -139,6 +202,8 @@ class CheckpointManager:
             pending.result()
 
     def _prune(self) -> None:
+        if not self.is_writer:
+            return
         ckpts = self.list_checkpoints()
         for path, _ in ckpts[:-self.keep_last]:
             try:
@@ -149,16 +214,22 @@ class CheckpointManager:
     def list_checkpoints(self) -> List:
         out = []
         for fn in sorted(os.listdir(self.directory)):
-            if fn.startswith("checkpoint_") and fn.endswith(".zip"):
-                try:
-                    step = int(fn[len("checkpoint_"):-len(".zip")])
-                except ValueError:
-                    # a foreign/renamed file matching the glob must not
-                    # take down every list/prune/restore in the store
-                    logger.warning("skipping unparsable checkpoint filename "
-                                   "%s", fn)
-                    continue
-                out.append((os.path.join(self.directory, fn), step))
+            if not (fn.startswith("checkpoint_") and fn.endswith(".zip")):
+                continue
+            m = self._NAME_RE.match(fn)
+            if m is None:
+                # a foreign/renamed file matching the glob must not
+                # take down every list/prune/restore in the store
+                logger.warning("skipping unparsable checkpoint filename "
+                               "%s", fn)
+                continue
+            step, host = int(m.group(1)), m.group(2)
+            if self.role == "per_host":
+                if host is None or int(host) != self.process_id:
+                    continue   # another host's shard — not ours to touch
+            elif host is not None:
+                continue       # per-host shard in a shared-writer store
+            out.append((os.path.join(self.directory, fn), step))
         return out
 
     def latest(self) -> Optional[Any]:
@@ -208,7 +279,7 @@ class CheckpointManager:
         return None, -1
 
 
-class StepHangError(RuntimeError):
+class StepHangError(RecoverableInfraError):
     """The step watchdog fired: a dispatch exceeded ``step_timeout`` wall
     clock.  Message carries DEADLINE_EXCEEDED so the default
     FailureDetector classifies it as recoverable."""
@@ -236,6 +307,8 @@ class FailureDetector:
                            "non-finite gradient")
 
     def is_recoverable(self, exc: Exception) -> bool:
+        if isinstance(exc, RecoverableInfraError):
+            return True    # recoverable by construction (hang, host lost)
         if isinstance(exc, (ValueError, TypeError, KeyError)):
             return False   # programming errors propagate
         text = f"{type(exc).__name__}: {exc}"
@@ -283,11 +356,14 @@ class ElasticTrainer:
                  jitter_seed: Optional[int] = None,
                  step_timeout: Optional[float] = None,
                  sleep_fn: Callable[[float], None] = time.sleep,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Callable[[], float] = time.monotonic,
+                 membership_check: Optional[Callable[[], None]] = None,
+                 checkpoint_role: str = "auto"):
         import random
 
         self.trainer = trainer
-        self.ckpt = CheckpointManager(checkpoint_dir, keep_last)
+        self.ckpt = CheckpointManager(checkpoint_dir, keep_last,
+                                      role=checkpoint_role)
         self.checkpoint_every = max(1, checkpoint_every)
         self.max_restarts = max_restarts
         self.detector = failure_detector or FailureDetector()
@@ -302,6 +378,13 @@ class ElasticTrainer:
         self.step_timeout = step_timeout
         self.sleep_fn = sleep_fn
         self.clock = clock
+        # pod-scale membership: a callable polled before every step that
+        # raises a RecoverableInfraError (e.g. launcher.HostLostError) on
+        # host join/leave — the failure flows through the SAME backoff →
+        # rebuild_fn → restore machinery as a device loss, so slice-
+        # granular recovery (smaller dcn mesh over the survivors) is the
+        # existing recovery path, not a parallel one
+        self.membership_check = membership_check
         self.restarts = 0        # consecutive-failure budget (resets)
         self.total_restarts = 0  # lifetime count, for observability
         self.recovery_seconds = 0.0  # total wall clock spent in recovery
@@ -348,6 +431,21 @@ class ElasticTrainer:
         net.iteration = model.iteration
         self.global_step = step
         logger.info("restored checkpoint @ step %d", step)
+
+    def resume(self) -> int:
+        """Restore the newest intact checkpoint before training starts and
+        return the restored global step (0 when the store is empty) — the
+        host-(re)join entry point: a relaunched worker calls ``resume()``
+        and continues the loop from wherever the cluster's checkpoints
+        left off, instead of only recovering after a mid-training
+        failure."""
+        if self.ckpt.latest() is None:
+            return 0   # fresh store — nothing to resume, no warning
+        self._restore()
+        if self.global_step > 0 and hasattr(self.trainer, "_place_model"):
+            self.trainer._place_model()
+        self._watchdog_armed = False
+        return self.global_step
 
     def _materialize(self, loss) -> None:
         """Force the device barrier (``loss.value()``), under the watchdog
@@ -403,6 +501,11 @@ class ElasticTrainer:
         while True:
             t_start = self.clock()
             try:
+                if self.membership_check is not None:
+                    # inside the try: a HostLostError / membership change
+                    # takes the normal recovery path (backoff → rebuild →
+                    # restore), not an unhandled crash
+                    self.membership_check()
                 loss = self.trainer.fit_batch(ds)
                 self.global_step += 1
                 saving = self.global_step % self.checkpoint_every == 0

@@ -1,0 +1,616 @@
+"""Pod-scale elastic runtime: multi-process launcher + host join/leave.
+
+The missing layer between "virtual devices in one process" and a real
+pod: every piece of >8-device evidence in this repo used to live inside
+one OS process, and ElasticTrainer only survived *in-process* restarts.
+This module makes processes the failure unit (PAPERS.md: the
+TPU-supercomputer retrospective frames preemption-tolerant pod training
+as THE production problem):
+
+- :class:`PodLauncher` — forks N worker processes (the CLI ``launch``
+  subcommand's engine), sets per-process device visibility and the
+  ``DL4J_TPU_*`` env contract, monitors liveness, and RELAUNCHES workers
+  that die or hang — host leave → join, with a bounded restart budget
+  and a leak check that no orphan worker survives a run.
+- :class:`Membership` — a shared heartbeat ledger with a coordinator-side
+  membership **epoch**: workers beat, the coordinator's ``refresh()``
+  bumps the epoch whenever the alive-set changes.  File-based (every
+  worker of a single-box launch — and every host of a pod with a shared
+  filesystem — can reach it), with an injectable clock so join/leave
+  transitions are testable against a fake clock.
+- :class:`Heartbeat` — the worker-side daemon thread that beats.
+- :class:`ProcessFailureDetector` — a FailureDetector whose ``check()``
+  raises :class:`HostLostError` / :class:`MembershipChangedError` when
+  the membership moved; wired into ``ElasticTrainer(membership_check=)``
+  it turns a peer host's death into the SAME backoff → rebuild → restore
+  recovery path as a device loss, with ``mesh.surviving_mesh`` rebuilding
+  a (possibly smaller ``dcn``) mesh over the survivors.
+
+Bootstrap modes: ``distributed`` (workers call
+``distributed.initialize`` against a coordinator with a bounded connect
+timeout — the real-pod path, requires a jaxlib whose backend supports
+cross-process collectives, see ``probe_multiprocess_support``) and
+``replica`` (no jax.distributed: each worker is an independent replica
+over its own local devices — the single-box CPU path the multi-process
+chaos soak rides).  ``auto`` picks distributed only when a coordinator
+can work: on the CPU backend it falls back to replica.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .distributed import (
+    ENV_CHAOS, ENV_CONNECT_TIMEOUT, ENV_COORDINATOR, ENV_INCARNATION,
+    ENV_NUM_PROCESSES, ENV_PROCESS_ID, ENV_RUN_DIR, initialize,
+    resolve_process_index,
+)
+from .elastic import FailureDetector, RecoverableInfraError
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class HostLostError(RecoverableInfraError):
+    """A previously-alive member's heartbeat expired (process died, host
+    preempted, network partition).  Message carries UNAVAILABLE so
+    marker-based detectors classify it too; ``lost`` lists the members."""
+
+    def __init__(self, lost: Sequence[int], epoch: int):
+        super().__init__(
+            f"UNAVAILABLE: host(s) {sorted(lost)} left the membership "
+            f"(heartbeat expired) at epoch {epoch} — rebuilding over the "
+            "survivors")
+        self.lost = sorted(lost)
+        self.epoch = epoch
+
+
+class MembershipChangedError(RecoverableInfraError):
+    """The membership epoch moved under a live trainer (typically a host
+    JOINING back) — the mesh should be re-provisioned over the new
+    member set before the next step."""
+
+    def __init__(self, joined: Sequence[int], epoch: int):
+        super().__init__(
+            f"ABORTED: membership changed at epoch {epoch} — host(s) "
+            f"{sorted(joined)} joined; re-provisioning the mesh")
+        self.joined = sorted(joined)
+        self.epoch = epoch
+
+
+def _atomic_write_json(path: str, payload: dict) -> None:
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
+
+
+class Membership:
+    """Shared heartbeat ledger + coordinator-side membership epoch.
+
+    Workers call ``beat(process_id)``; the coordinator (launcher) calls
+    ``refresh()``, which recomputes the alive-set from heartbeat ages and
+    bumps the persisted epoch whenever it changes.  Heartbeat files and
+    the epoch ledger are single files under ``directory`` written with
+    atomic renames, so readers never see torn JSON.  ``clock`` is
+    injectable (fake-clock transition tests); cross-process use needs a
+    wall clock — the default ``time.time`` — because monotonic clocks
+    don't compare across processes."""
+
+    LEDGER = "membership.json"
+
+    def __init__(self, directory: str, heartbeat_timeout: float = 5.0,
+                 clock: Callable[[], float] = time.time):
+        if heartbeat_timeout <= 0:
+            raise ValueError(f"heartbeat_timeout must be > 0, got "
+                             f"{heartbeat_timeout}")
+        self.directory = directory
+        self.heartbeat_timeout = heartbeat_timeout
+        self.clock = clock
+        os.makedirs(directory, exist_ok=True)
+
+    # -- worker side -------------------------------------------------------
+
+    def _hb_path(self, process_id: int) -> str:
+        return os.path.join(self.directory, f"hb_{int(process_id)}.json")
+
+    def beat(self, process_id: int, pid: Optional[int] = None,
+             step: Optional[int] = None) -> None:
+        _atomic_write_json(self._hb_path(process_id), {
+            "process_id": int(process_id),
+            "pid": int(pid if pid is not None else os.getpid()),
+            "step": step, "t": self.clock()})
+
+    def last_beat(self, process_id: int) -> Optional[dict]:
+        try:
+            with open(self._hb_path(process_id)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def remove(self, process_id: int) -> None:
+        try:
+            os.remove(self._hb_path(process_id))
+        except OSError:
+            pass
+
+    # -- coordinator side --------------------------------------------------
+
+    def _scan(self) -> Dict[int, dict]:
+        out: Dict[int, dict] = {}
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for fn in names:
+            if not (fn.startswith("hb_") and fn.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.directory, fn)) as f:
+                    rec = json.load(f)
+                out[int(rec["process_id"])] = rec
+            except (OSError, ValueError, KeyError):
+                continue   # torn/foreign file — not a member
+        return out
+
+    def alive(self) -> List[int]:
+        now = self.clock()
+        return sorted(i for i, rec in self._scan().items()
+                      if now - float(rec.get("t", 0)) <= self.heartbeat_timeout)
+
+    def read(self) -> dict:
+        """The persisted ledger: {"epoch": int, "members": [ids]} (epoch 0,
+        no members before the first refresh)."""
+        try:
+            with open(os.path.join(self.directory, self.LEDGER)) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return {"epoch": 0, "members": []}
+
+    @property
+    def epoch(self) -> int:
+        return int(self.read()["epoch"])
+
+    def members(self) -> List[int]:
+        return list(self.read()["members"])
+
+    def refresh(self) -> int:
+        """Recompute the alive-set; if it differs from the ledger, bump
+        the epoch and persist — ONE bump per transition batch, so two
+        hosts expiring in the same scan cost one epoch, not two.  Only
+        the coordinator calls this (single ledger writer)."""
+        led = self.read()
+        alive = self.alive()
+        if alive != list(led["members"]):
+            led = {"epoch": int(led["epoch"]) + 1, "members": alive,
+                   "t": self.clock()}
+            _atomic_write_json(os.path.join(self.directory, self.LEDGER), led)
+            logger.info("membership epoch %d: members %s", led["epoch"],
+                        alive)
+        return int(led["epoch"])
+
+
+class Heartbeat:
+    """Worker-side liveness beacon: a daemon thread that beats the shared
+    Membership every ``interval`` seconds (plus once immediately), with an
+    optional ``step_fn`` so the ledger records training progress.  A
+    SIGSTOPped / wedged worker stops beating — which is exactly the
+    signal the launcher's hang detection keys on."""
+
+    def __init__(self, membership: Membership, process_id: int,
+                 interval: float = 0.2,
+                 step_fn: Optional[Callable[[], int]] = None):
+        self.membership = membership
+        self.process_id = int(process_id)
+        self.interval = interval
+        self.step_fn = step_fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def set_step_fn(self, step_fn: Callable[[], int]) -> None:
+        self.step_fn = step_fn
+
+    def _beat_once(self) -> None:
+        step = None
+        if self.step_fn is not None:
+            try:
+                step = int(self.step_fn())
+            except Exception:
+                step = None
+        try:
+            self.membership.beat(self.process_id, step=step)
+        except OSError as exc:   # run dir vanished mid-shutdown — not fatal
+            logger.debug("heartbeat write failed: %s", exc)
+
+    def start(self) -> "Heartbeat":
+        if self._thread is not None:
+            return self
+        self._beat_once()
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                self._beat_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name=f"heartbeat-{self.process_id}")
+        self._thread.start()
+        return self
+
+    def stop(self, deregister: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        if deregister:
+            self.membership.remove(self.process_id)
+
+    @classmethod
+    def start_from_env(cls, step_fn: Optional[Callable[[], int]] = None,
+                       interval: float = 0.2) -> Optional["Heartbeat"]:
+        """Start beating iff launched under the pod launcher (the
+        ``DL4J_TPU_RUN_DIR`` env is the contract); None otherwise."""
+        run_dir = os.environ.get(ENV_RUN_DIR)
+        if not run_dir:
+            return None
+        return cls(Membership(run_dir), resolve_process_index(),
+                   interval=interval, step_fn=step_fn).start()
+
+
+class ProcessFailureDetector(FailureDetector):
+    """Heartbeat-based process-liveness detection on top of the marker
+    classifier: ``check()`` compares the current alive-set against the
+    last one it saw and raises :class:`HostLostError` (leave) or
+    :class:`MembershipChangedError` (join) — both recoverable by
+    construction.  Wire it into ``ElasticTrainer(membership_check=
+    detector.check, failure_detector=detector, rebuild_fn=...)`` and a
+    peer's death flows through the standard backoff/restore recovery with
+    a mesh rebuilt over the survivors (``mesh.surviving_mesh``)."""
+
+    def __init__(self, membership: Membership,
+                 recover_on_join: bool = True):
+        self.membership = membership
+        self.recover_on_join = recover_on_join
+        self._known: Optional[frozenset] = None
+
+    def check(self) -> None:
+        alive = frozenset(self.membership.alive())
+        if self._known is None:       # first observation is the baseline
+            self._known = alive
+            return
+        lost, joined = self._known - alive, alive - self._known
+        self._known = alive
+        epoch = self.membership.epoch
+        if lost:
+            raise HostLostError(lost, epoch)
+        if joined and self.recover_on_join:
+            raise MembershipChangedError(joined, epoch)
+
+
+def maybe_bootstrap_from_env(timeout_s: Optional[float] = None) -> bool:
+    """Join the jax.distributed cluster iff the launcher exported a
+    coordinator address (``DL4J_TPU_COORDINATOR``); workers in replica
+    mode (no coordinator) return False and stay single-process.  The
+    bounded-timeout ``initialize`` raises CoordinatorUnreachableError
+    instead of hanging when the coordinator is gone."""
+    addr = os.environ.get(ENV_COORDINATOR)
+    if not addr:
+        return False
+    n = int(os.environ[ENV_NUM_PROCESSES])
+    i = resolve_process_index()
+    if timeout_s is None:
+        timeout_s = float(os.environ.get(ENV_CONNECT_TIMEOUT, "60"))
+    initialize(addr, n, i, timeout_s=timeout_s)
+    return True
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _with_device_count(xla_flags: str, count: int) -> str:
+    """XLA_FLAGS with exactly one host-platform device-count flag."""
+    kept = [t for t in xla_flags.split()
+            if "xla_force_host_platform_device_count" not in t]
+    kept.append(f"--xla_force_host_platform_device_count={count}")
+    return " ".join(kept)
+
+
+class _WorkerHandle:
+    def __init__(self, process_id: int):
+        self.process_id = process_id
+        self.proc: Optional[subprocess.Popen] = None
+        self.state = "pending"       # running | completed | unrecovered
+        self.incarnation = 0
+        self.restarts = 0
+        self.hang_killed = False
+        self.spawned_pids: List[int] = []
+        self.log_path: Optional[str] = None
+        self._log_f = None
+
+
+class PodLauncher:
+    """Fork, monitor, and heal a fleet of worker processes (one per
+    "host") — the engine behind the CLI ``launch`` subcommand and the
+    multi-process chaos soak.
+
+    Every worker runs ``worker_argv`` with the ``DL4J_TPU_*`` env
+    contract (process id/count, run dir for heartbeats, optional
+    coordinator address, optional chaos spec).  The monitor loop:
+
+    - reaps exited workers — rc 0 is completion; anything else is a host
+      LEAVE, and the worker is relaunched (host JOIN) while its restart
+      budget lasts, with the chaos spec stripped (a scheduled
+      ``proc_kill`` fires once per run, not once per incarnation);
+    - declares a worker HUNG when its heartbeat goes stale while the
+      process is still alive (SIGSTOP, wedged runtime), SIGKILLs it, and
+      relaunches through the same leave/join path;
+    - bumps the membership epoch on every transition via
+      ``Membership.refresh()``;
+    - on exit, kills anything still running and verifies no orphan
+      worker process survives (the leak check the soak gates on).
+    """
+
+    def __init__(self, worker_argv: Sequence[str], num_workers: int,
+                 run_dir: str,
+                 devices_per_worker: Optional[int] = None,
+                 base_env: Optional[Dict[str, str]] = None,
+                 chaos: Optional[Dict[int, str]] = None,
+                 bootstrap: str = "replica",
+                 coordinator_port: Optional[int] = None,
+                 heartbeat_timeout: float = 5.0,
+                 max_restarts: int = 2,
+                 poll_interval: float = 0.1,
+                 deadline_s: float = 600.0,
+                 connect_timeout_s: float = 60.0,
+                 platform: Optional[str] = None,
+                 megascale_slices: Optional[int] = None):
+        if num_workers < 1:
+            raise ValueError(f"num_workers must be >= 1, got {num_workers}")
+        if bootstrap not in ("replica", "distributed"):
+            raise ValueError(f"bootstrap must be replica/distributed, got "
+                             f"{bootstrap!r}")
+        self.worker_argv = list(worker_argv)
+        self.num_workers = num_workers
+        self.run_dir = run_dir
+        self.devices_per_worker = devices_per_worker
+        self.base_env = dict(base_env if base_env is not None else os.environ)
+        self.chaos = dict(chaos or {})
+        bad = set(self.chaos) - set(range(num_workers))
+        if bad:
+            raise ValueError(f"chaos targets {sorted(bad)} out of range "
+                             f"[0, {num_workers})")
+        self.bootstrap = bootstrap
+        self.coordinator_port = coordinator_port
+        self.heartbeat_timeout = heartbeat_timeout
+        self.max_restarts = max_restarts
+        self.poll_interval = poll_interval
+        self.deadline_s = deadline_s
+        self.connect_timeout_s = connect_timeout_s
+        self.platform = platform
+        self.megascale_slices = megascale_slices
+        self.membership = Membership(run_dir, heartbeat_timeout)
+        self.handles = [_WorkerHandle(i) for i in range(num_workers)]
+        self.events: List[dict] = []
+        self._t0: Optional[float] = None
+
+    # -- env / spawn -------------------------------------------------------
+
+    def _event(self, kind: str, worker: Optional[int] = None, **extra):
+        e = {"t": round(time.time() - (self._t0 or time.time()), 3),
+             "kind": kind}
+        if worker is not None:
+            e["worker"] = worker
+        e.update(extra)
+        self.events.append(e)
+        logger.info("launcher: %s", e)
+
+    def _env_for(self, h: _WorkerHandle) -> Dict[str, str]:
+        env = dict(self.base_env)
+        env[ENV_PROCESS_ID] = str(h.process_id)
+        env[ENV_NUM_PROCESSES] = str(self.num_workers)
+        env[ENV_RUN_DIR] = self.run_dir
+        env[ENV_INCARNATION] = str(h.incarnation)
+        env[ENV_CONNECT_TIMEOUT] = str(self.connect_timeout_s)
+        if self.devices_per_worker:
+            env["XLA_FLAGS"] = _with_device_count(
+                env.get("XLA_FLAGS", ""), self.devices_per_worker)
+        if self.platform:
+            env["JAX_PLATFORMS"] = self.platform
+        if self.bootstrap == "distributed":
+            if self.coordinator_port is None:
+                self.coordinator_port = free_port()
+            env[ENV_COORDINATOR] = f"127.0.0.1:{self.coordinator_port}"
+            # feed slice detection (distributed.detect_num_slices →
+            # build_two_tier_mesh / ShardedTrainer.two_tier): each worker
+            # process is one "slice" unless the deployment already set
+            # the multislice runtime's env or the caller overrode it
+            if self.megascale_slices:
+                env["MEGASCALE_NUM_SLICES"] = str(self.megascale_slices)
+            else:
+                env.setdefault("MEGASCALE_NUM_SLICES",
+                               str(self.num_workers))
+        else:
+            env.pop(ENV_COORDINATOR, None)
+            if self.megascale_slices:
+                env["MEGASCALE_NUM_SLICES"] = str(self.megascale_slices)
+        spec = self.chaos.get(h.process_id)
+        if spec and h.incarnation == 0:
+            env[ENV_CHAOS] = spec     # consumed once per RUN: a relaunched
+        else:                         # worker must not re-kill itself at
+            env.pop(ENV_CHAOS, None)  # the same scheduled step forever
+        return env
+
+    def _spawn(self, h: _WorkerHandle) -> None:
+        self.membership.remove(h.process_id)   # a stale beat from the dead
+        # incarnation must not trip hang detection before the new process
+        # gets through its imports to the first beat
+        logs = os.path.join(self.run_dir, "logs")
+        os.makedirs(logs, exist_ok=True)
+        h.log_path = os.path.join(
+            logs, f"worker{h.process_id}.inc{h.incarnation}.log")
+        h._log_f = open(h.log_path, "wb")
+        h.proc = subprocess.Popen(self.worker_argv, env=self._env_for(h),
+                                  stdout=h._log_f,
+                                  stderr=subprocess.STDOUT)
+        h.state = "running"
+        h.hang_killed = False
+        h.spawned_pids.append(h.proc.pid)
+        self._event("spawn", h.process_id, pid=h.proc.pid,
+                    incarnation=h.incarnation)
+
+    def _close_log(self, h: _WorkerHandle) -> None:
+        if h._log_f is not None:
+            try:
+                h._log_f.close()
+            except OSError:
+                pass
+            h._log_f = None
+
+    def _log_tail(self, h: _WorkerHandle, n: int = 1500) -> str:
+        try:
+            with open(h.log_path, "rb") as f:
+                f.seek(0, os.SEEK_END)
+                size = f.tell()
+                f.seek(max(0, size - n))
+                return f.read().decode(errors="replace")
+        except (OSError, TypeError):
+            return ""
+
+    # -- monitor -----------------------------------------------------------
+
+    def _poll_once(self) -> None:
+        now = time.time()
+        for h in self.handles:
+            if h.state != "running":
+                continue
+            rc = h.proc.poll()
+            if rc is not None:
+                self._close_log(h)
+                if rc == 0 and not h.hang_killed:
+                    h.state = "completed"
+                    self.membership.remove(h.process_id)
+                    self._event("complete", h.process_id,
+                                incarnation=h.incarnation)
+                    continue
+                kind = "hang" if h.hang_killed else "crash"
+                self._event("leave", h.process_id, cause=kind, rc=rc,
+                            incarnation=h.incarnation)
+                if h.restarts < self.max_restarts:
+                    h.restarts += 1
+                    h.incarnation += 1
+                    self._spawn(h)
+                    self._event("join", h.process_id,
+                                incarnation=h.incarnation)
+                else:
+                    h.state = "unrecovered"
+                    self._event("unrecovered", h.process_id, cause=kind,
+                                rc=rc, log_tail=self._log_tail(h))
+                continue
+            # alive — hang detection: a beat from THIS incarnation (the hb
+            # file is removed at spawn) that has gone stale means the
+            # process is wedged or stopped; never-beaten workers get
+            # startup grace (imports/compiles) and are bounded by the
+            # overall deadline instead
+            hb = self.membership.last_beat(h.process_id)
+            if hb is not None and \
+                    now - float(hb.get("t", now)) > self.heartbeat_timeout:
+                h.hang_killed = True
+                self._event("hang_detected", h.process_id,
+                            stale_s=round(now - float(hb["t"]), 2))
+                try:
+                    h.proc.kill()    # SIGKILL terminates SIGSTOPped too
+                except OSError:
+                    pass
+
+    def _running(self) -> bool:
+        return any(h.state == "running" for h in self.handles)
+
+    def _reap_all(self) -> int:
+        """Kill anything still alive and count it; then verify every pid
+        this launcher EVER spawned is gone — the no-orphans contract."""
+        leaked = 0
+        for h in self.handles:
+            if h.proc is not None and h.proc.poll() is None:
+                leaked += 1
+                try:
+                    h.proc.kill()
+                    h.proc.wait(timeout=10)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+            self._close_log(h)
+        for h in self.handles:
+            for pid in h.spawned_pids:
+                try:
+                    os.kill(pid, 0)
+                except ProcessLookupError:
+                    continue           # gone, as it should be
+                except PermissionError:
+                    pass               # exists under another uid — not ours
+                else:
+                    # still alive (a double-fork would land here) — last
+                    # resort, then recheck
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                    except OSError:
+                        pass
+                    leaked += 1
+        return leaked
+
+    def run(self) -> dict:
+        """Launch the fleet, heal it until every worker completes (or its
+        budget/deadline runs out), and return the run report."""
+        self._t0 = time.time()
+        os.makedirs(self.run_dir, exist_ok=True)
+        for h in self.handles:
+            self._spawn(h)
+        deadline_hit = False
+        leaked = 0
+        try:
+            while self._running():
+                time.sleep(self.poll_interval)
+                self.membership.refresh()
+                self._poll_once()
+                if time.time() - self._t0 > self.deadline_s:
+                    deadline_hit = True
+                    for h in self.handles:
+                        if h.state == "running":
+                            h.state = "unrecovered"
+                            self._event("unrecovered", h.process_id,
+                                        cause="deadline",
+                                        log_tail=self._log_tail(h))
+                    break
+            self.membership.refresh()
+        finally:
+            leaked = self._reap_all()
+        completed = [h.process_id for h in self.handles
+                     if h.state == "completed"]
+        unrecovered = [h.process_id for h in self.handles
+                       if h.state == "unrecovered"]
+        report = {
+            "workers": self.num_workers,
+            "completed": completed,
+            "unrecovered": unrecovered,
+            "restarts": sum(h.restarts for h in self.handles),
+            "leaves": [e for e in self.events if e["kind"] == "leave"],
+            "joins": sum(1 for e in self.events if e["kind"] == "join"),
+            "hang_detected": sum(1 for e in self.events
+                                 if e["kind"] == "hang_detected"),
+            "epoch": self.membership.epoch,
+            "deadline_hit": deadline_hit,
+            "leaked_killed": leaked,
+            "wall_seconds": round(time.time() - self._t0, 2),
+            "events": self.events,
+        }
+        report["ok"] = (not unrecovered and not deadline_hit
+                        and leaked == 0)
+        return report

@@ -79,6 +79,35 @@ def build_two_tier_mesh(n_slices: int,
     return build_mesh({DCN_AXIS: n_slices, **inner}, devices)
 
 
+def surviving_mesh(alive_slices: Sequence[int], n_slices: int,
+                   axes: Optional[Dict[str, int]] = None,
+                   devices: Optional[Sequence] = None) -> Mesh:
+    """Two-tier mesh over the devices of the SURVIVING slices only —
+    slice-granular recovery: when a host/slice leaves the membership, the
+    job re-provisions a (possibly smaller ``dcn``) mesh over what's left
+    instead of dying or waiting for the full pod to return.
+
+    ``alive_slices`` are slice row indices into the ORIGINAL ``n_slices``
+    slice-major device order (the layout ``build_two_tier_mesh`` assumes);
+    inner axes default to ``data=-1`` over each slice's devices.  Restore
+    the newest checkpoint after rebuilding — params placed for the old
+    mesh don't transfer (``ElasticTrainer(rebuild_fn=...)`` wires both
+    steps into one recovery)."""
+    devs = list(devices if devices is not None else jax.devices())
+    if n_slices < 1 or len(devs) % n_slices:
+        raise ValueError(f"{len(devs)} devices not divisible into "
+                         f"{n_slices} slices")
+    alive = sorted(set(int(s) for s in alive_slices))
+    if not alive:
+        raise ValueError("no surviving slices — nothing to rebuild on")
+    if alive[0] < 0 or alive[-1] >= n_slices:
+        raise ValueError(f"alive slices {alive} out of range "
+                         f"[0, {n_slices})")
+    per = len(devs) // n_slices
+    keep = [d for s in alive for d in devs[s * per:(s + 1) * per]]
+    return build_two_tier_mesh(len(alive), axes, keep)
+
+
 def put_global(arr, sharding: NamedSharding):
     """Place a host array onto a (possibly multi-process) sharding.
 

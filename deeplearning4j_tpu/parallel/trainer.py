@@ -41,8 +41,8 @@ from ..datasets.dataset import DataSet
 from ..utils.jax_compat import set_mesh, shard_map
 from ..datasets.iterators import DataSetIterator
 from .mesh import (
-    DATA_AXIS, DCN_AXIS, MODEL_AXIS, build_mesh, infer_param_shardings,
-    put_global, replicated,
+    DATA_AXIS, DCN_AXIS, MODEL_AXIS, build_mesh, build_two_tier_mesh,
+    infer_param_shardings, put_global, replicated,
 )
 
 
@@ -56,6 +56,29 @@ class ShardedTrainer:
     The wrapped net keeps working as usual afterwards; its params simply
     live sharded on the mesh.
     """
+
+    @classmethod
+    def two_tier(cls, net, n_slices: Optional[int] = None,
+                 axes: Optional[dict] = None, **kwargs) -> "ShardedTrainer":
+        """The pod-launch ceremony in one line: a trainer over
+        ``build_two_tier_mesh`` sized by the multislice runtime.
+
+        ``n_slices`` defaults to ``distributed.detect_num_slices()`` —
+        the MEGASCALE env contract every worker of a Cloud TPU multislice
+        job carries (the ``launch`` subcommand propagates it to forked
+        workers in distributed mode) — so the same program runs 1-slice
+        and N-slice unchanged:
+
+            distributed.initialize(...)            # or `launch --join`
+            trainer = ShardedTrainer.two_tier(
+                net, grad_compression="threshold")
+
+        All ShardedTrainer kwargs pass through (pair with
+        ``grad_compression=`` to compress the cross-slice tier)."""
+        if n_slices is None:
+            from .distributed import detect_num_slices
+            n_slices = detect_num_slices()
+        return cls(net, build_two_tier_mesh(n_slices, axes), **kwargs)
 
     def __init__(self, net, mesh: Optional[Mesh] = None,
                  data_axis: str = DATA_AXIS, model_axis: str = MODEL_AXIS,

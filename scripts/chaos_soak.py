@@ -1,6 +1,37 @@
 """Chaos soak: a scripted fault schedule against a REAL training loop.
 
-Three arms over the same seeded MLP/blobs workload:
+Two soaks share this file:
+
+``chaos_soak.py [--quick]`` — the single-process soak (bench config
+``chaos_recovery``), three arms over the same seeded MLP/blobs workload
+(described below).
+
+``chaos_soak.py --multiproc [--quick]`` — the PROCESS-scale soak (bench
+config ``multihost_chaos_recovery``): 2 worker processes x 4 virtual CPU
+devices each (the tests/test_multiprocess.py topology) under the
+PodLauncher, sharing one checkpoint store (only process 0 writes — the
+multi-host CheckpointManager guard).  Three arms again:
+
+  baseline   — ONE worker subprocess, chaos off: the reference loss
+               sequence (same per-process topology, so bit-comparable)
+  2-proc off — 2 launched workers, chaos off: every worker's loss
+               sequence must be BIT-IDENTICAL to the baseline (launcher
+               + membership + elastic machinery changes no math)
+  2-proc chaos — worker 1 is SIGKILLed mid-run (proc_kill, self-injected
+               at a deterministic step) and worker 0 is SIGSTOPped
+               (proc_hang → heartbeat expiry → launcher kill+relaunch):
+               both workers must be relaunched, resume from the shared
+               checkpoints, and reach training completion with zero
+               unrecovered failures; every loss any incarnation records
+               must equal the baseline at that step BIT-FOR-BIT, and no
+               orphan worker process may survive the run.
+
+``--worker`` is the internal per-process entry point (the launcher's
+child command).  Steps are paced (SOAK_STEP_SLEEP) so relaunch latency
+lands MID-run — a restarted worker has real tail work to replay, not a
+no-op rejoin.
+
+Single-process arms (the original soak):
 
   baseline  — plain ``net.fit_batch`` loop, no wrapper (the pre-change
               trainer's math)
@@ -218,8 +249,237 @@ def run_soak(quick=QUICK, ckpt_root=None):
     return out
 
 
+# ---------------------------------------------------------------------------
+# process-scale soak (bench config multihost_chaos_recovery)
+# ---------------------------------------------------------------------------
+
+class _Paced:
+    """Per-step pacing wrapper (fit_batch + net): emulates a realistic
+    step time so launcher-side relaunch latency lands MID-run in every
+    arm identically — sleep changes wall clock, never math."""
+
+    def __init__(self, trainer, sleep_s):
+        self.trainer = trainer
+        self.sleep_s = sleep_s
+
+    @property
+    def net(self):
+        return getattr(self.trainer, "net", self.trainer)
+
+    def _place_model(self):
+        if hasattr(self.trainer, "_place_model"):
+            self.trainer._place_model()
+
+    def fit_batch(self, ds):
+        if self.sleep_s:
+            time.sleep(self.sleep_s)
+        return self.trainer.fit_batch(ds)
+
+
+def run_worker() -> None:
+    """One cluster member (launcher child): 4 virtual CPU devices, a
+    data=4 ShardedTrainer, ElasticTrainer over the SHARED checkpoint
+    store, heartbeats, env-armed chaos.  Resumes from the newest
+    checkpoint (host join), trains to SOAK_STEPS, records every loss with
+    its global step."""
+    from deeplearning4j_tpu.cli import _parse_chaos
+    from deeplearning4j_tpu.parallel import (
+        ChaosInjector, ElasticTrainer, ShardedTrainer, build_mesh,
+    )
+    from deeplearning4j_tpu.parallel.distributed import (
+        ENV_CHAOS, ENV_INCARNATION, resolve_process_index,
+    )
+    from deeplearning4j_tpu.parallel.launcher import Heartbeat
+
+    steps = int(os.environ["SOAK_STEPS"])
+    sleep_s = float(os.environ.get("SOAK_STEP_SLEEP", "0"))
+    ckpt_dir = os.environ["SOAK_CKPT"]
+    out_dir = os.environ["SOAK_OUT_DIR"]
+    proc = resolve_process_index()
+    incarnation = int(os.environ.get(ENV_INCARNATION, "0"))
+
+    net = _mlp()
+    trainer = ShardedTrainer(net, build_mesh({"data": 4}))
+    inner = _Paced(trainer, sleep_s)
+    chaos_spec = os.environ.get(ENV_CHAOS)
+    if chaos_spec:
+        sched, seed, hang = _parse_chaos(chaos_spec)
+        inner = ChaosInjector(inner, sched, hang_seconds=hang, seed=seed)
+    et = ElasticTrainer(inner, ckpt_dir, checkpoint_every=4, sync_every=1)
+    hb = Heartbeat.start_from_env(step_fn=lambda: et.global_step)
+    # incarnation 0 is initial cluster formation — everyone starts from
+    # seeded init; a RELAUNCHED worker (host rejoin) resumes the shared
+    # store.  Resuming at first start would let a slow-booting worker
+    # skip steps a faster peer already checkpointed.
+    start_step = et.resume() if incarnation > 0 else 0
+    ds = _data()
+    losses = []
+    while et.global_step < steps:
+        losses.append(float(et.fit_batch(ds)))
+    os.makedirs(out_dir, exist_ok=True)
+    out = {"process": proc, "incarnation": incarnation,
+           "start_step": start_step, "losses": losses,
+           "writer": et.ckpt.is_writer}
+    path = os.path.join(out_dir, f"proc{proc}_inc{incarnation}.json")
+    with open(path + ".tmp", "w") as f:
+        json.dump(out, f)
+    os.replace(path + ".tmp", path)
+    if hb is not None:
+        hb.stop()
+
+
+def _spawn_baseline(root, steps, sleep_s):
+    """The single-process reference arm: the SAME worker entry point in
+    its own subprocess (4 virtual devices), chaos off, own checkpoint
+    dir — subprocess-for-subprocess comparable with the launched arms."""
+    import subprocess
+    import sys as _sys
+
+    from deeplearning4j_tpu.parallel.launcher import _with_device_count
+
+    out_dir = os.path.join(root, "baseline_out")
+    env = dict(os.environ)
+    env.pop("DL4J_TPU_RUN_DIR", None)
+    env.pop("DL4J_TPU_CHAOS", None)
+    env["DL4J_TPU_PROCESS_ID"] = "0"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = _with_device_count(env.get("XLA_FLAGS", ""), 4)
+    env.update({"SOAK_STEPS": str(steps), "SOAK_STEP_SLEEP": str(sleep_s),
+                "SOAK_CKPT": os.path.join(root, "baseline_ck"),
+                "SOAK_OUT_DIR": out_dir})
+    p = subprocess.run([_sys.executable, os.path.abspath(__file__),
+                        "--worker"], env=env, capture_output=True,
+                       text=True, timeout=600)
+    if p.returncode != 0:
+        raise RuntimeError(f"baseline worker failed rc={p.returncode}: "
+                           f"{p.stderr[-1500:]}")
+    with open(os.path.join(out_dir, "proc0_inc0.json")) as f:
+        return json.load(f)["losses"]
+
+
+def _launch_arm(root, name, steps, sleep_s, chaos, heartbeat_timeout,
+                deadline_s):
+    import sys as _sys
+
+    from deeplearning4j_tpu.parallel.launcher import PodLauncher
+
+    run_dir = os.path.join(root, f"{name}_run")
+    out_dir = os.path.join(root, f"{name}_out")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update({"SOAK_STEPS": str(steps), "SOAK_STEP_SLEEP": str(sleep_s),
+                "SOAK_CKPT": os.path.join(root, f"{name}_ck"),
+                "SOAK_OUT_DIR": out_dir})
+    launcher = PodLauncher(
+        [_sys.executable, os.path.abspath(__file__), "--worker"],
+        num_workers=2, run_dir=run_dir, devices_per_worker=4,
+        base_env=env, chaos=chaos, heartbeat_timeout=heartbeat_timeout,
+        max_restarts=2, deadline_s=deadline_s, platform="cpu")
+    report = launcher.run()
+    results = []
+    if os.path.isdir(out_dir):
+        for fn in sorted(os.listdir(out_dir)):
+            if fn.endswith(".json"):
+                with open(os.path.join(out_dir, fn)) as f:
+                    results.append(json.load(f))
+    return report, results
+
+
+def _losses_match_baseline(records, baseline):
+    """Every loss ANY incarnation recorded must equal the baseline at that
+    global step bit-for-bit (recovery replays the exact trajectory)."""
+    for rec in records:
+        for i, loss in enumerate(rec["losses"]):
+            step = rec["start_step"] + i       # loss of global step+1
+            if step >= len(baseline) or loss != baseline[step]:
+                return False
+    return True
+
+
+def run_multiproc_soak(quick=QUICK, root=None):
+    """The process-scale chaos soak — see the module docstring."""
+    import tempfile
+
+    steps = 16 if quick else 24
+    sleep_s = 0.3 if quick else 0.4
+    hb_timeout = 2.0
+    deadline = 180.0 if quick else 240.0
+    kill_step = max(2, steps // 4)             # worker 1: SIGKILL
+    hang_step = max(kill_step + 2, (2 * steps) // 3)   # worker 0: SIGSTOP
+    root = root or tempfile.mkdtemp(prefix="chaos_soak_mp_")
+    out = {"config": "multihost_chaos_recovery", "platform": "cpu",
+           "steps": steps, "workers": 2, "devices_per_worker": 4,
+           "proc_kill_step": kill_step, "proc_hang_step": hang_step}
+
+    t0 = time.perf_counter()
+    # -- arm 1: single-process baseline ------------------------------------
+    baseline = _spawn_baseline(root, steps, sleep_s)
+    out["baseline_final_loss"] = baseline[-1]
+
+    # -- arm 2: 2-process launch, chaos OFF → bit-identical ----------------
+    off_report, off_results = _launch_arm(
+        root, "off", steps, sleep_s, chaos=None,
+        heartbeat_timeout=hb_timeout, deadline_s=deadline)
+    out["off_ok"] = bool(off_report["ok"] and off_report["restarts"] == 0
+                         and len(off_results) == 2)
+    out["off_bitwise"] = bool(
+        len(off_results) == 2
+        and all(r["start_step"] == 0 and r["losses"] == baseline
+                for r in off_results))
+    out["off_leaked"] = off_report["leaked_killed"]
+
+    # -- arm 3: 2-process launch + process chaos ---------------------------
+    chaos = {1: f"proc_kill@{kill_step}", 0: f"proc_hang@{hang_step}"}
+    report, results = _launch_arm(
+        root, "chaos", steps, sleep_s, chaos=chaos,
+        heartbeat_timeout=hb_timeout, deadline_s=deadline)
+    causes = [e.get("cause") for e in report["leaves"]]
+    by_worker = {}
+    for r in results:
+        by_worker.setdefault(r["process"], []).append(r)
+    resumed = [r for r in results if r["start_step"] > 0]
+    out.update({
+        "unrecovered": len(report["unrecovered"]),
+        "completed": report["completed"],
+        "restarts": report["restarts"],
+        "proc_kill_recovered": causes.count("crash"),
+        "proc_hang_recovered": causes.count("hang"),
+        "membership_epoch": report["epoch"],
+        "leaked": report["leaked_killed"],
+        "deadline_hit": report["deadline_hit"],
+        "events": report["events"],
+        "chaos_loss_bitwise": _losses_match_baseline(results, baseline),
+        "resumed_incarnations": len(resumed),
+        "resume_tail_steps": [len(r["losses"]) for r in resumed],
+        # only process 0 may write the shared store: every result record
+        # carries the manager's own writer verdict
+        "writer_guard_ok": all(r["writer"] == (r["process"] == 0)
+                               for r in results),
+        "completion_steps_ok": all(
+            recs and max(r["start_step"] + len(r["losses"])
+                         for r in recs) == steps
+            for recs in by_worker.values()) and len(by_worker) == 2,
+    })
+    out["wall_seconds"] = round(time.perf_counter() - t0, 2)
+    out["soak_ok"] = bool(
+        out["off_ok"] and out["off_bitwise"] and out["off_leaked"] == 0
+        and out["unrecovered"] == 0 and not out["deadline_hit"]
+        and sorted(out["completed"]) == [0, 1]
+        and out["restarts"] == 2
+        and out["proc_kill_recovered"] >= 1
+        and out["proc_hang_recovered"] >= 1
+        and out["membership_epoch"] >= 4
+        and out["leaked"] == 0
+        and out["chaos_loss_bitwise"]
+        and out["writer_guard_ok"] and out["completion_steps_ok"])
+    return out
+
+
 def main() -> None:
-    out = run_soak()
+    if "--worker" in sys.argv:
+        run_worker()
+        return
+    out = run_multiproc_soak() if "--multiproc" in sys.argv else run_soak()
     print(json.dumps(out), flush=True)
     if not out["soak_ok"]:
         raise SystemExit(2)

@@ -3,7 +3,13 @@
 coordinator on localhost, one global 8-device mesh, cross-process psum —
 the reference's `local[N]` Spark test (BaseSparkTest.java:89) with real
 process boundaries.  Asserts loss parity with the single-process
-8-device run of the identical seeded model."""
+8-device run of the identical seeded model.
+
+The backend capability (cross-process collectives) is probed ONCE in a
+module fixture — only the tests that genuinely need cross-process
+collectives skip when the jaxlib lacks them; launcher/membership tests
+(tests/test_launcher.py) and the CLI `launch` integration below run on
+every backend."""
 
 import json
 import os
@@ -16,6 +22,24 @@ import pytest
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _WORKER = os.path.join(_REPO, "tests", "_mp_worker.py")
+
+
+@pytest.fixture(scope="module")
+def mp_support():
+    """(supported, reason) for cross-process collectives — probed once per
+    module (cached process-wide), not rediscovered by every full-size test
+    run failing minutes in."""
+    from deeplearning4j_tpu.parallel.distributed import (
+        probe_multiprocess_support,
+    )
+    return probe_multiprocess_support()
+
+
+@pytest.fixture
+def needs_mp_backend(mp_support):
+    ok, reason = mp_support
+    if not ok:
+        pytest.skip(reason)
 
 
 def _free_port() -> int:
@@ -50,7 +74,8 @@ def _single_process_reference():
     return [float(trainer.fit_batch(DataSet(x, y))) for _ in range(5)]
 
 
-def test_two_process_cluster_matches_single_process(tmp_path):
+def test_two_process_cluster_matches_single_process(tmp_path,
+                                                    needs_mp_backend):
     port = _free_port()
     outs = [str(tmp_path / f"proc{i}.json") for i in range(2)]
     env = dict(os.environ)
@@ -86,3 +111,45 @@ def test_two_process_cluster_matches_single_process(tmp_path):
     ref = _single_process_reference()
     np.testing.assert_allclose(payloads[0]["losses"], ref, rtol=1e-4)
     assert payloads[0]["losses"][-1] < payloads[0]["losses"][0]
+
+
+def test_cli_launch_two_workers_replica_mode(tmp_path):
+    """`launch --nprocs 2` end to end, no cross-process collectives needed
+    (replica bootstrap): both workers train, write distinct outputs via the
+    {process} placeholder, the membership epoch moved, and no worker
+    process survives the run."""
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import NeuralNetConfiguration
+
+    rng = np.random.default_rng(0)
+    np.savez(tmp_path / "data.npz",
+             x=rng.normal(size=(32, 6)).astype(np.float32),
+             y=rng.integers(0, 3, 32))
+    conf = (NeuralNetConfiguration.builder().seed(7)
+            .layer(Dense(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(6)).build())
+    with open(tmp_path / "conf.json", "w") as f:
+        json.dump(conf.to_dict(), f)
+    run_dir = tmp_path / "run"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu", "launch",
+         "--nprocs", "2", "--devices-per-proc", "1",
+         "--run-dir", str(run_dir), "--",
+         "train", "--config", str(tmp_path / "conf.json"),
+         "--data", str(tmp_path / "data.npz"), "--epochs", "1",
+         "--batch-size", "16",
+         "--output", str(tmp_path / "model_{process}.zip")],
+        env=env, capture_output=True, text=True, timeout=180, cwd=_REPO)
+    assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+    assert "completed=[0, 1]" in p.stdout
+    assert "leaked=0" in p.stdout
+    assert (tmp_path / "model_0.zip").exists()
+    assert (tmp_path / "model_1.zip").exists()
+    with open(run_dir / "membership.json") as f:
+        assert json.load(f)["epoch"] >= 1
