@@ -53,6 +53,14 @@ stderr, including:
     new serving.Engine on the same synthetic open-loop LeNet load,
     hard-gated on new >= 1.0x legacy throughput AND new p99 <= legacy
     at equal load, zero unwarmed serves (docs/SERVING.md)
+  - serving_chaos_recovery: the serving-resilience gate
+    (scripts/serving_chaos_soak.py) — replica_crash/replica_hang/
+    poison_input/bad_version faults against a live 2-replica engine
+    under open-loop load, hard-gated on zero stranded futures, zero
+    cross-request poisoning, bounded p99 through replica loss, zero
+    compiles across respawns, canary auto-rollback on exactly the
+    regressed version, and chaos-off bit-identity with the pre-PR
+    engine configuration (docs/SERVING.md "Failure model")
 
 BASELINE.md: the reference publishes NO numbers; the driver target is
 >=0.8x per-chip of H100+nd4j-cuda on ResNet-50 ≈ 2000 img/s.
@@ -982,6 +990,72 @@ def bench_input_pipeline():
             "throughput_ok": True}
 
 
+def bench_serving_chaos():
+    """Config 15: serving chaos recovery (scripts/serving_chaos_soak.py;
+    CPU subprocess — the resilience logic under test is host-side).  An
+    open-loop trickle against a 2-replica engine while every serving
+    fault kind fires: replica threads crashed and hung mid-batch
+    (supervisor must retry/complete every future and respawn+re-warm),
+    scripted all-NaN poison requests (bisection must isolate them so
+    co-batched requests succeed), and a canary choreography (a healthy
+    candidate must promote, a NaN-weight regressed candidate must
+    auto-roll-back).  HARD gates (the serving-resilience contract): zero
+    stranded futures, zero cross-request poisoning, p99 under the SLO
+    bound overall AND inside the 1s windows after each replica loss,
+    zero compiles across respawns (cache-hit re-warm), auto-rollback on
+    exactly the regressed version, and a chaos-off arm whose outputs are
+    BIT-IDENTICAL to the pre-PR engine configuration with every
+    resilience counter at zero.  The reported value is the injected
+    fault count — fixed by the deterministic schedule."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    script = os.path.join(_REPO, "scripts", "serving_chaos_soak.py")
+    cmd = [sys.executable, script] + (["--quick"] if QUICK else [])
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=1800, cwd=_REPO)
+    if p.returncode != 0:
+        raise RuntimeError(f"serving_chaos_soak failed (rc={p.returncode}): "
+                           f"{p.stdout[-500:]} {p.stderr[-1000:]}")
+    soak = json.loads(p.stdout.strip().splitlines()[-1])
+    if soak.get("stranded") != 0:
+        raise RuntimeError(f"serving soak STRANDED futures: {soak}")
+    if (soak.get("poison_cross_contaminated") != 0
+            or soak.get("non_poison_failures") != 0
+            or not soak.get("poison_isolated_ok")):
+        raise RuntimeError(f"poison isolation gate FAILED: {soak}")
+    if not soak.get("p99_ok"):
+        raise RuntimeError("p99 gate FAILED during replica loss: "
+                           f"{soak}")
+    if not soak.get("respawn_zero_compiles"):
+        raise RuntimeError("replica respawn paid a serve-time compile: "
+                           f"{soak}")
+    if (not soak.get("canary_promoted_good")
+            or not soak.get("canary_rollback_fired")):
+        raise RuntimeError(f"canary promote/rollback gate FAILED: {soak}")
+    if not soak.get("off_behavior_identical"):
+        raise RuntimeError("chaos-off engine is no longer behavior-"
+                           f"identical to the pre-PR configuration: {soak}")
+    if not soak.get("soak_ok"):
+        raise RuntimeError(f"serving chaos soak gate FAILED: {soak}")
+    return {"metric": "serving_chaos_recovery",
+            "value": soak["faults_injected"], "unit": "faults recovered",
+            "platform": soak["platform"],
+            "replica_crashes": soak["replica_crashes"],
+            "replica_hangs": soak["replica_hangs"],
+            "replica_respawns": soak["replica_respawns"],
+            "retries": soak["retries"],
+            "poison_isolated": soak["poison_isolated"],
+            "p99_ms": soak["p99_ms"],
+            "p99_loss_window_ms": soak["p99_loss_window_ms"],
+            "canary_history_promoted": soak["canary_history_promoted"],
+            "stranded": 0, "poison_cross_contaminated": 0,
+            "off_behavior_identical": True,
+            "wall_seconds": soak["wall_seconds"]}
+
+
 def bench_chaos_recovery():
     """Config 11: chaos-tested fault recovery (scripts/chaos_soak.py; the
     subprocess mechanism, CPU — fault injection needs no accelerator).  A
@@ -1114,6 +1188,7 @@ def main() -> None:
                      ("chaos_recovery", bench_chaos_recovery),
                      ("multihost_chaos_recovery", bench_multihost_chaos),
                      ("serving_throughput", bench_serving),
+                     ("serving_chaos_recovery", bench_serving_chaos),
                      ("input_pipeline_overlap", bench_input_pipeline)]:
         try:
             t0 = time.perf_counter()

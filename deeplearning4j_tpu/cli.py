@@ -470,7 +470,10 @@ def cmd_serve(args) -> int:
     engine = Engine.from_registry(
         reg, name, "prod", max_batch=args.max_batch, slo_ms=args.slo_ms,
         replicas=args.replicas, max_queue=args.queue_cap,
-        admission=args.admission)
+        admission=args.admission,
+        forward_timeout_s=args.forward_timeout,
+        max_retries=args.max_retries,
+        breaker_threshold=args.breaker_threshold)
     engine.load()
     print(f"serving {name} v{version} (alias 'prod'): "
           f"max_batch={args.max_batch}, slo={args.slo_ms}ms, "
@@ -492,7 +495,7 @@ def cmd_serve(args) -> int:
     server = UIServer(port=args.port, host=args.host).attach_engine(engine)
     server.start()
     print(f"listening on http://{args.host}:{server.port} — "
-          "POST /predict, GET /metrics")
+          "POST /predict, GET /metrics, GET /healthz")
     import threading
 
     try:
@@ -749,6 +752,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="admission queue bound in requests")
     v.add_argument("--port", type=int, default=9000)
     v.add_argument("--host", default="127.0.0.1")
+    v.add_argument("--forward-timeout", type=float, default=None,
+                   metavar="SECONDS",
+                   help="declare a replica HUNG (abandon + retry its batch "
+                   "elsewhere + respawn it) when one forward exceeds this "
+                   "(default: disabled)")
+    v.add_argument("--max-retries", type=int, default=1,
+                   help="per-request retry budget after a replica failure "
+                   "(deadline-aware, different replica; default 1)")
+    v.add_argument("--breaker-threshold", type=int, default=3,
+                   help="consecutive replica failures that trip its circuit "
+                   "breaker (dispatch routes around it; default 3)")
     v.add_argument("--smoke", type=int, default=0, metavar="N",
                    help="push N synthetic requests through the engine, "
                    "print the metrics snapshot, and exit (self-test)")

@@ -36,6 +36,7 @@ from __future__ import annotations
 
 import logging
 import os
+import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
@@ -69,13 +70,43 @@ class FaultKind:
     #: stop, exercising the heartbeat-expiry path: the launcher must
     #: declare it dead, SIGKILL it, and relaunch
     PROC_HANG = "proc_hang"
+    #: a serving replica THREAD dies mid-batch (uncaught exception) —
+    #: the engine supervisor must complete the stranded futures, retry
+    #: them on another replica, and respawn the thread (re-warmed)
+    REPLICA_CRASH = "replica_crash"
+    #: a serving replica's forward blocks past forward_timeout_s — the
+    #: supervisor must abandon the hung incarnation, redispatch its
+    #: batch, and respawn; the late wake-up's results are discarded
+    REPLICA_HANG = "replica_hang"
+    #: a serving request whose features are all-NaN — the batch's
+    #: forward goes non-finite and the engine must bisect to isolate the
+    #: poison request so co-batched requests still succeed (driver-side:
+    #: the workload submits the poisoned request itself)
+    POISON_INPUT = "poison_input"
+    #: a regressed model version is canary-promoted — the registry's
+    #: shadow-traffic comparison must auto-roll-back (driver-side: the
+    #: workload registers the bad version and calls set_alias(canary=))
+    BAD_VERSION = "bad_version"
 
     ALL = (DEVICE_LOSS, CKPT_WRITE_CRASH, CKPT_TRUNCATE, CKPT_BITFLIP,
-           HUNG_STEP, NAN_GRADS, PROC_KILL, PROC_HANG)
+           HUNG_STEP, NAN_GRADS, PROC_KILL, PROC_HANG,
+           REPLICA_CRASH, REPLICA_HANG, POISON_INPUT, BAD_VERSION)
 
     #: kinds that take down the whole PROCESS — only meaningful under a
     #: multi-process launcher (in-process soaks must not schedule them)
     PROCESS_KINDS = (PROC_KILL, PROC_HANG)
+
+    #: kinds the TRAINING ChaosInjector can act on (FaultSchedule.random's
+    #: default pool — serving kinds would be silent no-ops in a trainer)
+    TRAINER_KINDS = (DEVICE_LOSS, CKPT_WRITE_CRASH, CKPT_TRUNCATE,
+                     CKPT_BITFLIP, HUNG_STEP, NAN_GRADS, PROC_KILL,
+                     PROC_HANG)
+
+    #: serving-engine fault kinds (scripts/serving_chaos_soak.py);
+    #: the first two are ENGINE-side (ServingChaos, armed on an Engine),
+    #: the last two are DRIVER-side (the workload injects them)
+    SERVING_KINDS = (REPLICA_CRASH, REPLICA_HANG, POISON_INPUT, BAD_VERSION)
+    SERVING_ENGINE_KINDS = (REPLICA_CRASH, REPLICA_HANG)
 
 
 def truncate_file(path: str, keep_fraction: float = 0.5) -> None:
@@ -131,9 +162,10 @@ class FaultSchedule:
     def random(cls, seed: int, n_steps: int, rate: float = 0.05,
                kinds: Optional[List[str]] = None) -> "FaultSchedule":
         """Seeded random schedule: each step draws a fault with probability
-        ``rate``, kind uniform over ``kinds``.  Same seed → same schedule,
-        so a failing soak replays exactly."""
-        kinds = list(kinds or FaultKind.ALL)
+        ``rate``, kind uniform over ``kinds`` (default: the trainer-
+        injectable kinds).  Same seed → same schedule, so a failing soak
+        replays exactly."""
+        kinds = list(kinds or FaultKind.TRAINER_KINDS)
         rng = np.random.default_rng(seed)
         faults: Dict[int, List[str]] = {}
         for step in range(1, n_steps + 1):
@@ -293,6 +325,63 @@ class ChaosInjector:
         os.kill(os.getpid(), sig)
         # SIGSTOP parks the process here until the launcher SIGKILLs (or
         # SIGCONTs) it; SIGKILL never returns
+
+
+class ServingChaos:
+    """Deterministic fault injection for the serving engine — the
+    serving analog of :class:`ChaosInjector`.
+
+    The schedule is keyed by the engine's GLOBAL batch-execution index
+    (1-based: the first batch any replica dequeues is 1, counted across
+    all replicas under a lock, so a schedule replays deterministically
+    for a deterministic workload).  Only ENGINE-side kinds are legal
+    here (``replica_crash``, ``replica_hang``); driver-side kinds
+    (``poison_input``, ``bad_version``) are injected by the workload
+    itself — see scripts/serving_chaos_soak.py.
+
+    Arm it with ``Engine(..., chaos=ServingChaos(schedule))``.  A
+    ``replica_crash`` raises out of the replica loop so the thread
+    genuinely dies with its batch in limbo; a ``replica_hang`` parks the
+    replica thread in a sleep longer than the engine's
+    ``forward_timeout_s`` — both must be recovered by the supervisor.
+    """
+
+    def __init__(self, schedule: FaultSchedule, hang_seconds: float = 2.0,
+                 sleep_fn: Callable[[float], None] = time.sleep):
+        for kinds in schedule.faults.values():
+            for kind in kinds:
+                if kind not in FaultKind.SERVING_ENGINE_KINDS:
+                    raise ValueError(
+                        f"{kind!r} is not an engine-side serving fault — "
+                        f"ServingChaos takes {FaultKind.SERVING_ENGINE_KINDS}"
+                        "; poison_input/bad_version are injected by the "
+                        "workload driver")
+        self.schedule = schedule
+        self.hang_seconds = hang_seconds
+        self.sleep_fn = sleep_fn
+        self.batch_index = 0
+        self.events: List[dict] = []
+        self._lock = threading.Lock()
+
+    def pop_batch(self, replica_idx: int) -> List[str]:
+        """Faults scheduled for the next global batch index, consumed.
+        Called by every replica thread as it dequeues a batch."""
+        with self._lock:
+            self.batch_index += 1
+            kinds = self.schedule.pop(self.batch_index)
+            for kind in kinds:
+                self.events.append({"batch": self.batch_index, "kind": kind,
+                                    "replica": replica_idx,
+                                    "t": time.monotonic()})
+                logger.warning("serving chaos @batch %d: %s (replica %d)",
+                               self.batch_index, kind, replica_idx)
+        return kinds
+
+    def injected(self, kind: Optional[str] = None) -> int:
+        with self._lock:
+            if kind is None:
+                return len(self.events)
+            return sum(1 for e in self.events if e["kind"] == kind)
 
 
 def _poison_dataset(ds):

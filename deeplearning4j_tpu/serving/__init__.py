@@ -7,12 +7,18 @@ remains as a thin back-compat shim over this engine).  Pieces:
                buckets, admission control (block/shed)
   registry.py  versioned model registry, alias pinning ("prod" -> v7),
                hot-swap that drains in-flight batches, rollback = alias
-               move; loads serializer FORMAT_VERSION 1-4 checkpoints
+               move, canary promotion with auto-rollback
+               (set_alias(..., canary=frac)); loads serializer
+               FORMAT_VERSION 1-4 checkpoints
   engine.py    N engine replicas over jax.local_devices(), round-robin
                dispatch with per-replica in-flight caps, AOT warmup of
-               every (bucket, dtype) pair at load
-  metrics.py   fixed-bucket latency histograms + counters, exported on
-               ui/server.py's /metrics endpoint
+               every (bucket, dtype) pair at load; replica supervision
+               (crash/hang detect → retry elsewhere → respawn+re-warm,
+               per-replica circuit breaker), poison-input bisection,
+               typed request errors — every future always resolves
+  metrics.py   fixed-bucket latency histograms + counters (incl. retry/
+               respawn/circuit/canary/poison), exported on ui/server.py's
+               /metrics endpoint (health on /healthz)
 
 Reference lineage: DL4J's ParallelInference BATCHED mode + the model-
 server role; design cf. the serving sections of "TensorFlow: A system
@@ -24,12 +30,16 @@ from .batcher import (
     ADMISSION_POLICIES, DeadlineExceededError, DynamicBatcher,
     OverloadedError, pow2_buckets,
 )
-from .engine import Engine
+from .engine import (
+    Engine, PoisonInputError, ReplicaCrashError, ReplicaHungError,
+    ServingUnavailableError,
+)
 from .metrics import LatencyHistogram, ServingMetrics
 from .registry import ModelRegistry
 
 __all__ = [
     "ADMISSION_POLICIES", "DeadlineExceededError", "DynamicBatcher",
     "Engine", "LatencyHistogram", "ModelRegistry", "OverloadedError",
-    "ServingMetrics", "pow2_buckets",
+    "PoisonInputError", "ReplicaCrashError", "ReplicaHungError",
+    "ServingMetrics", "ServingUnavailableError", "pow2_buckets",
 ]

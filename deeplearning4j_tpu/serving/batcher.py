@@ -47,7 +47,8 @@ class OverloadedError(RuntimeError):
 
 
 class _Request:
-    __slots__ = ("x", "rows", "future", "t_submit", "deadline")
+    __slots__ = ("x", "rows", "future", "t_submit", "deadline",
+                 "retries", "tried")
 
     def __init__(self, x: np.ndarray, future: Future, t_submit: float,
                  deadline: float):
@@ -56,6 +57,8 @@ class _Request:
         self.future = future
         self.t_submit = t_submit
         self.deadline = deadline
+        self.retries = 0          # failure-isolation retries consumed
+        self.tried = set()        # replica indices that failed this request
 
 
 def pow2_buckets(max_batch: int) -> List[int]:

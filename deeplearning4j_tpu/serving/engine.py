@@ -1,11 +1,14 @@
-"""Replicated serving engine: dispatch, AOT warmup, hot-swap.
+"""Replicated serving engine: dispatch, AOT warmup, hot-swap, resilience.
 
 Topology (one Engine):
 
     callers ──submit──▶ DynamicBatcher ──next_batch──▶ dispatcher thread
-        ──round-robin (per-replica in-flight cap)──▶ replica queues
+        ──round-robin over DISPATCHABLE replicas (per-replica in-flight
+          cap, health + circuit breaker)──▶ replica queues
         ──▶ replica threads (one per replica, params device_put onto
             jax.local_devices()[i]) ──▶ futures resolve
+    supervisor thread ──▶ detects crashed/hung replica threads, completes
+        or retries their in-flight futures, respawns + re-warms them
 
 Model versions are immutable `_ModelVersion` snapshots: every batch
 reads the CURRENT version exactly once (under the version lock) before
@@ -21,6 +24,29 @@ cache (`_cache_size()`); tests assert it does not grow while serving.
 Models without a jit-able forward (ComputationGraph, arbitrary duck-
 typed `.output` models) fall back to calling `model.output` — warmup
 still pre-triggers their compiles, only the counter is unavailable.
+
+Failure model (docs/SERVING.md "Failure model"):
+
+- Every submitted future ALWAYS completes — with a result or a typed
+  error, never stranded.  A replica thread that dies or hangs mid-batch
+  is detected by the supervisor (bounded ``forward_timeout_s``, the
+  serving analog of ElasticTrainer's step watchdog), its in-flight
+  requests are retried once on a DIFFERENT replica when their deadline
+  still allows (else failed with `ReplicaCrashError`/`ReplicaHungError`),
+  and the replica is respawned with an AOT re-warm pass (zero new
+  compiles — executables live in the version's jit cache).
+- K consecutive replica failures trip a per-replica circuit breaker:
+  the dispatcher routes around the replica until ``breaker_cooldown_s``
+  passes, then half-opens it (one probe batch; success closes it).
+- A batch whose forward produces non-finite outputs is BISECTED and
+  re-executed to isolate the poison request(s): co-batched requests
+  still succeed, the poison request fails with `PoisonInputError`.
+- Canary promotion (`run_canary`, driven by
+  ``registry.set_alias(..., canary=frac)``) mirrors a deterministic
+  fraction of live batches to the incoming version as shadow traffic,
+  compares error rate / p99 / prediction divergence against the
+  incumbent over a decision window, and either completes the hot-swap
+  or auto-rolls-back.
 """
 
 from __future__ import annotations
@@ -37,6 +63,49 @@ from .batcher import DeadlineExceededError, DynamicBatcher, _Request
 from .metrics import ServingMetrics
 
 _SENTINEL = object()
+
+# engine-side serving chaos kinds (string literals, not an import — the
+# chaos module lives in parallel/ and must stay import-independent of
+# serving/; parallel.chaos.FaultKind defines the same constants)
+_CHAOS_CRASH = "replica_crash"
+_CHAOS_HANG = "replica_hang"
+
+
+class ReplicaCrashError(RuntimeError):
+    """The replica thread executing this request died; the request's
+    deadline (or retry budget) did not allow a retry elsewhere."""
+
+
+class ReplicaHungError(RuntimeError):
+    """The replica executing this request exceeded ``forward_timeout_s``
+    and was abandoned; no retry was possible within the deadline."""
+
+
+class PoisonInputError(RuntimeError):
+    """This request's input made the forward produce non-finite outputs
+    (isolated by batch bisection — co-batched requests were unaffected)."""
+
+
+class ServingUnavailableError(RuntimeError):
+    """No dispatchable replica (all dead or circuit-broken)."""
+
+
+def _fail_safe(fut: Future, exc: BaseException) -> None:
+    if not fut.done():
+        try:
+            fut.set_exception(exc)
+        except Exception:   # lost a completion race — already resolved
+            pass
+
+
+def _set_safe(fut: Future, value) -> bool:
+    if not fut.done():
+        try:
+            fut.set_result(value)
+            return True
+        except Exception:
+            pass
+    return False
 
 
 def _jitable(model) -> bool:
@@ -84,6 +153,19 @@ class _ModelVersion:
             return None
 
 
+class _Execution:
+    """One batch execution's claim on a model version.  ``release`` is
+    idempotent so the supervisor (abandoning a hung incarnation) and the
+    executing thread's ``finally`` can both call it — the version's
+    active count is decremented exactly once."""
+
+    __slots__ = ("version", "released")
+
+    def __init__(self, version: _ModelVersion):
+        self.version = version
+        self.released = False
+
+
 class _Replica:
     def __init__(self, idx: int, device, inflight_cap: int):
         self.idx = idx
@@ -91,6 +173,41 @@ class _Replica:
         self.queue: "queue.Queue" = queue.Queue(maxsize=max(1, inflight_cap))
         self.thread: Optional[threading.Thread] = None
         self.processed = 0
+        # supervision state — all mutated under `lock`
+        self.lock = threading.Lock()
+        self.generation = 0              # bumped on every abandon/respawn
+        self.current_batch: Optional[List[_Request]] = None
+        self.busy_since: Optional[float] = None
+        self.execution: Optional[_Execution] = None
+        self.consecutive_failures = 0
+        self.breaker_open = False
+        self.breaker_open_until = 0.0
+        self.respawns = 0
+
+
+class _CanaryState:
+    """Shadow-traffic measurement window for one canary candidate."""
+
+    def __init__(self, version: _ModelVersion, frac: float, window: int):
+        self.version = version
+        self.frac = float(frac)
+        self.window = int(window)
+        self.lock = threading.Lock()
+        self.eligible = 0
+        self.mirrored = 0
+        self.canary_ms: List[float] = []
+        self.incumbent_ms: List[float] = []
+        self.canary_errors = 0
+        self.divergences: List[float] = []
+        self.done = threading.Event()
+
+    def select(self) -> bool:
+        """Deterministic traffic-fraction selection: mirror batch k iff
+        the integer part of k*frac advanced (so exactly ceil(frac*n) of
+        the first n eligible batches mirror, no RNG)."""
+        self.eligible += 1
+        return (int(self.eligible * self.frac)
+                > int((self.eligible - 1) * self.frac))
 
 
 class Engine:
@@ -105,6 +222,20 @@ class Engine:
         Replica *i* pins its params to ``jax.local_devices()[i % n]``.
     inflight_per_replica: per-replica dispatch-queue bound — the
         round-robin dispatcher skips a replica whose queue is full.
+    forward_timeout_s: if set, a replica whose batch executes longer
+        than this is declared HUNG: the supervisor abandons it, retries
+        its requests elsewhere, and respawns the replica (the serving
+        analog of ElasticTrainer's step watchdog).  None disables hang
+        detection (crash detection stays on).
+    max_retries: per-request retry budget after a replica failure or a
+        retryable forward error; retries go to a DIFFERENT replica when
+        one is available and never launch past the request's deadline.
+    breaker_threshold / breaker_cooldown_s: K consecutive failures trip
+        the replica's circuit breaker (dispatch routes around it);
+        after the cooldown it half-opens (one probe; success closes it).
+    poison_isolation: bisect batches whose forward output is non-finite
+        to isolate the poison request (co-batched requests succeed).
+    chaos: an armed ``parallel.chaos.ServingChaos`` (tests/soaks only).
     """
 
     def __init__(self, model=None, *, registry=None, name: Optional[str] = None,
@@ -114,6 +245,12 @@ class Engine:
                  admission: str = "block", inflight_per_replica: int = 2,
                  max_wait_ms: Optional[float] = None,
                  metrics: Optional[ServingMetrics] = None,
+                 forward_timeout_s: Optional[float] = None,
+                 max_retries: int = 1, breaker_threshold: int = 3,
+                 breaker_cooldown_s: float = 1.0,
+                 supervise_interval_s: float = 0.02,
+                 poison_isolation: bool = True,
+                 chaos=None,
                  clock=time.monotonic):
         import jax
 
@@ -130,6 +267,13 @@ class Engine:
             max_queue=max_queue, admission=admission,
             max_wait_ms=max_wait_ms, metrics=self.metrics, clock=clock)
         self.clock = clock
+        self.forward_timeout_s = forward_timeout_s
+        self.max_retries = int(max_retries)
+        self.breaker_threshold = int(breaker_threshold)
+        self.breaker_cooldown_s = float(breaker_cooldown_s)
+        self.supervise_interval_s = float(supervise_interval_s)
+        self.poison_isolation = bool(poison_isolation)
+        self._chaos = chaos
         devices = jax.local_devices()
         n = len(devices) if replicas in (-1, 0) else int(replicas)
         if n < 1:
@@ -141,6 +285,8 @@ class Engine:
         self._vlock = threading.Lock()
         self._swap_lock = threading.Lock()
         self._current = _ModelVersion(model, tag, self._devices)
+        self._canary: Optional[_CanaryState] = None
+        self._canary_log: List[dict] = []
         self._warmed: set = set()       # (bucket, dtype_str) pairs
         self._example_shape: Optional[Tuple[int, ...]] = None
         self._warm_dtypes: Tuple[str, ...] = ("float32",)
@@ -151,14 +297,17 @@ class Engine:
         if registry is not None and name is not None:
             registry.subscribe(
                 name, ref,
-                lambda version, m: self.swap_model(m, tag=f"{name}:v{version}"))
+                lambda version, m: self.swap_model(m, tag=f"{name}:v{version}"),
+                canary=lambda version, m, **kw: self.run_canary(
+                    m, tag=f"{name}:v{version}", **kw))
         for r in self._replicas:
-            r.thread = threading.Thread(target=self._replica_loop, args=(r,),
-                                        daemon=True)
-            r.thread.start()
+            self._start_replica_thread(r)
         self._dispatcher = threading.Thread(target=self._dispatch_loop,
                                             daemon=True)
         self._dispatcher.start()
+        self._supervisor = threading.Thread(target=self._supervise_loop,
+                                            daemon=True)
+        self._supervisor.start()
 
     @classmethod
     def from_registry(cls, registry, name: str, ref: str = "prod",
@@ -213,11 +362,31 @@ class Engine:
                 self.batcher.observe_exec_ms(b, (self.clock() - t0) * 1e3)
                 self._warmed.add((b, str(np.dtype(dtype))))
 
+    def _rewarm_replica(self, idx: int) -> None:
+        """Re-warm one (respawned) replica: run every warmed (bucket,
+        dtype) pair once on its device, for the current AND any canary
+        version.  Executables already live in each version's jit cache,
+        so this is a cache-hit pass — zero new compiles (the respawn
+        contract) — that doubles as a health probe."""
+        if self._example_shape is None:
+            return
+        with self._vlock:
+            versions = [self._current]
+        can = self._canary
+        if can is not None:
+            versions.append(can.version)
+        for dtype in self._warm_dtypes:
+            for b in self.batcher.buckets:
+                x = np.zeros((b,) + self._example_shape, dtype=dtype)
+                for v in versions:
+                    np.asarray(self._run_forward(v, idx, x))
+
     def compile_cache_size(self) -> Optional[int]:
         """Number of compiled executables backing the CURRENT version's
         forward (None for non-jit-able models).  After ``load()`` this
         must not grow while serving bucket-shaped requests — the
-        zero-compiles-at-serve-time contract."""
+        zero-compiles-at-serve-time contract (also across replica
+        respawns: re-warm is a cache-hit pass)."""
         with self._vlock:
             return self._current.cache_size()
 
@@ -232,6 +401,16 @@ class Engine:
 
     # -- dispatch ----------------------------------------------------------
 
+    def _dispatchable(self, r: _Replica, now: float) -> bool:
+        """Health gate for routing: thread alive AND breaker closed (or
+        past its cooldown — half-open: the next batch is the probe)."""
+        with r.lock:
+            if r.thread is None or not r.thread.is_alive():
+                return False
+            if r.breaker_open and now < r.breaker_open_until:
+                return False
+        return True
+
     def _dispatch_loop(self) -> None:
         rr = 0
         n = len(self._replicas)
@@ -239,29 +418,105 @@ class Engine:
             batch = self.batcher.next_batch()
             if batch is None:
                 break
-            placed = False
-            for k in range(n):  # round-robin, skipping full replicas
-                r = self._replicas[(rr + k) % n]
-                try:
-                    r.queue.put_nowait(batch)
-                    rr = (rr + k + 1) % n
-                    placed = True
-                    break
-                except queue.Full:
-                    continue
-            if not placed:  # all at their in-flight cap: backpressure
-                self._replicas[rr].queue.put(batch)
-                rr = (rr + 1) % n
+            rr = self._place_batch(batch, rr, n)
         for r in self._replicas:
             r.queue.put(_SENTINEL)
 
-    def _replica_loop(self, replica: _Replica) -> None:
+    def _place_batch(self, batch: List[_Request], rr: int, n: int) -> int:
+        """Round-robin placement skipping unhealthy/full replicas; waits
+        (expiring deadlines) when nothing is dispatchable, fails the
+        batch deterministically on shutdown."""
+        while True:
+            if self._shutdown:
+                for req in batch:
+                    _fail_safe(req.future,
+                               RuntimeError("serving engine is shut down"))
+                return rr
+            now = self.clock()
+            batch = self._expire_batch(batch, now)
+            if not batch:
+                return rr
+            candidates = [self._replicas[(rr + k) % n] for k in range(n)]
+            dispatchable = [c for c in candidates
+                            if self._dispatchable(c, now)]
+            for c in dispatchable:
+                try:
+                    c.queue.put_nowait(batch)
+                    return (c.idx + 1) % n
+                except queue.Full:
+                    continue
+            if dispatchable:
+                # every healthy replica at its in-flight cap: backpressure
+                c = dispatchable[0]
+                try:
+                    c.queue.put(batch, timeout=0.1)
+                    return (c.idx + 1) % n
+                except queue.Full:
+                    continue
+            # nothing dispatchable (all dead / breakers open): wait for
+            # the supervisor to respawn, expiring deadlines meanwhile
+            time.sleep(0.005)
+
+    def _expire_batch(self, batch: List[_Request],
+                      now: float) -> List[_Request]:
+        live = []
+        expired = 0
+        for r in batch:
+            if r.future.done():
+                continue
+            if r.deadline < now:
+                expired += 1
+                _fail_safe(r.future, DeadlineExceededError(
+                    f"deadline passed after "
+                    f"{(now - r.t_submit) * 1e3:.1f}ms"))
+            else:
+                live.append(r)
+        if expired:
+            self.metrics.inc("deadline_missed", expired)
+        return live
+
+    def _start_replica_thread(self, r: _Replica) -> None:
+        with r.lock:
+            gen = r.generation
+            t = threading.Thread(target=self._replica_loop, args=(r, gen),
+                                 daemon=True)
+            r.thread = t
+        t.start()
+
+    def _replica_loop(self, replica: _Replica, gen: int) -> None:
         while True:
             item = replica.queue.get()
             if item is _SENTINEL:
                 break
-            self._execute(item, replica)
-            replica.processed += 1
+            with replica.lock:
+                if gen != replica.generation:
+                    # abandoned while blocked in get(): hand the batch to
+                    # the live incarnation and exit
+                    replica.queue.put(item)
+                    return
+                replica.current_batch = item
+                replica.busy_since = self.clock()
+            if self._chaos is not None:
+                kinds = self._chaos.pop_batch(replica.idx)
+                if _CHAOS_CRASH in kinds:
+                    # simulated thread death: exit with the batch still in
+                    # limbo (current_batch set, futures unresolved) — the
+                    # supervisor must detect, complete/retry, and respawn
+                    return
+                if _CHAOS_HANG in kinds:
+                    self._chaos.sleep_fn(self._chaos.hang_seconds)
+                    with replica.lock:
+                        if gen != replica.generation:
+                            return      # supervisor abandoned us mid-hang
+            self._execute(item, replica, gen)
+            with replica.lock:
+                if gen != replica.generation:
+                    return              # abandoned mid-execute; successor runs
+                replica.current_batch = None
+                replica.busy_since = None
+                replica.processed += 1
+
+    # -- execution ---------------------------------------------------------
 
     def _run_forward(self, v: _ModelVersion, replica_idx: int, xs: np.ndarray):
         if v.fwd is not None:
@@ -269,53 +524,72 @@ class Engine:
         out = v.model.output(xs)
         return out[0] if isinstance(out, list) else out
 
-    def _execute(self, batch: List[_Request], replica: _Replica) -> None:
-        now = self.clock()
-        live = []
-        expired = 0
-        for r in batch:  # deadlines re-checked at execution start — the
-            if r.deadline < now:  # batch may have sat in the replica queue
-                expired += 1
-                if not r.future.done():
-                    r.future.set_exception(DeadlineExceededError(
-                        f"deadline passed after "
-                        f"{(now - r.t_submit) * 1e3:.1f}ms"))
-            else:
-                live.append(r)
-        if expired:
-            self.metrics.inc("deadline_missed", expired)
-        if not live:
-            return
-        for r in live:
-            self.metrics.queue_wait.record((now - r.t_submit) * 1e3)
-        xs = (live[0].x if len(live) == 1
-              else np.concatenate([r.x for r in live], axis=0))
+    def _forward_padded(self, v: _ModelVersion, replica_idx: int,
+                        reqs: List[_Request],
+                        count_unwarmed: bool = True) -> Tuple[np.ndarray,
+                                                              int, int, int]:
+        """Concat + pad ``reqs`` to their bucket, run the forward, and
+        return (out rows for the requests, rows, bucket, padded)."""
+        xs = (reqs[0].x if len(reqs) == 1
+              else np.concatenate([r.x for r in reqs], axis=0))
         rows = xs.shape[0]
         bucket = self.batcher.bucket_for(rows)
         padded = bucket - rows
         if padded:
             pad = np.zeros((padded,) + xs.shape[1:], xs.dtype)
             xs = np.concatenate([xs, pad], axis=0)
-        if self._loaded and (bucket, str(xs.dtype)) not in self._warmed:
+        if (count_unwarmed and self._loaded
+                and (bucket, str(xs.dtype)) not in self._warmed):
             self.metrics.inc("unwarmed_serves")
+        out = np.asarray(self._run_forward(v, replica_idx, xs))
+        return out[:rows], rows, bucket, padded
+
+    def _execute(self, batch: List[_Request], replica: _Replica,
+                 gen: int) -> None:
+        now = self.clock()
+        live = self._expire_batch(batch, now)
+        if not live:
+            return
+        for r in live:
+            self.metrics.queue_wait.record((now - r.t_submit) * 1e3)
         with self._vlock:
             v = self._current
             v.active += 1
+        ex = _Execution(v)
+        with replica.lock:
+            replica.execution = ex
         t0 = self.clock()
         try:
-            out = np.asarray(self._run_forward(v, replica.idx, xs))
+            out, rows, bucket, padded = self._forward_padded(
+                v, replica.idx, live)
+            device_ms = (self.clock() - t0) * 1e3
+            if self.poison_isolation and not np.isfinite(out).all():
+                # non-finite forward: bisect to isolate the poison
+                # request(s) so co-batched requests still succeed
+                self._isolate_poison(v, replica, live, precomputed=out)
+                self.metrics.record_batch(len(live), rows, padded, device_ms)
+                return
         except Exception as e:
             self.metrics.inc("errors")
-            for r in live:
-                if not r.future.done():
-                    r.future.set_exception(e)
+            self._retry_or_fail(live, replica.idx, e)
             return
         finally:
-            with self._vlock:
-                v.active -= 1
-                if v.retired and v.active == 0:
-                    v.drained.set()
-        device_ms = (self.clock() - t0) * 1e3
+            self._release(ex)
+            with replica.lock:
+                replica.execution = None
+        with replica.lock:
+            abandoned = gen != replica.generation
+            if not abandoned and (replica.consecutive_failures
+                                  or replica.breaker_open):
+                # a completed batch is the half-open probe succeeding:
+                # close the breaker, forget the failure streak
+                replica.consecutive_failures = 0
+                replica.breaker_open = False
+        if abandoned:
+            # the supervisor already redispatched this batch to another
+            # replica — discard this late result (futures are one-shot,
+            # so even a completion race is harmless)
+            return
         self.batcher.observe_exec_ms(bucket, device_ms)
         self.metrics.record_batch(len(live), rows, padded, device_ms)
         with self._log_lock:
@@ -327,9 +601,349 @@ class Engine:
         done = self.clock()
         ofs = 0
         for r in live:
-            r.future.set_result(out[ofs:ofs + r.rows])
+            _set_safe(r.future, out[ofs:ofs + r.rows])
             ofs += r.rows
             self.metrics.e2e.record((done - r.t_submit) * 1e3)
+        can = self._canary
+        if can is not None and not can.done.is_set():
+            self._mirror_canary(can, replica, live, out, device_ms)
+
+    def _isolate_poison(self, v: _ModelVersion, replica: _Replica,
+                        reqs: List[_Request],
+                        precomputed: Optional[np.ndarray] = None) -> None:
+        """Bisection: resolve every request in ``reqs`` with a result or
+        `PoisonInputError`.  Re-executes halves (bucket-shaped, so still
+        zero new compiles) until each non-finite output is pinned to a
+        single request; sub-batches that come back finite complete all
+        their requests — one poison request cannot fail its batch-mates.
+        Works even for models where a poison row contaminates the whole
+        batch output (e.g. cross-batch normalization)."""
+        if precomputed is not None:
+            out = precomputed
+        else:
+            out, _, _, _ = self._forward_padded(v, replica.idx, reqs,
+                                                count_unwarmed=False)
+        ofs = 0
+        finite = []
+        for r in reqs:
+            finite.append(bool(np.isfinite(out[ofs:ofs + r.rows]).all()))
+            ofs += r.rows
+        if all(finite):
+            done = self.clock()
+            ofs = 0
+            for r in reqs:
+                _set_safe(r.future, out[ofs:ofs + r.rows])
+                ofs += r.rows
+                self.metrics.e2e.record((done - r.t_submit) * 1e3)
+            return
+        if len(reqs) == 1:
+            self.metrics.inc("poison_isolated")
+            _fail_safe(reqs[0].future, PoisonInputError(
+                "request input produced non-finite outputs (isolated by "
+                "batch bisection)"))
+            return
+        mid = max(1, len(reqs) // 2)
+        self._isolate_poison(v, replica, reqs[:mid])
+        self._isolate_poison(v, replica, reqs[mid:])
+
+    # -- failure isolation + retry -----------------------------------------
+
+    def _release(self, ex: _Execution) -> None:
+        with self._vlock:
+            if ex.released:
+                return
+            ex.released = True
+            v = ex.version
+            v.active -= 1
+            if v.retired and v.active == 0:
+                v.drained.set()
+
+    def _retry_or_fail(self, reqs: List[_Request], failed_idx: int,
+                       error: BaseException) -> None:
+        """Deadline-aware bounded retry: requests with retry budget AND
+        enough deadline slack for another execution are redispatched to
+        a different replica; the rest fail with the typed error.  Every
+        future resolves — nothing is ever stranded."""
+        now = self.clock()
+        retry = []
+        for r in reqs:
+            if r.future.done():
+                continue
+            budget_s = self.batcher._exec_budget_ms(r.rows) / 1000.0
+            if (r.retries < self.max_retries
+                    and r.deadline - now > budget_s):
+                r.retries += 1
+                r.tried.add(failed_idx)
+                retry.append(r)
+            else:
+                _fail_safe(r.future, error)
+        if not retry:
+            return
+        self.metrics.inc("retries", len(retry))
+        self._redispatch(retry)
+
+    def _redispatch(self, reqs: List[_Request]) -> None:
+        """Place retried requests on a healthy replica, preferring one
+        that has not already failed them; expires deadlines while
+        waiting and fails deterministically on shutdown.  Under backlog
+        the dispatcher refills replica queues the instant a slot frees,
+        so a pure ``put_nowait`` poll can starve — use a short BLOCKING
+        put (enters the queue's waiter list, competing fairly) and drop
+        the different-replica preference after a few failed rounds
+        rather than starve the retry until its deadline."""
+        tried = set()
+        for r in reqs:
+            tried |= r.tried
+        rounds = 0
+        while True:
+            if self._shutdown:
+                for r in reqs:
+                    _fail_safe(r.future,
+                               RuntimeError("serving engine is shut down"))
+                return
+            now = self.clock()
+            reqs = self._expire_batch(reqs, now)
+            if not reqs:
+                return
+            candidates = [c for c in self._replicas
+                          if self._dispatchable(c, now)]
+            preferred = ([c for c in candidates
+                          if c.idx not in tried] if rounds < 3 else []) \
+                or candidates
+            for c in preferred[1:]:
+                try:
+                    c.queue.put_nowait(list(reqs))
+                    return
+                except queue.Full:
+                    continue
+            if preferred:
+                try:
+                    preferred[0].queue.put(list(reqs), timeout=0.05)
+                    return
+                except queue.Full:
+                    pass
+            else:
+                time.sleep(0.005)
+            rounds += 1
+
+    # -- supervision -------------------------------------------------------
+
+    def _supervise_loop(self) -> None:
+        while not self._shutdown:
+            time.sleep(self.supervise_interval_s)
+            if self._shutdown:
+                return
+            now = self.clock()
+            for r in self._replicas:
+                if self._shutdown:
+                    return
+                self._check_replica(r, now)
+
+    def _check_replica(self, r: _Replica, now: float) -> None:
+        with r.lock:
+            if self._shutdown:
+                # a sentinel-exited thread is a clean shutdown, not a
+                # crash — never respawn into a closing engine
+                return
+            crashed = (r.thread is not None and not r.thread.is_alive())
+            hung = (not crashed
+                    and self.forward_timeout_s is not None
+                    and r.busy_since is not None
+                    and now - r.busy_since > self.forward_timeout_s)
+            if not crashed and not hung:
+                return
+            batch = r.current_batch
+            ex = r.execution
+            r.current_batch = None
+            r.busy_since = None
+            r.execution = None
+            r.generation += 1       # any late wake-up is now a no-op
+            r.consecutive_failures += 1
+            r.respawns += 1
+            opened = False
+            if (r.consecutive_failures >= self.breaker_threshold
+                    and not r.breaker_open):
+                r.breaker_open = True
+                opened = True
+            if r.breaker_open:
+                r.breaker_open_until = now + self.breaker_cooldown_s
+        if ex is not None:
+            self._release(ex)       # idempotent vs the hung finally
+        self.metrics.inc("replica_crashes" if crashed else "replica_hangs")
+        if opened:
+            self.metrics.inc("circuit_opens")
+        # respawn FIRST so the retry path has a live target even with a
+        # single replica...
+        self._start_replica_thread(r)
+        error: RuntimeError = (ReplicaCrashError(
+            f"replica {r.idx} thread died mid-batch")
+            if crashed else ReplicaHungError(
+                f"replica {r.idx} exceeded forward_timeout_s="
+                f"{self.forward_timeout_s}"))
+        # ...then recover OFF the supervisor thread: re-warm and retry
+        # can block (device time, backpressured queues) and the
+        # supervisor must keep scanning — a crash recovery that stalls
+        # hang detection on the OTHER replica would strand its batch
+        # until the hang resolves itself
+        threading.Thread(target=self._recover_replica,
+                         args=(r, batch, error), daemon=True).start()
+
+    def _recover_replica(self, r: _Replica, batch: Optional[List[_Request]],
+                         error: RuntimeError) -> None:
+        try:
+            self._rewarm_replica(r.idx)   # cache-hit pass: zero compiles
+        except Exception:
+            # the replica will fail its next batch and re-enter the
+            # supervisor; the breaker bounds how often we retry
+            pass
+        self.metrics.inc("replica_respawns")
+        if batch:
+            self._retry_or_fail(
+                [q for q in batch if not q.future.done()], r.idx, error)
+
+    def health_snapshot(self) -> dict:
+        """Per-replica health (healthy/degraded/dead) + readiness.
+        ``status``: "ok" (all healthy), "degraded" (≥1 dispatchable),
+        "unready" (none dispatchable — or shut down)."""
+        now = self.clock()
+        reps = []
+        n_healthy = n_dispatchable = 0
+        for r in self._replicas:
+            with r.lock:
+                alive = r.thread is not None and r.thread.is_alive()
+                cooling = r.breaker_open and now < r.breaker_open_until
+                if not alive or cooling:
+                    h = "dead"
+                elif r.breaker_open or r.consecutive_failures:
+                    h = "degraded"      # half-open / recent failures
+                else:
+                    h = "healthy"
+                reps.append({
+                    "replica": r.idx, "health": h, "alive": alive,
+                    "busy": r.busy_since is not None,
+                    "consecutive_failures": r.consecutive_failures,
+                    "breaker_open": r.breaker_open,
+                    "respawns": r.respawns, "processed": r.processed,
+                })
+            if h == "healthy":
+                n_healthy += 1
+            if h != "dead":
+                n_dispatchable += 1
+        if self._shutdown or n_dispatchable == 0:
+            status = "unready"
+        elif n_healthy == len(self._replicas):
+            status = "ok"
+        else:
+            status = "degraded"
+        return {"status": status, "ready": status != "unready",
+                "replicas": reps}
+
+    # -- canary ------------------------------------------------------------
+
+    def _mirror_canary(self, can: _CanaryState, replica: _Replica,
+                       reqs: List[_Request], incumbent_out: np.ndarray,
+                       incumbent_ms: float) -> None:
+        """Shadow one live batch to the canary version AFTER the
+        incumbent's results are already delivered (user latency is never
+        behind the canary forward) and record the comparison."""
+        with can.lock:
+            if can.done.is_set() or not can.select():
+                return
+        err = False
+        div = None
+        t0 = self.clock()
+        try:
+            out, _, _, _ = self._forward_padded(can.version, replica.idx,
+                                                reqs, count_unwarmed=False)
+            if not np.isfinite(out).all():
+                err = True
+            elif out.shape == incumbent_out.shape:
+                div = float(np.mean(np.abs(out - incumbent_out)))
+        except Exception:
+            err = True
+        ms = (self.clock() - t0) * 1e3
+        self.metrics.inc("canary_mirrored_batches")
+        with can.lock:
+            can.mirrored += 1
+            can.canary_ms.append(ms)
+            can.incumbent_ms.append(incumbent_ms)
+            if err:
+                can.canary_errors += 1
+            if div is not None:
+                can.divergences.append(div)
+            if can.mirrored >= can.window:
+                can.done.set()
+
+    def run_canary(self, model, tag: Optional[str] = None, *,
+                   frac: float = 0.2, window: int = 32,
+                   timeout_s: float = 60.0, max_error_rate: float = 0.0,
+                   p99_factor: float = 3.0,
+                   max_divergence: Optional[float] = None) -> dict:
+        """Canary the incoming ``model`` against the incumbent: mirror a
+        deterministic ``frac`` of live batches to it as shadow traffic,
+        compare error rate / p99 exec / prediction divergence over a
+        ``window`` of mirrored batches, then either complete the
+        hot-swap (promote) or auto-roll-back.  Blocks until the window
+        fills or ``timeout_s`` passes (an unfilled window is a rollback
+        — an unjudged version is never promoted).  Returns the decision
+        dict; usually driven via ``registry.set_alias(..., canary=)``."""
+        if self._canary is not None:
+            raise RuntimeError("a canary evaluation is already running")
+        nv = _ModelVersion(model, tag or f"canary@{time.time():.0f}",
+                           self._devices)
+        if self._loaded:
+            self._warm_version(nv)
+        can = _CanaryState(nv, frac, window)
+        self._canary = can
+        try:
+            can.done.wait(timeout_s)
+        finally:
+            self._canary = None     # no more mirrors record into `can`
+        with can.lock:
+            mirrored = can.mirrored
+            errors = can.canary_errors
+            c_ms = list(can.canary_ms)
+            i_ms = list(can.incumbent_ms)
+            divs = list(can.divergences)
+        err_rate = errors / mirrored if mirrored else None
+        p99_c = float(np.percentile(c_ms, 99)) if c_ms else None
+        p99_i = float(np.percentile(i_ms, 99)) if i_ms else None
+        mean_div = float(np.mean(divs)) if divs else None
+        reasons = []
+        if mirrored < window:
+            reasons.append(f"window incomplete ({mirrored}/{window} "
+                           "mirrored batches before timeout)")
+        if err_rate is not None and err_rate > max_error_rate:
+            reasons.append(f"error rate {err_rate:.3f} > {max_error_rate}")
+        # the 1ms floor keeps clock-resolution noise on sub-ms forwards
+        # from vetoing a healthy canary (sub-3ms p99 is never a regression)
+        if (p99_c is not None and p99_i is not None
+                and p99_c > p99_factor * max(p99_i, 1.0)):
+            reasons.append(f"p99 {p99_c:.2f}ms > {p99_factor}x incumbent "
+                           f"{p99_i:.2f}ms")
+        if (max_divergence is not None and mean_div is not None
+                and mean_div > max_divergence):
+            reasons.append(f"prediction divergence {mean_div:.4f} > "
+                           f"{max_divergence}")
+        promote = not reasons
+        decision = {
+            "candidate": nv.tag, "incumbent": self.current_tag,
+            "promote": promote, "reasons": reasons,
+            "mirrored_batches": mirrored, "error_rate": err_rate,
+            "canary_p99_ms": round(p99_c, 3) if p99_c is not None else None,
+            "incumbent_p99_ms": (round(p99_i, 3) if p99_i is not None
+                                 else None),
+            "mean_divergence": (round(mean_div, 6) if mean_div is not None
+                                else None),
+        }
+        if promote:
+            self._swap_version(nv)      # already warmed: no extra compiles
+            self.metrics.inc("canary_promotions")
+        else:
+            self.metrics.inc("canary_rollbacks")
+        with self._log_lock:
+            self._canary_log.append(decision)
+        return decision
 
     # -- hot swap ----------------------------------------------------------
 
@@ -340,11 +954,14 @@ class Engine:
         requests keep their version; a batch never mixes two versions.
         Returns the retired version's tag (rollback = swap back, or an
         alias move in the registry)."""
+        nv = _ModelVersion(model, tag or f"swap@{time.time():.0f}",
+                           self._devices)
+        if self._loaded:
+            self._warm_version(nv)
+        return self._swap_version(nv)
+
+    def _swap_version(self, nv: _ModelVersion) -> str:
         with self._swap_lock:
-            nv = _ModelVersion(model, tag or f"swap@{time.time():.0f}",
-                               self._devices)
-            if self._loaded:
-                self._warm_version(nv)
             with self._vlock:
                 old = self._current
                 self._current = nv
@@ -369,6 +986,9 @@ class Engine:
         snap["queue_depth"] = self.batcher.qsize()
         snap["buckets"] = list(self.batcher.buckets)
         snap["compile_cache_size"] = self.compile_cache_size()
+        snap["health"] = self.health_snapshot()
+        with self._log_lock:
+            snap["canary_decisions"] = list(self._canary_log[-8:])
         return snap
 
     def shutdown(self, timeout: float = 5.0) -> None:
@@ -383,9 +1003,19 @@ class Engine:
         for r in self._replicas:
             if r.thread:
                 r.thread.join(timeout=timeout)
+        if self._supervisor:
+            self._supervisor.join(timeout=timeout)
         # anything still sitting in replica queues (threads died, or the
-        # sentinel raced a late dispatch) fails deterministically
+        # sentinel raced a late dispatch) fails deterministically —
+        # including an in-flight batch of a dead/hung replica
         for r in self._replicas:
+            with r.lock:
+                stranded = r.current_batch
+                r.current_batch = None
+            if stranded:
+                for req in stranded:
+                    _fail_safe(req.future,
+                               RuntimeError("serving engine is shut down"))
             while True:
                 try:
                     item = r.queue.get_nowait()
@@ -394,6 +1024,5 @@ class Engine:
                 if item is _SENTINEL:
                     continue
                 for req in item:
-                    if not req.future.done():
-                        req.future.set_exception(
-                            RuntimeError("serving engine is shut down"))
+                    _fail_safe(req.future,
+                               RuntimeError("serving engine is shut down"))

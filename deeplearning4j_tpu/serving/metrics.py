@@ -107,6 +107,13 @@ class ServingMetrics:
             "requests": 0, "rows": 0, "batches": 0, "padded_rows": 0,
             "shed": 0, "deadline_missed": 0, "errors": 0, "swaps": 0,
             "unwarmed_serves": 0,
+            # resilience counters (docs/SERVING.md "Failure model"):
+            # supervisor interventions, request retries, poison isolation,
+            # breaker trips, and canary promotion decisions
+            "replica_crashes": 0, "replica_hangs": 0, "replica_respawns": 0,
+            "retries": 0, "poison_isolated": 0, "circuit_opens": 0,
+            "canary_promotions": 0, "canary_rollbacks": 0,
+            "canary_mirrored_batches": 0,
         }
         self._batch_rows_max = 0
         self._t0 = time.monotonic()

@@ -18,6 +18,15 @@ pointer, and drained every in-flight batch on the outgoing one — so when
 it returns, no request is still executing the old version.  Batches
 never mix versions (each batch snapshots exactly one version).
 
+``set_alias(..., canary=frac)`` promotes THROUGH a canary instead of
+flipping immediately: every subscribed engine mirrors ``frac`` of its
+live traffic to the incoming version as shadow traffic (user results
+still come from the incumbent), compares error rate / p99 / prediction
+divergence over a decision window, and votes.  The alias moves only if
+EVERY engine votes promote; otherwise the promotion auto-rolls-back and
+the alias stays on the incumbent.  The decision (with per-engine stats)
+is returned and recorded in :meth:`canary_history`.
+
 Checkpoints load through ``utils/serializer.load_model`` and therefore
 accept every supported FORMAT_VERSION (1-4), including v4 integrity
 digests — a corrupt file raises instead of serving garbage.
@@ -36,8 +45,11 @@ class ModelRegistry:
         self._models: Dict[str, Dict[int, Any]] = {}
         self._aliases: Dict[str, Dict[str, int]] = {}
         self._lock = threading.Lock()
-        # (name, alias) -> [callback(version, model)]
-        self._subs: Dict[Tuple[str, str], List[Callable[[int, Any], None]]] = {}
+        # (name, alias) -> [(callback(version, model), canary_cb or None)]
+        self._subs: Dict[Tuple[str, str],
+                         List[Tuple[Callable[[int, Any], None],
+                                    Optional[Callable]]]] = {}
+        self._canary_log: Dict[str, List[dict]] = {}
 
     # -- registration ------------------------------------------------------
 
@@ -110,31 +122,94 @@ class ModelRegistry:
 
     # -- aliases + hot swap ------------------------------------------------
 
-    def set_alias(self, name: str, alias: str, version: int) -> Optional[int]:
+    def set_alias(self, name: str, alias: str, version: int,
+                  canary: Optional[float] = None,
+                  canary_window: int = 32,
+                  canary_timeout_s: float = 60.0,
+                  canary_thresholds: Optional[Dict[str, Any]] = None):
         """Atomically move ``alias`` to ``version`` and hot-swap every
         subscribed engine (synchronously — returns after old versions
         drained).  Returns the alias's previous version (None if new).
-        Rollback is just another ``set_alias`` to the old version."""
+        Rollback is just another ``set_alias`` to the old version.
+
+        With ``canary=frac`` (0 < frac <= 1) the move goes through a
+        canary evaluation first: each subscribed engine mirrors ``frac``
+        of its live traffic to the incoming version over
+        ``canary_window`` mirrored batches (bounded by
+        ``canary_timeout_s``), judged against ``canary_thresholds``
+        (``max_error_rate``, ``p99_factor``, ``max_divergence`` — see
+        ``Engine.run_canary``).  The alias moves only if every engine
+        votes promote; on any rollback vote the alias stays put.
+        Returns the decision record (also kept in
+        :meth:`canary_history`) instead of the previous version.
+        """
         with self._lock:
             if name not in self._models:
                 raise KeyError(f"no model named {name!r} registered")
             version = self._resolve_version_locked(name, version)
             amap = self._aliases.setdefault(name, {})
             prev = amap.get(alias)
-            amap[alias] = version
             model = self._models[name][version]
             subs = list(self._subs.get((name, alias), ()))
+            canary_subs = [c for _, c in subs if c is not None]
+            run_canary = (canary is not None and prev is not None
+                          and prev != version and canary_subs)
+            if not run_canary:
+                amap[alias] = version
+        if run_canary:
+            # canary path: the alias has NOT moved — engines judge the
+            # candidate on shadow traffic first (outside the lock: the
+            # decision window is live serving time)
+            thresholds = dict(canary_thresholds or {})
+            canary_pairs = [(cb, c) for cb, c in subs if c is not None]
+            decisions = [c(version, model, frac=canary,
+                           window=canary_window,
+                           timeout_s=canary_timeout_s, **thresholds)
+                         for _, c in canary_pairs]
+            promoted = all(d.get("promote") for d in decisions)
+            record = {"name": name, "alias": alias, "from": prev,
+                      "to": version, "promoted": promoted,
+                      "decisions": decisions}
+            with self._lock:
+                self._canary_log.setdefault(name, []).append(record)
+                if promoted:
+                    self._aliases[name][alias] = version
+                incumbent_model = self._models[name][prev]
+            if promoted:
+                # promote-voting engines already completed their hot-swap
+                # inside run_canary; plain (non-canary) subscribers still
+                # need the regular swap notification
+                for cb, canary_cb in subs:
+                    if canary_cb is None:
+                        cb(version, model)
+            else:
+                # unanimity failed: any engine whose individual vote was
+                # promote has already swapped — swap it back to the
+                # incumbent so the fleet stays version-consistent
+                for (cb, _), d in zip(canary_pairs, decisions):
+                    if d.get("promote"):
+                        cb(prev, incumbent_model)
+            return record
         if prev != version:
             # callbacks run OUTSIDE the registry lock: an engine's swap
             # blocks on draining in-flight batches, whose replica threads
             # must never need this lock
-            for cb in subs:
+            for cb, _ in subs:
                 cb(version, model)
         return prev
 
-    def subscribe(self, name: str, alias: str,
-                  callback: Callable[[int, Any], None]) -> None:
-        """Engine hook: ``callback(version, model)`` fires on every
-        ``set_alias`` move of (name, alias)."""
+    def canary_history(self, name: str) -> List[dict]:
+        """Every canary promotion decision recorded for ``name``."""
         with self._lock:
-            self._subs.setdefault((name, alias), []).append(callback)
+            return list(self._canary_log.get(name, ()))
+
+    def subscribe(self, name: str, alias: str,
+                  callback: Callable[[int, Any], None],
+                  canary: Optional[Callable] = None) -> None:
+        """Engine hook: ``callback(version, model)`` fires on every
+        ``set_alias`` move of (name, alias); ``canary(version, model,
+        frac=, window=, timeout_s=, **thresholds)`` (when provided)
+        handles ``set_alias(..., canary=frac)`` evaluations and must
+        return the decision dict (``Engine.run_canary``'s contract)."""
+        with self._lock:
+            self._subs.setdefault((name, alias), []).append((callback, canary))

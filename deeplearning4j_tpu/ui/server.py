@@ -65,13 +65,21 @@ class UIServer:
         return json.dumps({"serving": serving, "sessions": sessions})
 
     def _predict_json(self, body: bytes):
-        """(status, payload) for POST /predict.  Admission shed maps to
-        429, a blown deadline to 504 — overload stays visible to HTTP
-        clients instead of turning into opaque 500s."""
+        """(status, payload) for POST /predict.  Every error is
+        structured JSON with a STABLE ``error_class`` field (never a raw
+        traceback): admission shed → 429 ``overloaded``, a blown
+        deadline → 504 ``deadline_exceeded``, an isolated poison input →
+        422 ``poison_input``, malformed request → 400 ``bad_request``,
+        anything else → 500 ``internal`` (exception type + message only
+        — model internals stay out of the HTTP surface)."""
         import json
-        from ..serving import DeadlineExceededError, OverloadedError
+        from ..serving import (
+            DeadlineExceededError, OverloadedError, PoisonInputError,
+            ReplicaCrashError, ReplicaHungError,
+        )
         if self._engine is None:
-            return 503, {"error": "no serving engine attached"}
+            return 503, {"error": "no serving engine attached",
+                         "error_class": "unavailable"}
         try:
             payload = json.loads(body)
             import numpy as np
@@ -80,11 +88,30 @@ class UIServer:
             return 200, {"outputs": np.asarray(out).tolist(),
                          "model": self._engine.current_tag}
         except OverloadedError as e:
-            return 429, {"error": str(e)}
+            return 429, {"error": str(e), "error_class": "overloaded"}
         except DeadlineExceededError as e:
-            return 504, {"error": str(e)}
+            return 504, {"error": str(e), "error_class": "deadline_exceeded"}
+        except PoisonInputError as e:
+            return 422, {"error": str(e), "error_class": "poison_input"}
+        except (ReplicaCrashError, ReplicaHungError) as e:
+            return 500, {"error": str(e), "error_class": "replica_failure"}
         except (KeyError, ValueError, TypeError) as e:
-            return 400, {"error": f"{type(e).__name__}: {e}"}
+            return 400, {"error": f"{type(e).__name__}: {e}",
+                         "error_class": "bad_request"}
+        except Exception as e:  # model exceptions: no traceback leak
+            return 500, {"error": f"{type(e).__name__}: {e}",
+                         "error_class": "internal"}
+
+    def _healthz_json(self):
+        """(status, payload) for GET /healthz: liveness + readiness with
+        per-replica health (healthy/degraded/dead) from the engine's
+        supervisor.  503 when no engine is attached or no replica is
+        dispatchable — load balancers can take the box out of rotation."""
+        if self._engine is None:
+            return 503, {"status": "unready", "ready": False,
+                         "error": "no serving engine attached"}
+        snap = self._engine.health_snapshot()
+        return (200 if snap.get("ready") else 503), snap
 
     def enable_remote_listener(self) -> "UIServer":
         """Accept POSTed stats on /remote into the first attached storage
@@ -140,6 +167,12 @@ class UIServer:
                             urllib.parse.unquote(sid))
                     elif path == "/metrics":
                         self._reply(200, server._metrics_json().encode(),
+                                    "application/json")
+                        return
+                    elif path == "/healthz":
+                        import json as _json
+                        code, payload = server._healthz_json()
+                        self._reply(code, _json.dumps(payload).encode(),
                                     "application/json")
                         return
                     elif path in ("", "/", "/index.html"):

@@ -1,0 +1,436 @@
+"""Serving chaos soak: all four serving fault kinds against a live
+engine under open-loop load (bench config ``serving_chaos_recovery``).
+
+Arms (CPU; the resilience logic under test is host-side — run with
+``JAX_PLATFORMS=cpu``, as bench.py's subprocess harness does):
+
+  off-identity — the SAME deterministic request sequence served
+      synchronously (one outstanding request at a time, so every batch
+      is a singleton and bitwise-comparable) by (a) an engine in the
+      pre-PR configuration (no chaos, poison isolation off, no forward
+      timeout) and (b) an engine with the full resilience stack armed
+      but chaos off.  Outputs must be BIT-IDENTICAL and the resilience
+      counters all zero: the resilience machinery disabled-or-idle
+      changes no behavior.
+
+  chaos — an open-loop trickle (the serving_ab protocol: the arrival
+      clock never waits for the server) against a 2-replica engine with
+      every serving fault kind firing:
+        * replica_crash / replica_hang (engine-side, ServingChaos
+          schedule keyed by global batch index): replica threads die or
+          park mid-batch; the supervisor must complete or retry every
+          in-flight future, respawn + re-warm the replica (ZERO new
+          compiles), and keep p99 bounded through the loss windows.
+        * poison_input (driver-side): scripted requests carry all-NaN
+          features; the engine must bisect them out so every co-batched
+          request still succeeds — zero cross-request poisoning.
+        * bad_version (driver-side): mid-run, a GOOD candidate version
+          is promoted through `set_alias(..., canary=frac)` (must
+          promote: same weights, zero divergence) and later a REGRESSED
+          (NaN-weight) candidate is canaried (must auto-roll-back, with
+          user traffic never touched by it).
+
+Gates (consumed by bench.py ``serving_chaos_recovery``):
+  - stranded == 0: every submitted future resolves (result or typed
+    error) within the drain timeout — nothing hangs, ever
+  - poison_cross_contaminated == 0 AND non_poison_failures == 0: every
+    scripted poison request fails with PoisonInputError, every other
+    request succeeds with finite outputs
+  - p99_ok: end-to-end p99 (overall AND inside the 1s windows following
+    each replica crash/hang) stays under the SLO budget while a replica
+    is down
+  - respawn_zero_compiles: the serving version's executable cache does
+    not grow across replica respawns (re-warm is a cache-hit pass) and
+    unwarmed_serves == 0
+  - canary_promoted_good AND canary_rollback_fired: the auto-rollback
+    fires on exactly the regressed version, never the healthy one
+  - off_behavior_identical: the off-identity arm above
+
+Last stdout line is the JSON result (the bench subprocess contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import List, Tuple
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+QUICK = "--quick" in sys.argv or os.environ.get("BENCH_QUICK", "0") == "1"
+
+
+def _mlp(seed=7, nan_params=False):
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import (
+        MultiLayerNetwork, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.updaters import Sgd
+
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(lr=0.05))
+            .layer(Dense(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(12)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    if nan_params:
+        # the regressed version: same architecture, NaN weights — every
+        # forward is non-finite, exactly what the canary must catch
+        import jax
+        net.params = jax.tree_util.tree_map(
+            lambda a: a * np.nan, net.params)
+    return net
+
+
+def _request_stream(n: int, poison_every: int) -> List[Tuple[np.ndarray, bool]]:
+    """Deterministic request sequence: 1-2 row requests, every
+    ``poison_every``-th poisoned with all-NaN features (driver-side
+    POISON_INPUT injection)."""
+    rng = np.random.default_rng(0)
+    out = []
+    for i in range(n):
+        rows = 1 if i % 3 else 2
+        x = rng.normal(size=(rows, 12)).astype(np.float32)
+        poison = poison_every > 0 and i > 0 and i % poison_every == 0
+        if poison:
+            x = np.full_like(x, np.nan)
+        out.append((x, poison))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# arm 1: chaos-off behavior identity (the pre-PR engine vs the new one)
+# ---------------------------------------------------------------------------
+
+def run_off_identity(n_requests: int) -> dict:
+    from deeplearning4j_tpu.serving import Engine
+
+    stream = _request_stream(n_requests, poison_every=0)
+
+    def serve_all(eng) -> List[np.ndarray]:
+        outs = []
+        for x, _ in stream:   # synchronous: every batch is a singleton,
+            outs.append(np.asarray(eng.output(x, slo_ms=30_000)))
+        return outs           # so the two arms run IDENTICAL programs
+
+    legacy_cfg = Engine(_mlp(), max_batch=8, slo_ms=1000, replicas=2,
+                        poison_isolation=False, forward_timeout_s=None,
+                        max_retries=0).load()
+    legacy_out = serve_all(legacy_cfg)
+    legacy_cfg.shutdown()
+
+    resilient = Engine(_mlp(), max_batch=8, slo_ms=1000, replicas=2,
+                       poison_isolation=True, forward_timeout_s=5.0,
+                       max_retries=1).load()
+    new_out = serve_all(resilient)
+    snap = resilient.metrics_snapshot()
+    resilient.shutdown()
+
+    bitwise = all(a.shape == b.shape and np.array_equal(a, b)
+                  for a, b in zip(legacy_out, new_out))
+    idle = all(snap["counters"][k] == 0 for k in (
+        "replica_crashes", "replica_hangs", "replica_respawns", "retries",
+        "poison_isolated", "circuit_opens", "canary_promotions",
+        "canary_rollbacks", "errors", "deadline_missed"))
+    return {"off_bitwise": bool(bitwise), "off_counters_idle": bool(idle),
+            "off_behavior_identical": bool(bitwise and idle),
+            "off_requests": n_requests}
+
+
+# ---------------------------------------------------------------------------
+# arm 2: the chaos arm
+# ---------------------------------------------------------------------------
+
+class _Driver:
+    """Traffic driver + completion ledger.  The main request stream runs
+    open-loop from a background thread (the arrival clock never waits
+    for the server); ``pump_while`` keeps a steady trickle flowing while
+    a blocking call (a canary ``set_alias``) runs — the decision window
+    needs live batches to mirror.  EVERY submission is recorded, so the
+    stranded-futures gate covers pump traffic too."""
+
+    def __init__(self, eng, slo_ms):
+        self.eng = eng
+        self.slo_ms = slo_ms
+        self.records: List[dict] = []   # one per submission, always
+        self.lock = threading.Lock()
+        self.n_submitted = 0
+        self.n_done = 0
+
+    def submit(self, x, poison):
+        t_submit = time.monotonic()
+        fut = self.eng.output_async(x, slo_ms=self.slo_ms)
+        with self.lock:
+            self.n_submitted += 1
+
+        def cb(f):
+            t = time.monotonic()
+            exc = f.exception()
+            rec = {"poison": poison, "latency_ms": (t - t_submit) * 1e3,
+                   "t_done": t,
+                   "error": type(exc).__name__ if exc is not None else None}
+            if exc is None:
+                rec["finite"] = bool(np.isfinite(f.result()).all())
+            with self.lock:
+                self.records.append(rec)
+                self.n_done += 1
+        fut.add_done_callback(cb)
+
+    def open_loop(self, stream, interarrival_s):
+        """Returns the (started) submission thread."""
+        def run():
+            t0 = time.monotonic()
+            for i, (x, poison) in enumerate(stream):
+                delay = t0 + i * interarrival_s - time.monotonic()
+                if delay > 0:
+                    time.sleep(delay)
+                self.submit(x, poison)
+        t = threading.Thread(target=run, daemon=True)
+        t.start()
+        return t
+
+    def pump_while(self, blocking_fn, interarrival_s=0.004):
+        """Trickle normal requests from a side thread while
+        ``blocking_fn`` runs on this one; returns its result."""
+        stop = threading.Event()
+        x = np.zeros((1, 12), np.float32)
+
+        def pump():
+            while not stop.is_set():
+                self.submit(x, False)
+                time.sleep(interarrival_s)
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        try:
+            return blocking_fn()
+        finally:
+            stop.set()
+            t.join(timeout=10)
+
+    def wait_done_count(self, n, timeout):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self.lock:
+                if self.n_done >= n:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def drain(self, timeout):
+        """True when every submitted future has resolved."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self.lock:
+                if self.n_done >= self.n_submitted:
+                    return True
+            time.sleep(0.02)
+        return False
+
+
+def _p99(lat: List[float]):
+    if not lat:
+        return None
+    return float(np.percentile(np.asarray(lat), 99))
+
+
+def run_chaos_arm(n_requests: int, interarrival_ms: float) -> dict:
+    from deeplearning4j_tpu.parallel import (
+        FaultKind, FaultSchedule, ServingChaos,
+    )
+    from deeplearning4j_tpu.serving import Engine, ModelRegistry
+
+    slo_ms = 2500.0
+    poison_every = 60
+    stream = _request_stream(n_requests, poison_every=poison_every)
+    n_poison = sum(1 for _, p in stream if p)
+
+    # engine-side schedule (global batch indices): crashes + hangs spread
+    # through the run, scaled so every index lands well inside the total
+    # batch count (>= n_requests/2 batches: requests are 1-2 rows and the
+    # trickle closes mostly-small batches); retries/bisections shift
+    # later indices, which is fine — determinism is per-run via the
+    # seeded request stream
+    # conservative batch-count floor: under backlog (which the hangs
+    # themselves create) requests coalesce toward max_batch, so the run
+    # produces at LEAST ~n_requests/5 batches — keep every scheduled
+    # index under that
+    base = max(30, n_requests // 5)
+    crash_batches = sorted({10, (2 * base) // 5, (4 * base) // 5})
+    hang_batches = sorted({base // 4, (3 * base) // 5}
+                          - set(crash_batches))
+    sched = {b: [FaultKind.REPLICA_CRASH] for b in crash_batches}
+    for b in hang_batches:
+        sched[b] = [FaultKind.REPLICA_HANG]
+    n_faults_scheduled = sum(len(v) for v in sched.values())
+    chaos = ServingChaos(FaultSchedule.scripted(sched), hang_seconds=1.2)
+
+    reg = ModelRegistry()
+    v1 = reg.register("m", _mlp(seed=7))
+    reg.set_alias("m", "prod", v1)
+    eng = Engine.from_registry(
+        reg, "m", "prod", max_batch=8, slo_ms=slo_ms, replicas=2,
+        max_queue=100_000, admission="block", max_wait_ms=2.0,
+        forward_timeout_s=0.4, max_retries=1, breaker_threshold=3,
+        breaker_cooldown_s=0.5, supervise_interval_s=0.01, chaos=chaos)
+    eng.load()
+
+    driver = _Driver(eng, slo_ms)
+    t_start = time.monotonic()
+    submit_thread = driver.open_loop(stream, interarrival_ms / 1000.0)
+
+    # -- canary choreography (driver-side bad_version fault) ---------------
+    # a GOOD candidate (bit-identical weights) promotes mid-run; a pump
+    # trickle keeps batches flowing through the decision window even if
+    # the main stream has already drained...
+    driver.wait_done_count(n_requests // 3, timeout=120)
+    v2 = reg.register("m", _mlp(seed=7))
+    good_record = driver.pump_while(
+        lambda: reg.set_alias("m", "prod", v2, canary=0.5,
+                              canary_window=6, canary_timeout_s=60))
+    cache_after_promote = eng.compile_cache_size()
+    # ...then a REGRESSED (NaN-weight) candidate must auto-roll-back
+    driver.wait_done_count((2 * n_requests) // 3, timeout=120)
+    v_bad = reg.register("m", _mlp(seed=7, nan_params=True))
+    bad_record = driver.pump_while(
+        lambda: reg.set_alias("m", "prod", v_bad, canary=0.5,
+                              canary_window=6, canary_timeout_s=60))
+
+    # -- drain: EVERY future must resolve ----------------------------------
+    submit_thread.join(timeout=120)
+    all_done = driver.drain(timeout=180)
+    wall_s = time.monotonic() - t_start
+    snap = eng.metrics_snapshot()
+    cache_final = eng.compile_cache_size()
+    fault_events = list(chaos.events)
+    eng.shutdown()
+
+    with driver.lock:
+        records = list(driver.records)
+        n_submitted = driver.n_submitted
+    # stranded = submitted futures that never resolved within the drain
+    # timeout; a submission thread still stuck in admission after the
+    # join timeout counts as stranding the whole remainder
+    stranded = max(0, n_submitted - len(records))
+    if submit_thread.is_alive():
+        stranded += n_requests
+
+    poison_recs = [r for r in records if r["poison"]]
+    normal_recs = [r for r in records if not r["poison"]]
+    poison_isolated_ok = all(r["error"] == "PoisonInputError"
+                             for r in poison_recs)
+    # zero cross-request poisoning: every non-poison request SUCCEEDS
+    # with finite outputs (no error, no NaN leak)
+    non_poison_failures = sum(1 for r in normal_recs if r["error"] is not None)
+    nonfinite_leaks = sum(1 for r in normal_recs
+                          if r["error"] is None and not r.get("finite"))
+
+    lat_all = [r["latency_ms"] for r in normal_recs if r["error"] is None]
+    p99_all = _p99(lat_all)
+    # p99 inside the 1s loss window after each replica fault: the
+    # single-replica-loss tail the ISSUE gates on
+    loss_lat = []
+    for ev in fault_events:
+        t0, t1 = ev["t"], ev["t"] + 1.0
+        loss_lat += [r["latency_ms"] for r in normal_recs
+                     if r["error"] is None and t0 <= r["t_done"] <= t1]
+    p99_loss = _p99(loss_lat)
+    p99_bound = slo_ms
+    p99_ok = bool(p99_all is not None and p99_all <= p99_bound
+                  and (p99_loss is None or p99_loss <= p99_bound))
+
+    c = snap["counters"]
+    history = reg.canary_history("m")
+    out = {
+        "n_requests": n_requests, "n_submitted": n_submitted,
+        "n_poison": n_poison, "wall_seconds": round(wall_s, 2),
+        "stranded": int(stranded),
+        "all_done_before_timeout": bool(all_done),
+        "faults_scheduled": n_faults_scheduled,
+        "faults_injected": len(fault_events),
+        "fault_events": fault_events,
+        "replica_crashes": c["replica_crashes"],
+        "replica_hangs": c["replica_hangs"],
+        "replica_respawns": c["replica_respawns"],
+        "retries": c["retries"],
+        "circuit_opens": c["circuit_opens"],
+        "poison_isolated": c["poison_isolated"],
+        "poison_isolated_ok": bool(poison_isolated_ok
+                                   and c["poison_isolated"] == n_poison),
+        "non_poison_failures": int(non_poison_failures),
+        "poison_cross_contaminated": int(nonfinite_leaks),
+        "p99_ms": round(p99_all, 2) if p99_all is not None else None,
+        "p99_loss_window_ms": (round(p99_loss, 2)
+                               if p99_loss is not None else None),
+        "loss_window_samples": len(loss_lat),
+        "p99_bound_ms": p99_bound, "p99_ok": p99_ok,
+        "unwarmed_serves": c["unwarmed_serves"],
+        "respawn_zero_compiles": bool(
+            cache_after_promote is not None
+            and cache_final == cache_after_promote
+            and c["unwarmed_serves"] == 0),
+        "canary_promoted_good": bool(good_record["promoted"]),
+        "canary_rollback_fired": bool(not bad_record["promoted"]),
+        "canary_history_promoted": [h["promoted"] for h in history],
+        "canary_promotions": c["canary_promotions"],
+        "canary_rollbacks": c["canary_rollbacks"],
+        "final_model": snap["model"],
+        "deadline_missed": c["deadline_missed"],
+        "health_final": snap["health"]["status"],
+        "replicas_alive_final": all(r["alive"]
+                                    for r in snap["health"]["replicas"]),
+    }
+    out["chaos_ok"] = bool(
+        out["stranded"] == 0
+        and out["faults_injected"] == out["faults_scheduled"]
+        and out["replica_respawns"] >= out["faults_scheduled"]
+        and out["poison_isolated_ok"]
+        and out["non_poison_failures"] == 0
+        and out["poison_cross_contaminated"] == 0
+        and out["p99_ok"]
+        and out["respawn_zero_compiles"]
+        and out["canary_promoted_good"]
+        and out["canary_rollback_fired"]
+        and out["canary_history_promoted"] == [True, False]
+        and out["final_model"] == "m:v2"
+        # every replica ends the soak alive and serving ("degraded" only
+        # means a failure streak was not yet reset by a later batch)
+        and out["replicas_alive_final"]
+        and out["health_final"] in ("ok", "degraded"))
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--interarrival-ms", type=float, default=3.0)
+    args = ap.parse_args()
+
+    import jax
+
+    quick = args.quick or QUICK
+    n_requests = args.requests or (300 if quick else 900)
+    n_off = 60 if quick else 150
+
+    print(f"serving_chaos_soak: {n_requests} chaos requests @ "
+          f"{args.interarrival_ms}ms inter-arrival, {n_off} identity "
+          f"requests, platform={jax.devices()[0].platform}", file=sys.stderr)
+
+    out = {"config": "serving_chaos_recovery",
+           "platform": jax.devices()[0].platform, "quick": quick}
+    out.update(run_off_identity(n_off))
+    out.update(run_chaos_arm(n_requests, args.interarrival_ms))
+    out["soak_ok"] = bool(out["off_behavior_identical"] and out["chaos_ok"])
+    print(json.dumps(out), flush=True)
+    return 0 if out["soak_ok"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

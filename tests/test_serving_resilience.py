@@ -1,0 +1,645 @@
+"""Serving resilience: replica supervision, failure isolation, canary
+auto-rollback, and the serving chaos harness.
+
+The contracts under test (ISSUE 7 / docs/SERVING.md "Failure model"):
+  - a replica thread that dies or hangs mid-batch NEVER strands its
+    futures: they are retried on a different replica or completed with a
+    typed error, and the replica is respawned with a re-warm pass that
+    adds ZERO compiles
+  - retries are bounded and deadline-aware (never launched past the
+    request's deadline)
+  - K consecutive replica failures trip a per-replica circuit breaker;
+    it half-opens after the cooldown and a successful probe closes it
+  - a poison (NaN) input is isolated by batch bisection: co-batched
+    requests still succeed, even when the model contaminates the whole
+    batch output
+  - canary promotion mirrors shadow traffic and auto-rolls-back on
+    regression; a healthy candidate promotes and completes the hot-swap
+  - /healthz reports per-replica health; /predict errors are structured
+    JSON with a stable error_class (no raw tracebacks)
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import (
+    MultiLayerNetwork, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.updaters import Sgd
+from deeplearning4j_tpu.parallel import FaultKind, FaultSchedule, ServingChaos
+from deeplearning4j_tpu.serving import (
+    Engine, ModelRegistry, PoisonInputError, ReplicaCrashError,
+    ReplicaHungError,
+)
+
+
+def _mlp(seed=7):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(lr=0.05))
+            .layer(Dense(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(12)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+class _ConstModel:
+    def __init__(self, val, delay_s=0.0):
+        self.val = float(val)
+        self.delay_s = delay_s
+        self.calls = 0
+
+    def output(self, x):
+        self.calls += 1
+        if self.delay_s:
+            time.sleep(self.delay_s)
+        return np.full((x.shape[0], 1), self.val, np.float32)
+
+
+class _NaNModel:
+    """A regressed version: every output is NaN (the bad_version fault)."""
+
+    def output(self, x):
+        return np.full((x.shape[0], 1), np.nan, np.float32)
+
+
+class _Contaminating:
+    """A poison row NaNs the WHOLE batch output (cross-batch reduction,
+    like train-mode batchnorm) — the hard case for poison isolation."""
+
+    def output(self, x):
+        return (np.sum(x) * np.ones((x.shape[0], 1))).astype(np.float32)
+
+
+def _crash_chaos(batches, hang_seconds=2.0):
+    return ServingChaos(FaultSchedule.scripted(
+        {b: FaultKind.REPLICA_CRASH for b in batches}),
+        hang_seconds=hang_seconds)
+
+
+# ---------------------------------------------------------------------------
+# replica supervision
+# ---------------------------------------------------------------------------
+
+class TestReplicaSupervision:
+    def test_crash_mid_batch_never_strands_futures(self):
+        """The satellite regression: pre-PR, a replica thread dying
+        mid-batch left its futures unresolved forever.  With retries
+        disabled the future must fail PROMPTLY with the typed error."""
+        eng = Engine(_mlp(), max_batch=4, replicas=1, slo_ms=10_000,
+                     max_retries=0, chaos=_crash_chaos([1]),
+                     supervise_interval_s=0.01).load()
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(ReplicaCrashError):
+                eng.output(np.zeros((2, 12), np.float32))
+            assert time.monotonic() - t0 < 5.0  # raises, not hangs
+            snap = eng.metrics_snapshot()
+            assert snap["counters"]["replica_crashes"] == 1
+            assert snap["counters"]["replica_respawns"] == 1
+        finally:
+            eng.shutdown()
+
+    def test_crash_retries_on_a_different_replica(self):
+        eng = Engine(_mlp(), max_batch=4, replicas=2, slo_ms=10_000,
+                     chaos=_crash_chaos([1]),
+                     supervise_interval_s=0.01).load()
+        try:
+            c0 = eng.compile_cache_size()
+            out = eng.output(np.zeros((2, 12), np.float32))  # crash → retry
+            assert out.shape == (2, 3)
+            snap = eng.metrics_snapshot()
+            assert snap["counters"]["replica_crashes"] == 1
+            assert snap["counters"]["retries"] >= 1
+            assert snap["counters"]["replica_respawns"] == 1
+            # the retry ran on the OTHER replica, not the crashed one
+            crashed = [r for r in snap["health"]["replicas"]
+                       if r["respawns"] == 1]
+            assert len(crashed) == 1
+            served = {b["replica"] for b in eng.batch_log}
+            assert crashed[0]["replica"] not in served
+            # respawn re-warm is a cache-hit pass: zero new compiles
+            assert eng.compile_cache_size() == c0
+            assert snap["counters"]["unwarmed_serves"] == 0
+            # the engine keeps serving normally afterwards
+            assert eng.output(np.zeros((3, 12), np.float32)).shape == (3, 3)
+            assert eng.compile_cache_size() == c0
+        finally:
+            eng.shutdown()
+
+    def test_hang_detected_and_retried(self):
+        """A replica parked past forward_timeout_s is abandoned: its
+        batch retries elsewhere, the replica respawns, and the late
+        wake-up's results are discarded (no double delivery)."""
+        chaos = ServingChaos(FaultSchedule.scripted(
+            {1: FaultKind.REPLICA_HANG}), hang_seconds=1.0)
+        eng = Engine(_mlp(), max_batch=4, replicas=2, slo_ms=10_000,
+                     forward_timeout_s=0.15, chaos=chaos,
+                     supervise_interval_s=0.01).load()
+        try:
+            t0 = time.monotonic()
+            out = eng.output(np.zeros((2, 12), np.float32))
+            waited = time.monotonic() - t0
+            assert out.shape == (2, 3)
+            assert waited < 0.9  # resolved by retry, not by the hang ending
+            snap = eng.metrics_snapshot()
+            assert snap["counters"]["replica_hangs"] == 1
+            assert snap["counters"]["retries"] >= 1
+            assert snap["counters"]["replica_respawns"] == 1
+            time.sleep(1.0)  # let the hung incarnation wake and exit
+            assert eng.output(np.zeros((1, 12), np.float32)).shape == (1, 3)
+        finally:
+            eng.shutdown()
+
+    def test_hang_without_retry_budget_fails_typed(self):
+        chaos = ServingChaos(FaultSchedule.scripted(
+            {1: FaultKind.REPLICA_HANG}), hang_seconds=1.0)
+        eng = Engine(_mlp(), max_batch=4, replicas=1, slo_ms=10_000,
+                     forward_timeout_s=0.15, max_retries=0, chaos=chaos,
+                     supervise_interval_s=0.01).load()
+        try:
+            with pytest.raises(ReplicaHungError):
+                eng.output(np.zeros((2, 12), np.float32))
+        finally:
+            eng.shutdown()
+
+    def test_circuit_breaker_trips_and_recovers(self):
+        """Two consecutive crashes at breaker_threshold=2 open the
+        breaker (circuit_opens counter); after the cooldown the replica
+        half-opens and a successful probe closes it again."""
+        eng = Engine(_mlp(), max_batch=4, replicas=1, slo_ms=10_000,
+                     breaker_threshold=2, breaker_cooldown_s=0.2,
+                     chaos=_crash_chaos([1, 2]),
+                     supervise_interval_s=0.01).load()
+        try:
+            # batch 1 crashes, its retry (batch 2) crashes too → breaker
+            with pytest.raises(ReplicaCrashError):
+                eng.output(np.zeros((2, 12), np.float32))
+            snap = eng.metrics_snapshot()
+            assert snap["counters"]["replica_crashes"] == 2
+            assert snap["counters"]["circuit_opens"] == 1
+            # next request waits out the cooldown (dispatcher routes
+            # around the open breaker), then the half-open probe succeeds
+            out = eng.output(np.zeros((2, 12), np.float32), slo_ms=10_000)
+            assert out.shape == (2, 3)
+            health = eng.health_snapshot()
+            assert health["status"] == "ok"
+            assert health["replicas"][0]["breaker_open"] is False
+            assert health["replicas"][0]["consecutive_failures"] == 0
+        finally:
+            eng.shutdown()
+
+    def test_health_snapshot_shape(self):
+        eng = Engine(_ConstModel(1.0), max_batch=4, replicas=2,
+                     slo_ms=10_000)
+        try:
+            h = eng.health_snapshot()
+            assert h["status"] == "ok" and h["ready"] is True
+            assert len(h["replicas"]) == 2
+            for r in h["replicas"]:
+                assert r["health"] == "healthy" and r["alive"]
+                assert r["breaker_open"] is False
+        finally:
+            eng.shutdown()
+            assert eng.health_snapshot()["ready"] is False
+
+
+# ---------------------------------------------------------------------------
+# retry x deadline
+# ---------------------------------------------------------------------------
+
+class TestRetryDeadline:
+    def test_retry_never_launches_past_deadline(self):
+        """A crashed request whose remaining deadline is smaller than
+        the bucket's expected exec time must FAIL typed, not retry: the
+        retry would complete after the SLO is already blown."""
+        model = _ConstModel(1.0, delay_s=0.15)   # warmup seeds EMA ~150ms
+        eng = Engine(model, max_batch=2, replicas=2, slo_ms=10_000,
+                     chaos=_crash_chaos([1]),
+                     supervise_interval_s=0.01).load(input_shape=(2,))
+        try:
+            calls_before = model.calls
+            # 100ms budget < ~150ms expected exec: no retry possible
+            with pytest.raises(ReplicaCrashError):
+                eng.output(np.zeros((1, 2), np.float32), slo_ms=100)
+            # the only model calls after the crash are the respawn
+            # re-warm probes (one per bucket) — never a user retry
+            assert model.calls - calls_before <= len(eng.batcher.buckets)
+            assert eng.metrics_snapshot()["counters"]["retries"] == 0
+        finally:
+            eng.shutdown()
+
+    def test_retry_with_slack_succeeds(self):
+        model = _ConstModel(1.0, delay_s=0.05)
+        eng = Engine(model, max_batch=2, replicas=2, slo_ms=10_000,
+                     chaos=_crash_chaos([1]),
+                     supervise_interval_s=0.01).load(input_shape=(2,))
+        try:
+            out = eng.output(np.zeros((1, 2), np.float32), slo_ms=5_000)
+            assert out.shape == (1, 1)
+            assert eng.metrics_snapshot()["counters"]["retries"] == 1
+        finally:
+            eng.shutdown()
+
+    def test_model_error_retried_then_propagates(self):
+        """A deterministic model error burns the retry budget and then
+        propagates — bounded, never an infinite retry loop."""
+        class Broken:
+            def __init__(self):
+                self.calls = 0
+
+            def output(self, x):
+                self.calls += 1
+                raise RuntimeError("boom")
+
+        model = Broken()
+        eng = Engine(model, max_batch=4, slo_ms=10_000, max_retries=1)
+        try:
+            with pytest.raises(RuntimeError, match="boom"):
+                eng.output(np.ones((2, 3), np.float32))
+            assert model.calls == 2   # original + exactly one retry
+        finally:
+            eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# poison-input isolation
+# ---------------------------------------------------------------------------
+
+class TestPoisonIsolation:
+    def test_poison_isolated_co_batched_succeed(self):
+        eng = Engine(_mlp(), max_batch=8, replicas=1, slo_ms=10_000,
+                     max_wait_ms=20.0).load()
+        try:
+            good = [eng.output_async(np.ones((1, 12), np.float32))
+                    for _ in range(3)]
+            poison = eng.output_async(np.full((1, 12), np.nan, np.float32))
+            more_good = [eng.output_async(np.ones((1, 12), np.float32))
+                         for _ in range(3)]
+            for f in good + more_good:
+                out = f.result(timeout=30)
+                assert np.isfinite(out).all()
+            with pytest.raises(PoisonInputError):
+                poison.result(timeout=30)
+            snap = eng.metrics_snapshot()
+            assert snap["counters"]["poison_isolated"] == 1
+            assert snap["counters"]["unwarmed_serves"] == 0  # pow2 halves
+        finally:
+            eng.shutdown()
+
+    def test_poison_isolated_when_model_contaminates_whole_batch(self):
+        """Cross-batch contamination: every co-batched output is NaN, so
+        per-slice checks cannot identify the culprit — bisection re-runs
+        halves until the poison request is pinned."""
+        eng = Engine(_Contaminating(), max_batch=8, replicas=1,
+                     slo_ms=10_000, max_wait_ms=20.0)
+        try:
+            good = [eng.output_async(np.ones((1, 4), np.float32))
+                    for _ in range(3)]
+            poison = eng.output_async(np.full((1, 4), np.nan, np.float32))
+            for f in good:
+                assert np.isfinite(f.result(timeout=30)).all()
+            with pytest.raises(PoisonInputError):
+                poison.result(timeout=30)
+            assert eng.metrics_snapshot()["counters"]["poison_isolated"] == 1
+        finally:
+            eng.shutdown()
+
+    def test_poison_isolation_can_be_disabled(self):
+        eng = Engine(_NaNModel(), max_batch=4, replicas=1, slo_ms=10_000,
+                     poison_isolation=False)
+        try:
+            out = eng.output(np.ones((2, 3), np.float32))
+            assert np.isnan(out).all()   # pre-PR behavior: NaN passes through
+        finally:
+            eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# hot swap x replica failure
+# ---------------------------------------------------------------------------
+
+class TestSwapRacingFailure:
+    def test_swap_drains_and_completes_with_replica_crash_mid_drain(self):
+        """A hot-swap must still drain (set_alias returns) and never mix
+        versions even when a replica thread dies while batches of the
+        outgoing version are in flight."""
+        reg = ModelRegistry()
+        v1 = reg.register("m", _ConstModel(1.0, delay_s=0.002))
+        v2 = reg.register("m", _ConstModel(2.0, delay_s=0.002))
+        reg.set_alias("m", "prod", v1)
+        # crashes sprinkled through the run, landing around the swaps
+        chaos = _crash_chaos([3, 7, 11])
+        eng = Engine.from_registry(reg, "m", "prod", max_batch=4,
+                                   replicas=2, slo_ms=10_000,
+                                   chaos=chaos, supervise_interval_s=0.01)
+        try:
+            futs, stop = [], threading.Event()
+
+            def pound():
+                while not stop.is_set():
+                    futs.append(
+                        eng.output_async(np.zeros((1, 3), np.float32)))
+                    time.sleep(0.001)
+
+            threads = [threading.Thread(target=pound, daemon=True)
+                       for _ in range(3)]
+            for t in threads:
+                t.start()
+            swapped = []
+
+            def swap():
+                reg.set_alias("m", "prod", v2)
+                swapped.append(True)
+
+            st = threading.Thread(target=swap, daemon=True)
+            st.start()
+            st.join(timeout=30)
+            assert swapped, "hot-swap drain stranded by the replica crash"
+            time.sleep(0.1)
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            # every single future resolves: result or typed error
+            vals = []
+            for f in futs:
+                try:
+                    vals.append(float(np.unique(f.result(timeout=30))[0]))
+                except (ReplicaCrashError, RuntimeError):
+                    pass
+            assert all(v in (1.0, 2.0) for v in vals)
+            for entry in eng.batch_log:   # batches never mix versions
+                assert entry["tag"] in ("m:v1", "m:v2")
+            assert eng.current_tag == "m:v2"
+            assert chaos.injected() == 3
+        finally:
+            eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# canary promotion + auto-rollback
+# ---------------------------------------------------------------------------
+
+class _Traffic:
+    """Background open-loop traffic driving canary windows."""
+
+    def __init__(self, eng, shape=(1, 3)):
+        self.eng = eng
+        self.shape = shape
+        self.stop = threading.Event()
+        self.results = []
+        self.errors = []
+        self.thread = threading.Thread(target=self._run, daemon=True)
+
+    def _run(self):
+        while not self.stop.is_set():
+            try:
+                self.results.append(
+                    self.eng.output(np.zeros(self.shape, np.float32)))
+            except Exception as e:
+                self.errors.append(e)
+            time.sleep(0.002)
+
+    def __enter__(self):
+        self.thread.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop.set()
+        self.thread.join(timeout=10)
+
+
+class TestCanary:
+    def test_healthy_candidate_promotes(self):
+        reg = ModelRegistry()
+        v1 = reg.register("m", _ConstModel(1.0))
+        v2 = reg.register("m", _ConstModel(1.0))
+        reg.set_alias("m", "prod", v1)
+        eng = Engine.from_registry(reg, "m", "prod", max_batch=4,
+                                   slo_ms=10_000, max_wait_ms=0.5)
+        try:
+            with _Traffic(eng):
+                record = reg.set_alias("m", "prod", v2, canary=0.5,
+                                       canary_window=4, canary_timeout_s=30)
+            assert record["promoted"] is True
+            d = record["decisions"][0]
+            assert d["promote"] and d["mirrored_batches"] >= 4
+            assert d["error_rate"] == 0.0
+            assert d["mean_divergence"] == 0.0
+            assert reg.resolve("m", "prod")[0] == v2
+            assert eng.current_tag == "m:v2"
+            snap = eng.metrics_snapshot()
+            assert snap["counters"]["canary_promotions"] == 1
+            assert snap["counters"]["canary_rollbacks"] == 0
+            assert snap["counters"]["canary_mirrored_batches"] >= 4
+            assert reg.canary_history("m")[0]["promoted"] is True
+        finally:
+            eng.shutdown()
+
+    def test_regressed_candidate_rolls_back(self):
+        """The bad_version fault: a candidate that NaNs its outputs must
+        be auto-rolled-back, with user traffic never touched by it."""
+        reg = ModelRegistry()
+        v1 = reg.register("m", _ConstModel(1.0))
+        v_bad = reg.register("m", _NaNModel())
+        reg.set_alias("m", "prod", v1)
+        eng = Engine.from_registry(reg, "m", "prod", max_batch=4,
+                                   slo_ms=10_000, max_wait_ms=0.5)
+        try:
+            with _Traffic(eng) as traffic:
+                record = reg.set_alias("m", "prod", v_bad, canary=0.5,
+                                       canary_window=4, canary_timeout_s=30)
+            assert record["promoted"] is False
+            d = record["decisions"][0]
+            assert not d["promote"] and d["error_rate"] == 1.0
+            assert any("error rate" in r for r in d["reasons"])
+            # alias + engine stayed on the incumbent
+            assert reg.resolve("m", "prod")[0] == v1
+            assert eng.current_tag == "m:v1"
+            assert eng.metrics_snapshot()["counters"]["canary_rollbacks"] == 1
+            # shadow traffic never leaked into user results
+            assert not traffic.errors
+            assert all(np.isfinite(r).all() and np.unique(r)[0] == 1.0
+                       for r in traffic.results)
+        finally:
+            eng.shutdown()
+
+    def test_divergent_candidate_rolls_back_on_threshold(self):
+        reg = ModelRegistry()
+        v1 = reg.register("m", _ConstModel(1.0))
+        v2 = reg.register("m", _ConstModel(5.0))   # finite but different
+        reg.set_alias("m", "prod", v1)
+        eng = Engine.from_registry(reg, "m", "prod", max_batch=4,
+                                   slo_ms=10_000, max_wait_ms=0.5)
+        try:
+            with _Traffic(eng):
+                record = reg.set_alias(
+                    "m", "prod", v2, canary=1.0, canary_window=3,
+                    canary_timeout_s=30,
+                    canary_thresholds={"max_divergence": 0.5})
+            assert record["promoted"] is False
+            assert any("divergence" in r
+                       for r in record["decisions"][0]["reasons"])
+            assert eng.current_tag == "m:v1"
+        finally:
+            eng.shutdown()
+
+    def test_no_traffic_window_times_out_to_rollback(self):
+        """An unjudged candidate is never promoted: zero traffic during
+        the window → timeout → rollback."""
+        reg = ModelRegistry()
+        v1 = reg.register("m", _ConstModel(1.0))
+        v2 = reg.register("m", _ConstModel(1.0))
+        reg.set_alias("m", "prod", v1)
+        eng = Engine.from_registry(reg, "m", "prod", max_batch=4,
+                                   slo_ms=10_000)
+        try:
+            record = reg.set_alias("m", "prod", v2, canary=0.5,
+                                   canary_window=4, canary_timeout_s=0.3)
+            assert record["promoted"] is False
+            assert any("window incomplete" in r
+                       for r in record["decisions"][0]["reasons"])
+            assert reg.resolve("m", "prod")[0] == v1
+        finally:
+            eng.shutdown()
+
+    def test_canary_to_first_pin_or_same_version_is_direct(self):
+        reg = ModelRegistry()
+        v1 = reg.register("m", _ConstModel(1.0))
+        # first pin: nothing to compare against → direct move
+        assert reg.set_alias("m", "prod", v1, canary=0.5) is None
+        # same version: no-op, returns prev like the direct path
+        assert reg.set_alias("m", "prod", v1, canary=0.5) == v1
+
+
+# ---------------------------------------------------------------------------
+# chaos plumbing + HTTP surface
+# ---------------------------------------------------------------------------
+
+class TestServingChaosPlumbing:
+    def test_rejects_driver_side_kinds(self):
+        with pytest.raises(ValueError, match="engine-side"):
+            ServingChaos(FaultSchedule.scripted(
+                {1: FaultKind.POISON_INPUT}))
+        with pytest.raises(ValueError, match="engine-side"):
+            ServingChaos(FaultSchedule.scripted({1: FaultKind.BAD_VERSION}))
+
+    def test_event_log_and_injected_counts(self):
+        chaos = _crash_chaos([2])
+        eng = Engine(_ConstModel(1.0), max_batch=4, slo_ms=10_000,
+                     replicas=2, chaos=chaos, supervise_interval_s=0.01)
+        try:
+            for _ in range(3):
+                eng.output(np.zeros((1, 2), np.float32))
+            assert chaos.injected(FaultKind.REPLICA_CRASH) == 1
+            assert chaos.injected() == 1
+            assert chaos.events[0]["kind"] == FaultKind.REPLICA_CRASH
+        finally:
+            eng.shutdown()
+
+
+class TestHttpSurface:
+    def test_healthz_and_structured_errors(self):
+        from deeplearning4j_tpu.ui import UIServer
+
+        class Broken:
+            def output(self, x):
+                raise RuntimeError("secret internal detail")
+
+        eng = Engine(Broken(), max_batch=4, slo_ms=500, max_retries=0)
+        server = UIServer(port=0).attach_engine(eng).start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            h = json.loads(urllib.request.urlopen(
+                base + "/healthz", timeout=5).read())
+            assert h["status"] == "ok" and h["ready"] is True
+            assert h["replicas"][0]["health"] == "healthy"
+            req = urllib.request.Request(
+                base + "/predict",
+                data=json.dumps({"inputs": [[0.0] * 3]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 500
+            payload = json.loads(ei.value.read())
+            assert payload["error_class"] == "internal"
+            assert "Traceback" not in payload["error"]
+            bad = urllib.request.Request(base + "/predict", data=b"{}",
+                                         headers={"Content-Type":
+                                                  "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(bad, timeout=5)
+            assert ei.value.code == 400
+            assert json.loads(ei.value.read())["error_class"] == "bad_request"
+        finally:
+            server.stop()
+            eng.shutdown()
+
+    def test_healthz_without_engine_is_503(self):
+        from deeplearning4j_tpu.ui import UIServer
+
+        server = UIServer(port=0).start()
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/healthz", timeout=5)
+            assert ei.value.code == 503
+            assert json.loads(ei.value.read())["ready"] is False
+        finally:
+            server.stop()
+
+    def test_poison_maps_to_422(self):
+        from deeplearning4j_tpu.ui import UIServer
+
+        eng = Engine(_mlp(), max_batch=4, slo_ms=10_000).load()
+        server = UIServer(port=0).attach_engine(eng).start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{server.port}/predict",
+                data=json.dumps({"inputs": [[float("nan")] * 12]}).encode(),
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=10)
+            assert ei.value.code == 422
+            assert (json.loads(ei.value.read())["error_class"]
+                    == "poison_input")
+        finally:
+            server.stop()
+            eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# the full soak (slow tier: subprocess, all four fault kinds + gates)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+class TestServingChaosSoak:
+    def test_soak_passes_all_gates(self):
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        p = subprocess.run(
+            [sys.executable,
+             os.path.join(repo, "scripts", "serving_chaos_soak.py"),
+             "--quick"],
+            env=env, capture_output=True, text=True, timeout=900, cwd=repo)
+        assert p.returncode == 0, p.stdout[-1000:] + p.stderr[-2000:]
+        soak = json.loads(p.stdout.strip().splitlines()[-1])
+        assert soak["soak_ok"], soak
+        assert soak["stranded"] == 0
+        assert soak["poison_cross_contaminated"] == 0
+        assert soak["canary_rollback_fired"] and soak["canary_promoted_good"]
+        assert soak["respawn_zero_compiles"]
+        assert soak["off_behavior_identical"]
