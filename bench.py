@@ -61,6 +61,13 @@ stderr, including:
     compiles across respawns, canary auto-rollback on exactly the
     regressed version, and chaos-off bit-identity with the pre-PR
     engine configuration (docs/SERVING.md "Failure model")
+  - telemetry_overhead: the observability-layer gate
+    (scripts/trace_overhead_ab.py) — span tracing OFF vs ON on
+    adjacent-step pairs, hard-gated on median paired overhead <= 3%,
+    tracing-off arm bit-identical losses (and a shared no-op fast
+    path), the exported Chrome trace validating against the schema, and
+    the documented span trees present for BOTH a training step and a
+    served request (docs/OBSERVABILITY.md)
 
 BASELINE.md: the reference publishes NO numbers; the driver target is
 >=0.8x per-chip of H100+nd4j-cuda on ResNet-50 ≈ 2000 img/s.
@@ -990,6 +997,56 @@ def bench_input_pipeline():
             "throughput_ok": True}
 
 
+def bench_telemetry_overhead():
+    """Config 16: observability-layer A/B (scripts/trace_overhead_ab.py;
+    CPU subprocess — the span recorder under test is host-side).  The
+    OFF and ON arms run adjacent-step-paired on the same batches.  HARD
+    gates (the telemetry contract): median paired overhead <= 1.03x,
+    loss sequences BIT-IDENTICAL across arms (tracing may move clock
+    reads, never math) with the disabled fast path a shared no-op
+    object, the exported trace valid Chrome-trace JSON, and the
+    documented span trees present: train/step ⊃ {train/h2d,
+    train/dispatch} (+ train/device_sync) for training, serve/batch ⊃
+    serve/forward (+ serve/request / serve/queue_wait /
+    serve/batch_form) for serving (docs/OBSERVABILITY.md)."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    script = os.path.join(_REPO, "scripts", "trace_overhead_ab.py")
+    cmd = [sys.executable, script] + (["--quick"] if QUICK else [])
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=1800, cwd=_REPO)
+    if p.returncode != 0:
+        raise RuntimeError(f"trace_overhead_ab failed (rc={p.returncode}): "
+                           f"{p.stderr[-1500:]}")
+    ab = json.loads(p.stdout.strip().splitlines()[-1])
+    if not ab.get("overhead_ok"):
+        raise RuntimeError("telemetry overhead gate FAILED (tracing-on "
+                           f"must be <= 1.03x paired): {ab}")
+    if not ab.get("loss_bitwise") or not ab.get("disabled_noop"):
+        raise RuntimeError("telemetry off-arm identity gate FAILED "
+                           f"(tracing changed behavior): {ab}")
+    if not ab.get("trace_valid"):
+        raise RuntimeError("exported trace failed Chrome-trace schema "
+                           f"validation: {ab}")
+    if not ab.get("train_span_tree_ok") or not ab.get("serve_span_tree_ok"):
+        raise RuntimeError("documented span tree MISSING from the exported "
+                           f"trace: {ab}")
+    return {"metric": "telemetry_overhead",
+            "value": ab["overhead_ratio"],
+            "unit": "x (tracing on/off, cpu)",
+            "platform": ab["platform"], "pairs": ab["pairs"],
+            "pair_ratio_iqr": ab["pair_ratio_iqr"],
+            "events": ab["events"],
+            "dropped_events": ab["dropped_events"],
+            "train_steps_traced": ab["train_steps_traced"],
+            "loss_bitwise": True, "disabled_noop": True,
+            "trace_valid": True, "train_span_tree_ok": True,
+            "serve_span_tree_ok": True, "overhead_ok": True}
+
+
 def bench_serving_chaos():
     """Config 15: serving chaos recovery (scripts/serving_chaos_soak.py;
     CPU subprocess — the resilience logic under test is host-side).  An
@@ -1189,7 +1246,8 @@ def main() -> None:
                      ("multihost_chaos_recovery", bench_multihost_chaos),
                      ("serving_throughput", bench_serving),
                      ("serving_chaos_recovery", bench_serving_chaos),
-                     ("input_pipeline_overlap", bench_input_pipeline)]:
+                     ("input_pipeline_overlap", bench_input_pipeline),
+                     ("telemetry_overhead", bench_telemetry_overhead)]:
         try:
             t0 = time.perf_counter()
             out = fn()

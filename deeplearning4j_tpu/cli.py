@@ -262,6 +262,43 @@ def _parse_chaos(spec: str):
     return FaultSchedule(faults), seed, hang
 
 
+def _setup_trace(args):
+    """Arm span tracing (docs/OBSERVABILITY.md) from ``--trace PATH`` or
+    the launcher's ``DL4J_TPU_TRACE_DIR`` env contract (each worker
+    incarnation writes its own ``worker{i}.inc{j}.trace.json``, which
+    ``launch --trace`` merges into one pod timeline).  Returns the armed
+    output path, or None when tracing stays off."""
+    import os
+
+    from .parallel.distributed import (
+        ENV_INCARNATION, ENV_TRACE_DIR, resolve_process_index,
+    )
+    path = getattr(args, "trace", None)
+    if path:
+        path = path.replace("{process}", str(resolve_process_index()))
+    else:
+        trace_dir = os.environ.get(ENV_TRACE_DIR)
+        if trace_dir:
+            inc = os.environ.get(ENV_INCARNATION, "0")
+            path = os.path.join(
+                trace_dir,
+                f"worker{resolve_process_index()}.inc{inc}.trace.json")
+    if not path:
+        return None
+    from .obs import trace as obs_trace
+    obs_trace.enable_tracing(path=path)
+    return path
+
+
+def _flush_trace(trace_path) -> None:
+    if not trace_path:
+        return
+    from .obs import trace as obs_trace
+    written = obs_trace.flush()
+    if written:
+        print(f"trace: {written} (chrome://tracing / ui.perfetto.dev)")
+
+
 def cmd_train(args) -> int:
     from .datasets import DataSet, ListDataSetIterator
     from .optimize import ScoreIterationListener
@@ -276,6 +313,7 @@ def cmd_train(args) -> int:
         print(f"distributed: process {distributed.process_index()}/"
               f"{distributed.process_count()}")
     heartbeat = Heartbeat.start_from_env()
+    trace_path = _setup_trace(args)
 
     net = _build_model(args)
     xs, ys = _load_data(args.data, train=True, num_classes=_num_classes_of(net))
@@ -429,6 +467,7 @@ def cmd_train(args) -> int:
                                        str(resolve_process_index()))
         net.save(out_path)
         print(f"saved: {out_path}")
+    _flush_trace(trace_path)
     if heartbeat is not None:
         heartbeat.stop()
     return 0
@@ -463,6 +502,7 @@ def cmd_serve(args) -> int:
     and prints the metrics snapshot."""
     from .serving import Engine, ModelRegistry
 
+    trace_path = _setup_trace(args)
     reg = ModelRegistry()
     name = args.name
     version = reg.load(name, args.model, version=args.version)
@@ -489,13 +529,14 @@ def cmd_serve(args) -> int:
             f.result(timeout=120)
         print(json.dumps(engine.metrics_snapshot()))
         engine.shutdown()
+        _flush_trace(trace_path)
         return 0
     from .ui import UIServer
 
     server = UIServer(port=args.port, host=args.host).attach_engine(engine)
     server.start()
     print(f"listening on http://{args.host}:{server.port} — "
-          "POST /predict, GET /metrics, GET /healthz")
+          "POST /predict, GET /metrics, GET /healthz, GET /trace")
     import threading
 
     try:
@@ -505,6 +546,7 @@ def cmd_serve(args) -> int:
     finally:
         server.stop()
         engine.shutdown()
+        _flush_trace(trace_path)
     return 0
 
 
@@ -571,6 +613,18 @@ def cmd_launch(args) -> int:
     if not run_dir:
         import tempfile
         run_dir = tempfile.mkdtemp(prefix="dl4j_tpu_launch_")
+    trace_dir = None
+    if args.trace:
+        # pod tracing: every worker incarnation writes its own trace
+        # file under run_dir/trace, the launcher records its
+        # spawn/leave/join/membership instants on its own track, and the
+        # merge below stitches everything into ONE pod timeline at
+        # args.trace (docs/OBSERVABILITY.md "Reading a pod timeline")
+        from .obs import trace as obs_trace
+        trace_dir = os.path.join(run_dir, "trace")
+        obs_trace.enable_tracing(
+            path=os.path.join(trace_dir, "launcher.trace.json"),
+            process_id=-1, process_name="launcher")
     chaos = _parse_chaos_worker(args.chaos_worker)
     launcher = PodLauncher(
         [_sys.executable, "-m", "deeplearning4j_tpu"] + rest,
@@ -582,7 +636,8 @@ def cmd_launch(args) -> int:
         max_restarts=args.max_restarts,
         deadline_s=args.deadline,
         connect_timeout_s=args.connect_timeout,
-        megascale_slices=args.megascale_slices)
+        megascale_slices=args.megascale_slices,
+        trace_dir=trace_dir)
     print(f"launch: {args.nprocs} worker(s) x "
           f"{args.devices_per_proc or 'default'} device(s), "
           f"bootstrap={args.bootstrap}, run dir {run_dir}"
@@ -597,6 +652,13 @@ def cmd_launch(args) -> int:
               + (f" worker {e['worker']}" if 'worker' in e else "")
               + (f" ({e['cause']}, rc={e.get('rc')})"
                  if e['kind'] in ('leave', 'unrecovered') else ""))
+    if args.trace:
+        merged = launcher.merge_trace(args.trace)
+        if merged is None:
+            print(f"trace: no worker traces found under {trace_dir}")
+        else:
+            print(f"trace: pod timeline ({merged['metadata']['events']} "
+                  f"events) -> {args.trace}")
     if report["unrecovered"]:
         print(f"launch: UNRECOVERED workers {report['unrecovered']} — "
               f"logs under {run_dir}/logs")
@@ -667,6 +729,11 @@ def build_parser() -> argparse.ArgumentParser:
                    "nan_grads/proc_kill/proc_hang (the proc_* kinds take "
                    "down THIS worker process — only meaningful under "
                    "`launch`, which restarts it)")
+    t.add_argument("--trace", metavar="PATH",
+                   help="record step/span tracing and write a Chrome-"
+                   "trace JSON to PATH on exit (view in chrome://tracing "
+                   "or ui.perfetto.dev; '{process}' expands to the worker "
+                   "index; docs/OBSERVABILITY.md)")
     t.set_defaults(fn=cmd_train)
 
     ln = sub.add_parser(
@@ -709,6 +776,11 @@ def build_parser() -> argparse.ArgumentParser:
                     help="arm worker I with a --chaos spec (repeatable), "
                     "e.g. '1:proc_kill@10' — injected only into the FIRST "
                     "incarnation, so the relaunched worker survives")
+    ln.add_argument("--trace", metavar="PATH",
+                    help="arm span tracing in every worker (per-"
+                    "incarnation files under RUN_DIR/trace) and merge "
+                    "them — plus the launcher's own membership/leave/join "
+                    "events — into ONE pod-timeline Chrome trace at PATH")
     ln.add_argument("--join", action="store_true",
                     help="join an existing cluster as one worker instead "
                     "of forking (one `launch --join` per host on a pod)")
@@ -766,6 +838,10 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("--smoke", type=int, default=0, metavar="N",
                    help="push N synthetic requests through the engine, "
                    "print the metrics snapshot, and exit (self-test)")
+    v.add_argument("--trace", metavar="PATH",
+                   help="record request/batch span tracing; the ring "
+                   "buffer is served live on GET /trace and written to "
+                   "PATH on shutdown (docs/OBSERVABILITY.md)")
     v.set_defaults(fn=cmd_serve)
 
     s = sub.add_parser("summary", help="model + memory summary")

@@ -44,6 +44,8 @@ from typing import Any, Callable, Optional
 
 import numpy as np
 
+from ..obs import trace as obs_trace
+from ..obs.metrics import get_registry
 from .dataset import DataSet
 from .iterators import DataSetIterator, _ProducerFailure
 
@@ -56,6 +58,12 @@ def live_pipelines():
     """Snapshot list over the currently-live prefetch iterators (the
     ``ui.profiler.input_pipeline_snapshot`` backing store)."""
     return list(_LIVE)
+
+
+# stall stats ride the unified registry too: one /metrics response
+# answers "is this job input-bound" (docs/OBSERVABILITY.md)
+get_registry().register_collector(
+    "input_pipeline", lambda: [p.stall_stats() for p in live_pipelines()])
 
 
 def device_put_batch(batch, placement=None):
@@ -233,7 +241,10 @@ class DevicePrefetchIterator(DataSetIterator):
             if self._closed:
                 return self._SENTINEL
             t0 = time.perf_counter()
-            item = self._queue.get()
+            # the data-wait leg of the step span taxonomy: how long the
+            # consumer sat waiting for a device-resident batch
+            with obs_trace.span("input/data_wait", cat="input"):
+                item = self._queue.get()
             waited = time.perf_counter() - t0
             with self._lock:
                 if self._first_request is None:

@@ -25,6 +25,7 @@ import numpy as np
 
 from ..datasets.dataset import DataSet
 from ..datasets.iterators import DataSetIterator, ListDataSetIterator
+from ..obs import trace as obs_trace
 from .conf.inputs import InputType
 from .conf.preprocessors import Preprocessor
 from .conf.regularizers import apply_constraints, maybe_weight_noise
@@ -505,13 +506,19 @@ class MultiLayerNetwork:
         if self._jit_step_guarded is None:
             self._jit_step_guarded = self._make_step_guarded()
         self._rng, sub = jax.random.split(self._rng)
-        x = _as_device(ds.features)
-        y = None if ds.labels is None else jax.tree_util.tree_map(_as_device, ds.labels)
-        m = _as_device(ds.features_mask)
-        lm = _as_device(ds.labels_mask)
-        self.params, self.state, self.opt_state, loss, ok = self._jit_step_guarded(
-            self.params, self.state, self.opt_state,
-            self._iter_scalar(1), x, y, sub, m, lm)
+        with obs_trace.span("train/step", cat="train", guarded=True,
+                            iteration=self.iteration + 1):
+            with obs_trace.span("train/h2d", cat="train"):
+                x = _as_device(ds.features)
+                y = (None if ds.labels is None
+                     else jax.tree_util.tree_map(_as_device, ds.labels))
+                m = _as_device(ds.features_mask)
+                lm = _as_device(ds.labels_mask)
+            with obs_trace.span("train/dispatch", cat="train"):
+                self.params, self.state, self.opt_state, loss, ok = \
+                    self._jit_step_guarded(
+                        self.params, self.state, self.opt_state,
+                        self._iter_scalar(1), x, y, sub, m, lm)
         self.iteration += 1
         # the guard's documented cost: reading the flag is a device sync
         self._note_guarded_step(bool(ok))
@@ -703,16 +710,26 @@ class MultiLayerNetwork:
         if self._jit_step is None:
             self._jit_step = self._make_step()
         self._rng, sub = jax.random.split(self._rng)
-        # device-resident batches (DevicePrefetchIterator / pre-sharded
-        # mesh input) pass through _as_device untouched
-        x = _as_device(ds.features)
-        # labels may be a pytree (e.g. Yolo2OutputLayer's dict targets)
-        y = None if ds.labels is None else jax.tree_util.tree_map(_as_device, ds.labels)
-        m = _as_device(ds.features_mask)
-        lm = _as_device(ds.labels_mask)
-        self.params, self.state, self.opt_state, loss = self._jit_step(
-            self.params, self.state, self.opt_state,
-            self._iter_scalar(1), x, y, sub, m, lm)
+        # span taxonomy (docs/OBSERVABILITY.md): train/step wraps the
+        # host side of one optimizer step; h2d is the batch staging,
+        # dispatch the fused XLA program (fwd+bwd+grad-exchange+update
+        # run on device inside it).  No-ops when tracing is off.
+        with obs_trace.span("train/step", cat="train",
+                            iteration=self.iteration + 1):
+            with obs_trace.span("train/h2d", cat="train"):
+                # device-resident batches (DevicePrefetchIterator /
+                # pre-sharded mesh input) pass through _as_device untouched
+                x = _as_device(ds.features)
+                # labels may be a pytree (e.g. Yolo2OutputLayer's dict
+                # targets)
+                y = (None if ds.labels is None
+                     else jax.tree_util.tree_map(_as_device, ds.labels))
+                m = _as_device(ds.features_mask)
+                lm = _as_device(ds.labels_mask)
+            with obs_trace.span("train/dispatch", cat="train"):
+                self.params, self.state, self.opt_state, loss = self._jit_step(
+                    self.params, self.state, self.opt_state,
+                    self._iter_scalar(1), x, y, sub, m, lm)
         self.iteration += 1
         score = LazyScore(loss)
         for lst in self.listeners:

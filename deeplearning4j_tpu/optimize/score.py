@@ -29,11 +29,16 @@ def materialize_scores(scores) -> None:
     cache the floats.  Per-score ``float()`` would pay one host round trip
     each — on a remote TPU that's ~100ms × steps; this is one."""
     import jax
+
+    from ..obs import trace as obs_trace
     lazy = [s for s in scores
             if isinstance(s, LazyScore) and not s.materialized]
     if not lazy:
         return
-    vals = jax.device_get([s._dev for s in lazy])
+    # the batched device barrier (one transfer for the whole epoch) —
+    # the other place step device time surfaces on the host timeline
+    with obs_trace.span("train/device_sync", cat="train", n_scores=len(lazy)):
+        vals = jax.device_get([s._dev for s in lazy])
     for s, v in zip(lazy, vals):
         s._val = float(v)
         s._dev = None
@@ -59,7 +64,11 @@ class LazyScore:
 
     def value(self) -> float:
         if self._val is None:
-            self._val = float(self._dev)
+            from ..obs import trace as obs_trace
+            # the host<->device barrier of the step — the only blocking
+            # read in a chained fit_batch loop (docs/OBSERVABILITY.md)
+            with obs_trace.span("train/device_sync", cat="train"):
+                self._val = float(self._dev)
             self._dev = None  # drop the device buffer once read
         return self._val
 

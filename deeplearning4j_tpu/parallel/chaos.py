@@ -42,6 +42,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from ..obs import trace as obs_trace
+
 logger = logging.getLogger("deeplearning4j_tpu")
 
 
@@ -272,6 +274,8 @@ class ChaosInjector:
 
     def _log(self, step: int, kind: str, detail: str = "") -> None:
         self.events.append({"step": step, "kind": kind, "detail": detail})
+        obs_trace.instant("fault", cat="chaos", kind=kind, step=step,
+                          detail=detail)
         logger.warning("chaos @%d: %s %s", step, kind, detail)
 
     def injected(self, kind: Optional[str] = None) -> int:
@@ -316,12 +320,16 @@ class ChaosInjector:
         self._log(self.step, kind,
                   f"{'SIGKILL' if kind == FaultKind.PROC_KILL else 'SIGSTOP'}"
                   f" pid {os.getpid()}")
-        # flush logging before the process vanishes mid-statement
+        # flush logging AND the trace ring before the process vanishes
+        # mid-statement — the proc_kill instant must survive into the
+        # worker's trace file so the merged pod timeline shows the death
+        # (docs/OBSERVABILITY.md "Reading a pod timeline")
         for h in logging.getLogger().handlers + logger.handlers:
             try:
                 h.flush()
             except Exception:
                 pass
+        obs_trace.flush()
         os.kill(os.getpid(), sig)
         # SIGSTOP parks the process here until the launcher SIGKILLs (or
         # SIGCONTs) it; SIGKILL never returns
@@ -373,6 +381,9 @@ class ServingChaos:
                 self.events.append({"batch": self.batch_index, "kind": kind,
                                     "replica": replica_idx,
                                     "t": time.monotonic()})
+                obs_trace.instant("fault", cat="chaos", kind=kind,
+                                  batch=self.batch_index,
+                                  replica=replica_idx)
                 logger.warning("serving chaos @batch %d: %s (replica %d)",
                                self.batch_index, kind, replica_idx)
         return kinds

@@ -37,6 +37,10 @@ ENV_COORDINATOR = "DL4J_TPU_COORDINATOR"
 ENV_CHAOS = "DL4J_TPU_CHAOS"
 ENV_INCARNATION = "DL4J_TPU_INCARNATION"
 ENV_CONNECT_TIMEOUT = "DL4J_TPU_CONNECT_TIMEOUT"
+#: directory each worker writes its Chrome trace file into (set by
+#: ``launch --trace``; workers name files worker{i}.inc{j}.trace.json and
+#: the launcher merges them into one pod timeline — obs/trace.py)
+ENV_TRACE_DIR = "DL4J_TPU_TRACE_DIR"
 
 
 class CoordinatorUnreachableError(ConnectionError):

@@ -26,6 +26,8 @@ from typing import Any, Callable, List, Optional
 
 import jax
 
+from ..obs import trace as obs_trace
+from ..obs.metrics import get_registry
 from .distributed import resolve_process_index
 
 logger = logging.getLogger("deeplearning4j_tpu")
@@ -405,6 +407,22 @@ class ElasticTrainer:
                                     if restart_reset_after is not None
                                     else checkpoint_every)
         self._ok_steps = 0
+        # unified registry (docs/OBSERVABILITY.md): process-wide recovery
+        # counters plus this trainer's structured stats as a collector —
+        # one /metrics response answers "how often is this job failing"
+        reg = get_registry()
+        self._m_restarts = reg.counter("elastic_restarts_total")
+        self._m_recovery_s = reg.counter("elastic_recovery_seconds_total")
+        self._m_backoff = reg.counter("elastic_backoff_sleeps_total")
+        reg.register_collector("elastic", self.recovery_stats, unique=True)
+
+    def recovery_stats(self) -> dict:
+        """Structured recovery counters (the registry collector view)."""
+        return {"global_step": self.global_step,
+                "restarts": self.restarts,
+                "total_restarts": self.total_restarts,
+                "recovery_seconds": round(self.recovery_seconds, 3),
+                "backoff_sleeps": len(self.backoff_sleeps)}
 
     @staticmethod
     def _default_loader(path: str):
@@ -416,7 +434,8 @@ class ElasticTrainer:
         return getattr(self.trainer, "net", self.trainer)
 
     def _restore(self) -> None:
-        model, step = self.ckpt.restore_latest(self.loader)
+        with obs_trace.span("ckpt/restore", cat="ckpt"):
+            model, step = self.ckpt.restore_latest(self.loader)
         if model is None:
             logger.warning("no checkpoint to restore — restarting from "
                            "current params")
@@ -441,9 +460,11 @@ class ElasticTrainer:
         failure."""
         if self.ckpt.latest() is None:
             return 0   # fresh store — nothing to resume, no warning
-        self._restore()
-        if self.global_step > 0 and hasattr(self.trainer, "_place_model"):
-            self.trainer._place_model()
+        with obs_trace.span("elastic/resume", cat="elastic") as sp:
+            self._restore()
+            if self.global_step > 0 and hasattr(self.trainer, "_place_model"):
+                self.trainer._place_model()
+            sp.set(step=self.global_step)
         self._watchdog_armed = False
         return self.global_step
 
@@ -522,13 +543,16 @@ class ElasticTrainer:
                         raise StepHangError(elapsed, self.step_timeout)
                     self._watchdog_armed = True
                 if saving:
-                    if self.async_checkpoints:
-                        # zip/deflate overlaps the next training steps;
-                        # the device→host snapshot happens here (the next
-                        # step donates these buffers)
-                        self.ckpt.save_async(self.net, self.global_step)
-                    else:
-                        self.ckpt.save(self.net, self.global_step)
+                    with obs_trace.span("ckpt/save", cat="ckpt",
+                                        step=self.global_step,
+                                        is_async=self.async_checkpoints):
+                        if self.async_checkpoints:
+                            # zip/deflate overlaps the next training
+                            # steps; the device→host snapshot happens
+                            # here (the next step donates these buffers)
+                            self.ckpt.save_async(self.net, self.global_step)
+                        else:
+                            self.ckpt.save(self.net, self.global_step)
                 self._ok_steps += 1
                 if self._ok_steps >= self.restart_reset_after and self.restarts:
                     logger.info("%d successful steps since last failure — "
@@ -542,28 +566,41 @@ class ElasticTrainer:
                 self._ok_steps = 0
                 self.restarts += 1
                 self.total_restarts += 1
+                obs_trace.instant("fault", cat="elastic",
+                                  kind=type(exc).__name__,
+                                  step=self.global_step,
+                                  restart=self.restarts)
+                self._m_restarts.inc()
                 self.detector.on_failure(exc, self.restarts)
                 if self.restarts > self.max_restarts:
                     raise RuntimeError(
                         f"exceeded max_restarts={self.max_restarts}") from exc
-                delay = self._backoff_delay()
-                if delay > 0:
-                    logger.info("backing off %.2fs before restart %d "
-                                "(exponential + jitter)", delay, self.restarts)
-                    self.backoff_sleeps.append(delay)
-                    self.sleep_fn(delay)
-                if self.rebuild_fn is not None:
-                    self.trainer = self.rebuild_fn()
-                self._restore()
-                # restored params are host arrays — a sharded trainer must
-                # re-place them on its (possibly rebuilt) mesh before the
-                # next step, or the jit step sees uncommitted inputs
-                if hasattr(self.trainer, "_place_model"):
-                    self.trainer._place_model()
+                with obs_trace.span("elastic/recovery", cat="elastic",
+                                    kind=type(exc).__name__,
+                                    step=self.global_step):
+                    delay = self._backoff_delay()
+                    if delay > 0:
+                        logger.info("backing off %.2fs before restart %d "
+                                    "(exponential + jitter)", delay,
+                                    self.restarts)
+                        self.backoff_sleeps.append(delay)
+                        self._m_backoff.inc()
+                        self.sleep_fn(delay)
+                    if self.rebuild_fn is not None:
+                        self.trainer = self.rebuild_fn()
+                    self._restore()
+                    # restored params are host arrays — a sharded trainer
+                    # must re-place them on its (possibly rebuilt) mesh
+                    # before the next step, or the jit step sees
+                    # uncommitted inputs
+                    if hasattr(self.trainer, "_place_model"):
+                        self.trainer._place_model()
                 # re-placement/rebuild recompiles: the next step gets the
                 # cold-start compile grace again
                 self._watchdog_armed = False
-                self.recovery_seconds += self.clock() - t_fail
+                spent = self.clock() - t_fail
+                self.recovery_seconds += spent
+                self._m_recovery_s.inc(max(0.0, spent))
 
     def fit(self, data, epochs: int = 1) -> List[float]:
         losses: List[float] = []

@@ -49,10 +49,12 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence
 
+from ..obs import trace as obs_trace
+from ..obs.metrics import get_registry, merge_snapshots
 from .distributed import (
     ENV_CHAOS, ENV_CONNECT_TIMEOUT, ENV_COORDINATOR, ENV_INCARNATION,
-    ENV_NUM_PROCESSES, ENV_PROCESS_ID, ENV_RUN_DIR, initialize,
-    resolve_process_index,
+    ENV_NUM_PROCESSES, ENV_PROCESS_ID, ENV_RUN_DIR, ENV_TRACE_DIR,
+    initialize, resolve_process_index,
 )
 from .elastic import FailureDetector, RecoverableInfraError
 
@@ -193,6 +195,8 @@ class Membership:
             led = {"epoch": int(led["epoch"]) + 1, "members": alive,
                    "t": self.clock()}
             _atomic_write_json(os.path.join(self.directory, self.LEDGER), led)
+            obs_trace.instant("membership/epoch", cat="launcher",
+                              epoch=led["epoch"], members=list(alive))
             logger.info("membership epoch %d: members %s", led["epoch"],
                         alive)
         return int(led["epoch"])
@@ -207,13 +211,35 @@ class Heartbeat:
 
     def __init__(self, membership: Membership, process_id: int,
                  interval: float = 0.2,
-                 step_fn: Optional[Callable[[], int]] = None):
+                 step_fn: Optional[Callable[[], int]] = None,
+                 export_metrics: bool = True, metrics_every: int = 5):
         self.membership = membership
         self.process_id = int(process_id)
         self.interval = interval
         self.step_fn = step_fn
+        # pod-level telemetry: every Nth beat also snapshots the global
+        # MetricsRegistry into run_dir/obs/ — the launcher's
+        # ``pod_metrics()`` aggregates these per-worker files into one
+        # pod view (docs/OBSERVABILITY.md)
+        self.export_metrics = export_metrics
+        self.metrics_every = max(1, int(metrics_every))
+        self._beats = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+
+    def metrics_path(self) -> str:
+        return os.path.join(self.membership.directory, "obs",
+                            f"metrics_w{self.process_id}.json")
+
+    def export_metrics_now(self) -> None:
+        try:
+            snap = get_registry().snapshot()
+            snap["process_id"] = self.process_id
+            snap["t"] = self.membership.clock()
+            os.makedirs(os.path.dirname(self.metrics_path()), exist_ok=True)
+            _atomic_write_json(self.metrics_path(), snap)
+        except (OSError, TypeError, ValueError) as exc:
+            logger.debug("metrics export failed: %s", exc)
 
     def set_step_fn(self, step_fn: Callable[[], int]) -> None:
         self.step_fn = step_fn
@@ -229,6 +255,9 @@ class Heartbeat:
             self.membership.beat(self.process_id, step=step)
         except OSError as exc:   # run dir vanished mid-shutdown — not fatal
             logger.debug("heartbeat write failed: %s", exc)
+        self._beats += 1
+        if self.export_metrics and self._beats % self.metrics_every == 1:
+            self.export_metrics_now()
 
     def start(self) -> "Heartbeat":
         if self._thread is not None:
@@ -249,6 +278,8 @@ class Heartbeat:
         if self._thread is not None:
             self._thread.join(timeout=2.0)
             self._thread = None
+        if self.export_metrics:
+            self.export_metrics_now()   # final counters beat the interval
         if deregister:
             self.membership.remove(self.process_id)
 
@@ -373,7 +404,8 @@ class PodLauncher:
                  deadline_s: float = 600.0,
                  connect_timeout_s: float = 60.0,
                  platform: Optional[str] = None,
-                 megascale_slices: Optional[int] = None):
+                 megascale_slices: Optional[int] = None,
+                 trace_dir: Optional[str] = None):
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")
         if bootstrap not in ("replica", "distributed"):
@@ -398,10 +430,28 @@ class PodLauncher:
         self.connect_timeout_s = connect_timeout_s
         self.platform = platform
         self.megascale_slices = megascale_slices
+        # when set, workers write per-incarnation Chrome traces here (the
+        # DL4J_TPU_TRACE_DIR contract) and merge_trace() stitches them —
+        # plus the launcher's own membership/leave/join instants — into
+        # one pod timeline
+        self.trace_dir = trace_dir
         self.membership = Membership(run_dir, heartbeat_timeout)
         self.handles = [_WorkerHandle(i) for i in range(num_workers)]
         self.events: List[dict] = []
         self._t0: Optional[float] = None
+        get_registry().register_collector("launcher", self.stats,
+                                          unique=True)
+
+    def stats(self) -> dict:
+        """Membership/fleet counters (the registry collector view)."""
+        by_kind: Dict[str, int] = {}
+        for e in self.events:
+            by_kind[e["kind"]] = by_kind.get(e["kind"], 0) + 1
+        return {"workers": self.num_workers,
+                "epoch": self.membership.epoch,
+                "members": self.membership.members(),
+                "restarts": sum(h.restarts for h in self.handles),
+                "events": by_kind}
 
     # -- env / spawn -------------------------------------------------------
 
@@ -412,6 +462,9 @@ class PodLauncher:
             e["worker"] = worker
         e.update(extra)
         self.events.append(e)
+        obs_trace.instant(f"launcher/{kind}", cat="launcher",
+                          **{k: v for k, v in e.items()
+                             if k not in ("t", "kind", "log_tail")})
         logger.info("launcher: %s", e)
 
     def _env_for(self, h: _WorkerHandle) -> Dict[str, str]:
@@ -443,6 +496,8 @@ class PodLauncher:
             env.pop(ENV_COORDINATOR, None)
             if self.megascale_slices:
                 env["MEGASCALE_NUM_SLICES"] = str(self.megascale_slices)
+        if self.trace_dir:
+            env[ENV_TRACE_DIR] = self.trace_dir
         spec = self.chaos.get(h.process_id)
         if spec and h.incarnation == 0:
             env[ENV_CHAOS] = spec     # consumed once per RUN: a relaunched
@@ -566,6 +621,53 @@ class PodLauncher:
                     leaked += 1
         return leaked
 
+    # -- pod-level telemetry -----------------------------------------------
+
+    def pod_metrics(self) -> dict:
+        """Aggregate the per-worker registry snapshots (written by each
+        worker's Heartbeat into run_dir/obs/) plus this launcher's own
+        registry into ONE pod-level view: counters summed, histogram
+        buckets added, gauges min/mean/max across workers — the
+        pod-scale ``/metrics`` answer."""
+        workers: Dict[str, dict] = {}
+        obs_dir = os.path.join(self.run_dir, "obs")
+        try:
+            names = sorted(os.listdir(obs_dir))
+        except OSError:
+            names = []
+        for fn in names:
+            if not (fn.startswith("metrics_w") and fn.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(obs_dir, fn)) as f:
+                    workers[fn[len("metrics_"):-len(".json")]] = json.load(f)
+            except (OSError, ValueError):
+                continue   # torn write — the next beat replaces it
+        return {"workers": workers,
+                "launcher": get_registry().snapshot(),
+                "aggregate": merge_snapshots(list(workers.values()))}
+
+    def merge_trace(self, out_path: str) -> Optional[dict]:
+        """Stitch every per-worker (and per-incarnation) trace file under
+        ``trace_dir`` — plus the launcher's own events, flushed here —
+        into one pod timeline at ``out_path``; None when tracing was not
+        armed or no worker wrote a trace."""
+        if not self.trace_dir:
+            return None
+        rec = obs_trace.get_recorder()
+        if rec is not None:
+            rec.save(os.path.join(self.trace_dir, "launcher.trace.json"))
+        try:
+            names = sorted(os.listdir(self.trace_dir))
+        except OSError:
+            return None
+        paths = [os.path.join(self.trace_dir, fn) for fn in names
+                 if fn.endswith(".trace.json")
+                 and not fn.endswith("pod.trace.json")]
+        if not paths:
+            return None
+        return obs_trace.merge_traces(paths, out_path)
+
     def run(self) -> dict:
         """Launch the fleet, heal it until every worker completes (or its
         budget/deadline runs out), and return the run report."""
@@ -613,4 +715,5 @@ class PodLauncher:
         }
         report["ok"] = (not unrecovered and not deadline_hit
                         and leaked == 0)
+        report["pod_metrics"] = self.pod_metrics()
         return report

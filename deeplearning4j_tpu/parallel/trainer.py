@@ -38,6 +38,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..datasets.dataset import DataSet
+from ..obs import trace as obs_trace
 from ..utils.jax_compat import set_mesh, shard_map
 from ..datasets.iterators import DataSetIterator
 from .mesh import (
@@ -398,9 +399,18 @@ class ShardedTrainer:
                 y = {net.conf.network_outputs[0]: y}
                 m = {net.conf.network_inputs[0]: m}
                 lm = {net.conf.network_outputs[0]: lm}
-            outs = self._compressed_step(
-                net.params, net.state, net.opt_state, net._iter_scalar(1),
-                x, y, sub, m, lm, net.grad_residual)
+            # one span for the fused step: the two-tier grad exchange
+            # (dense ICI psum + compressed DCN) runs INSIDE this program,
+            # so the host-side span is the whole dispatch — use the XLA
+            # profiler (ui/profiler.py) for the on-device breakdown
+            with obs_trace.span("train/step", cat="train",
+                                iteration=net.iteration + 1,
+                                path="compressed_exchange"):
+                with obs_trace.span("train/dispatch", cat="train"):
+                    outs = self._compressed_step(
+                        net.params, net.state, net.opt_state,
+                        net._iter_scalar(1), x, y, sub, m, lm,
+                        net.grad_residual)
             (net.params, net.state, net.opt_state, net.grad_residual,
              loss) = outs[:5]
             net.iteration += 1

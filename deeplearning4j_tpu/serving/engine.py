@@ -59,6 +59,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ..obs import trace as obs_trace
 from .batcher import DeadlineExceededError, DynamicBatcher, _Request
 from .metrics import ServingMetrics
 
@@ -552,6 +553,10 @@ class Engine:
             return
         for r in live:
             self.metrics.queue_wait.record((now - r.t_submit) * 1e3)
+            # post-hoc span: the request sat queued from submit to here
+            # (the engine clock and the trace clock are both monotonic)
+            obs_trace.complete_at("serve/queue_wait", r.t_submit, now,
+                                  cat="serve", rows=r.rows)
         with self._vlock:
             v = self._current
             v.active += 1
@@ -563,6 +568,9 @@ class Engine:
             out, rows, bucket, padded = self._forward_padded(
                 v, replica.idx, live)
             device_ms = (self.clock() - t0) * 1e3
+            obs_trace.complete_at("serve/forward", t0, self.clock(),
+                                  cat="serve", replica=replica.idx,
+                                  rows=rows, bucket=bucket, tag=v.tag)
             if self.poison_isolation and not np.isfinite(out).all():
                 # non-finite forward: bisect to isolate the poison
                 # request(s) so co-batched requests still succeed
@@ -604,6 +612,14 @@ class Engine:
             _set_safe(r.future, out[ofs:ofs + r.rows])
             ofs += r.rows
             self.metrics.e2e.record((done - r.t_submit) * 1e3)
+            obs_trace.complete_at("serve/request", r.t_submit, done,
+                                  cat="serve", rows=r.rows,
+                                  retries=r.retries)
+        # the batch-execution span wraps the forward on this replica's
+        # thread track (queue_wait spans end where this one begins)
+        obs_trace.complete_at("serve/batch", now, done, cat="serve",
+                              replica=replica.idx, n_requests=len(live),
+                              rows=rows, padded=padded, tag=v.tag)
         can = self._canary
         if can is not None and not can.done.is_set():
             self._mirror_canary(can, replica, live, out, device_ms)
@@ -680,6 +696,9 @@ class Engine:
         if not retry:
             return
         self.metrics.inc("retries", len(retry))
+        obs_trace.instant("serve/retry", cat="serve", n_requests=len(retry),
+                          failed_replica=failed_idx,
+                          error=type(error).__name__)
         self._redispatch(retry)
 
     def _redispatch(self, reqs: List[_Request]) -> None:
@@ -770,8 +789,14 @@ class Engine:
         if ex is not None:
             self._release(ex)       # idempotent vs the hung finally
         self.metrics.inc("replica_crashes" if crashed else "replica_hangs")
+        obs_trace.instant(
+            "serve/replica_crash" if crashed else "serve/replica_hang",
+            cat="serve", replica=r.idx,
+            in_flight=len(batch) if batch else 0)
         if opened:
             self.metrics.inc("circuit_opens")
+            obs_trace.instant("serve/circuit_open", cat="serve",
+                              replica=r.idx)
         # respawn FIRST so the retry path has a live target even with a
         # single replica...
         self._start_replica_thread(r)
@@ -791,7 +816,8 @@ class Engine:
     def _recover_replica(self, r: _Replica, batch: Optional[List[_Request]],
                          error: RuntimeError) -> None:
         try:
-            self._rewarm_replica(r.idx)   # cache-hit pass: zero compiles
+            with obs_trace.span("serve/respawn", cat="serve", replica=r.idx):
+                self._rewarm_replica(r.idx)   # cache-hit pass: 0 compiles
         except Exception:
             # the replica will fail its next batch and re-enter the
             # supervisor; the breaker bounds how often we retry
@@ -936,6 +962,9 @@ class Engine:
             "mean_divergence": (round(mean_div, 6) if mean_div is not None
                                 else None),
         }
+        obs_trace.instant("serve/canary_decision", cat="serve",
+                          candidate=nv.tag, promote=promote,
+                          reasons=list(reasons))
         if promote:
             self._swap_version(nv)      # already warmed: no extra compiles
             self.metrics.inc("canary_promotions")
@@ -970,6 +999,8 @@ class Engine:
                     old.drained.set()
             old.drained.wait()
             self.metrics.inc("swaps")
+            obs_trace.instant("serve/swap", cat="serve", incoming=nv.tag,
+                              retired=old.tag)
             return old.tag
 
     @property

@@ -1,9 +1,15 @@
-"""Serving observability: fixed-bucket latency histograms + counters.
+"""Serving observability, on the unified registry (obs/metrics.py).
 
 The reference stack exports serving metrics through its model-server's
 /metrics-style endpoints; here a `ServingMetrics` instance is owned by one
-`serving.Engine` and exported two ways: `snapshot()` (a plain dict, the
-test/API surface) and the `ui/server.py` `/metrics` JSON endpoint.
+`serving.Engine` and exported three ways: `snapshot()` (the legacy plain
+dict — the test/API surface, schema unchanged since PR 4), the per-engine
+``registry`` (typed instruments, one schema with every other subsystem),
+and the process-global ``obs.metrics.get_registry()`` — each
+ServingMetrics registers itself as a collector there, so one
+``MetricsRegistry.snapshot()`` / one ``UIServer /metrics`` response
+carries every live engine alongside the elastic / input-pipeline /
+launcher stats (docs/OBSERVABILITY.md).
 
 Histograms are FIXED-bucket (exponential ms boundaries), not reservoirs:
 recording is O(#buckets) worst case, lock-held time is tiny, and snapshots
@@ -17,67 +23,52 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, Sequence
 
-# 0.1 ms .. 10 s — covers a jitted forward on any sane hardware on the
-# left and a pathological queue stall on the right; +inf is implicit
-DEFAULT_BUCKETS_MS: Sequence[float] = (
-    0.1, 0.2, 0.5, 1.0, 2.0, 5.0, 10.0, 20.0, 50.0, 100.0,
-    200.0, 500.0, 1000.0, 2000.0, 5000.0, 10000.0,
+from ..obs.metrics import (
+    DEFAULT_LATENCY_BUCKETS_MS, Histogram, MetricsRegistry, get_registry,
 )
 
+# kept as the serving-local name; one source of truth in obs/metrics.py
+DEFAULT_BUCKETS_MS: Sequence[float] = DEFAULT_LATENCY_BUCKETS_MS
 
-class LatencyHistogram:
-    """Fixed-boundary histogram over milliseconds (thread-safe)."""
 
-    def __init__(self, buckets_ms: Sequence[float] = DEFAULT_BUCKETS_MS):
-        self.bounds = tuple(sorted(buckets_ms))
-        self._counts = [0] * (len(self.bounds) + 1)  # +1 = overflow
-        self.count = 0
-        self.sum_ms = 0.0
-        self.max_ms = 0.0
-        self._lock = threading.Lock()
+class LatencyHistogram(Histogram):
+    """The unified fixed-bucket histogram with the serving-legacy
+    millisecond surface: ``record(ms)``, ``count``/``sum_ms``/``max_ms``
+    attributes, and the ``*_ms``-keyed ``snapshot()`` schema the serving
+    tests and A/B scripts read."""
 
-    def record(self, ms: float) -> None:
-        i = 0
-        for i, b in enumerate(self.bounds):
-            if ms <= b:
-                break
-        else:
-            i = len(self.bounds)
+    def __init__(self, buckets_ms: Sequence[float] = DEFAULT_BUCKETS_MS,
+                 name: str = "latency_ms"):
+        super().__init__(name, buckets_ms)
+
+    def _unlabeled(self):
         with self._lock:
-            self._counts[i] += 1
-            self.count += 1
-            self.sum_ms += ms
-            if ms > self.max_ms:
-                self.max_ms = ms
+            return self._series.get(())
 
-    def percentile(self, p: float) -> Optional[float]:
-        """Approximate p-th percentile (0<p<=100) via in-bucket linear
-        interpolation; None when empty.  Overflow-bucket hits report the
-        max seen (there is no upper boundary to interpolate against)."""
-        with self._lock:
-            if not self.count:
-                return None
-            rank = p / 100.0 * self.count
-            seen = 0
-            for i, c in enumerate(self._counts):
-                if not c:
-                    continue
-                if seen + c >= rank:
-                    if i >= len(self.bounds):
-                        return self.max_ms
-                    lo = self.bounds[i - 1] if i else 0.0
-                    hi = self.bounds[i]
-                    frac = (rank - seen) / c
-                    return lo + (hi - lo) * frac
-                seen += c
-            return self.max_ms
+    @property
+    def count(self) -> int:
+        s = self._unlabeled()
+        return s.count if s else 0
+
+    @property
+    def sum_ms(self) -> float:
+        s = self._unlabeled()
+        return s.total if s else 0.0
+
+    @property
+    def max_ms(self) -> float:
+        s = self._unlabeled()
+        return s.max_value if s else 0.0
 
     def snapshot(self) -> dict:
         with self._lock:
-            counts = list(self._counts)
-            count, total, mx = self.count, self.sum_ms, self.max_ms
+            s = self._series.get(())
+            counts = list(s.counts) if s else [0] * (len(self.bounds) + 1)
+            count = s.count if s else 0
+            total = s.total if s else 0.0
+            mx = s.max_value if s else 0.0
         out = {"count": count, "sum_ms": round(total, 3),
                "max_ms": round(mx, 3),
                "mean_ms": round(total / count, 3) if count else None,
@@ -88,9 +79,21 @@ class LatencyHistogram:
         return out
 
 
+# every counter a fresh engine reports as zero (docs/SERVING.md: the
+# batching/admission set, then the resilience + canary set)
+_COUNTER_KEYS = (
+    "requests", "rows", "batches", "padded_rows",
+    "shed", "deadline_missed", "errors", "swaps", "unwarmed_serves",
+    "replica_crashes", "replica_hangs", "replica_respawns",
+    "retries", "poison_isolated", "circuit_opens",
+    "canary_promotions", "canary_rollbacks", "canary_mirrored_batches",
+)
+
+
 class ServingMetrics:
     """Per-engine metric set: three latency histograms (queue wait,
-    device time, end-to-end) + batching/admission counters.
+    device time, end-to-end) + batching/admission/resilience counters —
+    all typed instruments in the per-engine ``registry``.
 
     Batch occupancy (padding waste) is the satellite-regression metric:
     ``padded_rows / (rows + padded_rows)`` should stay near zero when
@@ -98,46 +101,55 @@ class ServingMetrics:
     ``max_batch`` before bucketing (the old ``ParallelInference._run``
     bug) shows up here as waste and as ``max_batch_rows`` > max_batch."""
 
-    def __init__(self, buckets_ms: Sequence[float] = DEFAULT_BUCKETS_MS):
-        self.queue_wait = LatencyHistogram(buckets_ms)
-        self.device_time = LatencyHistogram(buckets_ms)
-        self.e2e = LatencyHistogram(buckets_ms)
+    def __init__(self, buckets_ms: Sequence[float] = DEFAULT_BUCKETS_MS,
+                 registry: MetricsRegistry = None):
+        self.registry = registry or MetricsRegistry()
+        self.queue_wait = self.registry.register(
+            LatencyHistogram(buckets_ms, name="queue_wait_ms"))
+        self.device_time = self.registry.register(
+            LatencyHistogram(buckets_ms, name="device_time_ms"))
+        self.e2e = self.registry.register(
+            LatencyHistogram(buckets_ms, name="e2e_ms"))
+        self._counters = {k: self.registry.counter(k) for k in _COUNTER_KEYS}
         self._lock = threading.Lock()
-        self._c: Dict[str, int] = {
-            "requests": 0, "rows": 0, "batches": 0, "padded_rows": 0,
-            "shed": 0, "deadline_missed": 0, "errors": 0, "swaps": 0,
-            "unwarmed_serves": 0,
-            # resilience counters (docs/SERVING.md "Failure model"):
-            # supervisor interventions, request retries, poison isolation,
-            # breaker trips, and canary promotion decisions
-            "replica_crashes": 0, "replica_hangs": 0, "replica_respawns": 0,
-            "retries": 0, "poison_isolated": 0, "circuit_opens": 0,
-            "canary_promotions": 0, "canary_rollbacks": 0,
-            "canary_mirrored_batches": 0,
-        }
         self._batch_rows_max = 0
+        self._rows_max_gauge = self.registry.gauge("max_batch_rows")
+        self._rows_max_gauge.set(0)
         self._t0 = time.monotonic()
+        # one process-wide surface: every live engine's snapshot rides
+        # the global registry (weakly — a dropped engine unregisters)
+        self.global_name = get_registry().register_collector(
+            "serving", self.snapshot, unique=True)
 
     def inc(self, key: str, n: int = 1) -> None:
-        with self._lock:
-            self._c[key] = self._c.get(key, 0) + n
+        c = self._counters.get(key)
+        if c is None:        # open key set, as before the migration
+            with self._lock:
+                c = self._counters.get(key)
+                if c is None:
+                    c = self._counters[key] = self.registry.counter(key)
+        c.inc(n)
 
     def record_batch(self, n_requests: int, rows: int, padded_rows: int,
                      device_ms: float) -> None:
+        self._counters["batches"].inc()
+        self._counters["requests"].inc(n_requests)
+        self._counters["rows"].inc(rows)
+        self._counters["padded_rows"].inc(padded_rows)
         with self._lock:
-            self._c["batches"] += 1
-            self._c["requests"] += n_requests
-            self._c["rows"] += rows
-            self._c["padded_rows"] += padded_rows
             if rows > self._batch_rows_max:
                 self._batch_rows_max = rows
+                self._rows_max_gauge.set(rows)
         self.device_time.record(device_ms)
 
     def snapshot(self) -> dict:
+        c: Dict[str, int] = {}
+        for k, counter in list(self._counters.items()):
+            v = counter.value()
+            c[k] = int(v) if float(v).is_integer() else v
         with self._lock:
-            c = dict(self._c)
             rows_max = self._batch_rows_max
-            elapsed = time.monotonic() - self._t0
+        elapsed = time.monotonic() - self._t0
         total = c["rows"] + c["padded_rows"]
         return {
             "counters": c,

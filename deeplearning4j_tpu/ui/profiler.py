@@ -29,21 +29,34 @@ def input_pipeline_snapshot() -> List[dict]:
 @contextlib.contextmanager
 def profile_trace(logdir: str, create_perfetto_link: bool = False) -> Iterator[None]:
     """Context manager: ``with profile_trace('/tmp/trace'): train()`` —
-    view with TensorBoard's profile plugin (or perfetto).  No-ops cleanly
-    if the profiler backend is unavailable."""
-    import jax
+    view with TensorBoard's profile plugin (or perfetto; the
+    ``create_perfetto_link`` path stays available where the TPU backend
+    supports it).  Degrades gracefully when the profiler backend is
+    unavailable (CPU CI, stripped jaxlib builds): instead of raising, the
+    region runs untraced and a ``profiler/unavailable`` instant event is
+    recorded into the span trace (obs/trace.py) so the gap is visible in
+    the timeline rather than silent."""
+    from ..obs import trace as obs_trace
 
+    started = False
     try:
+        import jax
+
         jax.profiler.start_trace(logdir,
                                  create_perfetto_link=create_perfetto_link)
         started = True
-    except Exception:   # profiler unavailable on this backend/build
-        started = False
+    except Exception as e:   # profiler unavailable on this backend/build
+        obs_trace.instant("profiler/unavailable", cat="profiler",
+                          logdir=logdir, error=f"{type(e).__name__}: {e}")
     try:
-        yield
+        with obs_trace.span("profiler/trace", cat="profiler", logdir=logdir,
+                            backend_started=started):
+            yield
     finally:
         if started:
             try:
+                import jax
+
                 jax.profiler.stop_trace()
             except Exception:
                 pass

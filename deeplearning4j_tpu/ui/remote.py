@@ -23,6 +23,9 @@ import urllib.error
 import urllib.request
 from typing import List, Optional
 
+from ..obs import trace as obs_trace
+from ..obs.metrics import get_registry
+
 logger = logging.getLogger("deeplearning4j_tpu")
 
 
@@ -43,6 +46,12 @@ class RemoteStatsRouter:
         self.max_buffer = max_buffer
         self._pending: List[dict] = []
         self.dropped = 0
+        # silent data loss is the failure mode a dashboard can't show:
+        # dropped records count into the unified registry and the FIRST
+        # drop warns loudly (once — steady-state drops would spam)
+        self._dropped_counter = get_registry().counter(
+            "ui_remote_dropped_records_total")
+        self._drop_warned = False
 
     # -- StatsStorage surface (ui/storage.py contract) ---------------------
 
@@ -92,4 +101,16 @@ class RemoteStatsRouter:
             # drop OLDEST records; a dashboard cares about the recent ones
             self._pending = self._pending[overflow:]
             self.dropped += overflow
+            self._dropped_counter.inc(overflow)
+            obs_trace.instant("ui/remote_drop", cat="ui", dropped=overflow,
+                              total_dropped=self.dropped)
+            if not self._drop_warned:
+                self._drop_warned = True
+                logger.warning(
+                    "RemoteStatsRouter is DROPPING stats records: buffer "
+                    "full (max_buffer=%d) while %s is unreachable — %d "
+                    "record(s) discarded so far; this warning fires once, "
+                    "watch the ui_remote_dropped_records_total counter "
+                    "(/metrics) for the running total",
+                    self.max_buffer, self.url, self.dropped)
         return False

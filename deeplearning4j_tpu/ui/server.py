@@ -50,6 +50,8 @@ class UIServer:
 
     def _metrics_json(self) -> str:
         import json
+
+        from ..obs.metrics import get_registry
         serving = []
         for p in self._metrics_providers:
             snap = p() if callable(p) else p.snapshot()
@@ -62,7 +64,25 @@ class UIServer:
                 sessions[sid] = {"updates": len(ups),
                                  "last_iteration": last.get("iteration"),
                                  "last_score": last.get("score")}
-        return json.dumps({"serving": serving, "sessions": sessions})
+        # the unified registry (docs/OBSERVABILITY.md): serving engines,
+        # elastic recovery counters, input-pipeline stall stats, launcher
+        # membership — one schema beside the legacy keys
+        return json.dumps({"serving": serving, "sessions": sessions,
+                           "registry": get_registry().snapshot()})
+
+    def _trace_json(self) -> str:
+        """GET /trace: the ring buffer as a Chrome trace-event JSON
+        object (load in chrome://tracing or ui.perfetto.dev); an empty
+        trace with a hint when tracing is off."""
+        import json
+
+        from ..obs import trace as obs_trace
+        rec = obs_trace.get_recorder()
+        if rec is None:
+            return json.dumps({"traceEvents": [], "metadata": {
+                "tracing": "disabled — enable with --trace PATH or "
+                           "obs.enable_tracing()"}})
+        return json.dumps(rec.export())
 
     def _predict_json(self, body: bytes):
         """(status, payload) for POST /predict.  Every error is
@@ -167,6 +187,10 @@ class UIServer:
                             urllib.parse.unquote(sid))
                     elif path == "/metrics":
                         self._reply(200, server._metrics_json().encode(),
+                                    "application/json")
+                        return
+                    elif path == "/trace":
+                        self._reply(200, server._trace_json().encode(),
                                     "application/json")
                         return
                     elif path == "/healthz":

@@ -479,7 +479,20 @@ def main() -> None:
     if "--worker" in sys.argv:
         run_worker()
         return
+    # span tracing rides along for free (no math impact — the bit-identity
+    # arms gate that): a FAILED soak dumps the ring buffer so the fault /
+    # recovery / checkpoint timeline is debuggable from one file
+    from deeplearning4j_tpu.obs import trace as obs_trace
+    rec = obs_trace.enable_tracing(capacity=131072)
     out = run_multiproc_soak() if "--multiproc" in sys.argv else run_soak()
+    if not out["soak_ok"]:
+        import tempfile
+        path = os.path.join(tempfile.gettempdir(),
+                            "chaos_soak_failure.trace.json")
+        try:
+            out["trace_artifact"] = rec.save(path)
+        except OSError:
+            out["trace_artifact"] = None
     print(json.dumps(out), flush=True)
     if not out["soak_ok"]:
         raise SystemExit(2)

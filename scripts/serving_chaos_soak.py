@@ -423,11 +423,25 @@ def main() -> int:
           f"{args.interarrival_ms}ms inter-arrival, {n_off} identity "
           f"requests, platform={jax.devices()[0].platform}", file=sys.stderr)
 
+    # tracing rides along (crash/hang/retry/canary instants + per-request
+    # spans); a FAILED soak dumps the ring buffer as its debug artifact
+    from deeplearning4j_tpu.obs import trace as obs_trace
+    rec = obs_trace.enable_tracing(capacity=131072)
+
     out = {"config": "serving_chaos_recovery",
            "platform": jax.devices()[0].platform, "quick": quick}
     out.update(run_off_identity(n_off))
     out.update(run_chaos_arm(n_requests, args.interarrival_ms))
     out["soak_ok"] = bool(out["off_behavior_identical"] and out["chaos_ok"])
+    if not out["soak_ok"]:
+        import os
+        import tempfile
+        path = os.path.join(tempfile.gettempdir(),
+                            "serving_chaos_soak_failure.trace.json")
+        try:
+            out["trace_artifact"] = rec.save(path)
+        except OSError:
+            out["trace_artifact"] = None
     print(json.dumps(out), flush=True)
     return 0 if out["soak_ok"] else 2
 
