@@ -42,6 +42,16 @@ stderr, including:
     both proc-fault recoveries completing training, chaos-off 2-process
     bit-identity with the single-process baseline, bit-exact trajectory
     replay after resume, and zero orphan worker processes
+  - preemption_recovery: the ANNOUNCED-failure gate (scripts/chaos_soak.py
+    --preempt) — a scheduled preemption notice (SIGTERM) against the
+    writer/coordinator worker plus a slow_worker straggler and a
+    coordinator kill, hard-gated on the emergency checkpoint landing
+    within the grace budget, a PREEMPTED exit relaunching WITHOUT
+    consuming the restart budget, resume at exactly the preempted step
+    (zero steps lost) with bit-exact trajectory replay, coordinator-kill
+    recovery to completion, heartbeat-based straggler flagging, zero
+    orphans, and chaos-off bit-identity with the pre-PR launcher
+    configuration (docs/FAULT_TOLERANCE.md "Announced failures")
   - input_pipeline_overlap: the device-resident input-pipeline A/B gate
     (scripts/input_pipeline_ab.py) — sync host feeding vs
     DevicePrefetchIterator (async H2D ring, uint8 wire, on-device
@@ -1224,6 +1234,84 @@ def bench_multihost_chaos():
             "leaked": 0, "wall_seconds": soak["wall_seconds"]}
 
 
+def bench_preemption():
+    """Config 17: announced-failure recovery (scripts/chaos_soak.py
+    --preempt; CPU subprocesses — signal/process lifecycle needs no
+    accelerator).  The PodLauncher forks 2 workers x 4 virtual devices;
+    worker 0 (writer + coordinator) receives a scheduled preemption
+    notice (SIGTERM self) and, in a separate arm, a coordinator kill;
+    worker 1 is made a straggler.  HARD gates (the preemption-tolerance
+    contract): the emergency checkpoint lands WITHIN the grace budget,
+    the preempted worker exits with the distinct PREEMPTED code and
+    relaunches WITHOUT consuming the restart budget, the relaunched
+    incarnation resumes at EXACTLY the preempted step (zero steps lost)
+    with a bit-exact trajectory replay, the coordinator kill recovers to
+    training completion, the straggler is flagged from heartbeat step
+    times within the beat budget, zero orphan processes, and the
+    chaos-off arm (announced-failure machinery armed, no faults) stays
+    BIT-IDENTICAL to the pre-PR single-process baseline with zero
+    restarts/planned leaves/straggler flags.  The reported value is the
+    planned-leave count — fixed by the deterministic schedule."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    script = os.path.join(_REPO, "scripts", "chaos_soak.py")
+    cmd = [sys.executable, script, "--preempt"] + \
+        (["--quick"] if QUICK else [])
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=1800, cwd=_REPO)
+    if p.returncode != 0:
+        raise RuntimeError(f"preemption chaos_soak failed (rc="
+                           f"{p.returncode}): {p.stdout[-500:]} "
+                           f"{p.stderr[-1000:]}")
+    soak = json.loads(p.stdout.strip().splitlines()[-1])
+    if soak.get("unrecovered") != 0 or soak.get("coord_unrecovered") != 0:
+        raise RuntimeError(f"preemption soak had UNRECOVERED workers: "
+                           f"{soak}")
+    if not soak.get("emergency_within_grace"):
+        raise RuntimeError("emergency checkpoint missed the grace budget "
+                           f"(or never landed): {soak}")
+    if not soak.get("zero_steps_lost"):
+        raise RuntimeError("steps were lost beyond the preempted step: "
+                           f"{soak}")
+    if not soak.get("budget_untouched"):
+        raise RuntimeError("planned leave consumed the restart budget: "
+                           f"{soak}")
+    if not soak.get("preempt_loss_bitwise") \
+            or not soak.get("coord_loss_bitwise"):
+        raise RuntimeError("post-resume trajectory diverged from the "
+                           f"baseline: {soak}")
+    if not soak.get("coord_ok"):
+        raise RuntimeError(f"coordinator-kill recovery gate FAILED: {soak}")
+    if not soak.get("straggler_flagged"):
+        raise RuntimeError(f"straggler was never flagged: {soak}")
+    if not soak.get("off_bitwise") or not soak.get("off_ok"):
+        raise RuntimeError("chaos-off arm is no longer bit-identical to "
+                           f"the pre-PR launcher configuration: {soak}")
+    if soak.get("preempt_leaked", 1) != 0 or soak.get("off_leaked", 1) != 0 \
+            or soak.get("coord_leaked", 1) != 0:
+        raise RuntimeError(f"orphan worker survived the soak: {soak}")
+    if not soak.get("soak_ok"):
+        raise RuntimeError(f"preemption soak gate FAILED: {soak}")
+    return {"metric": "preemption_recovery",
+            "value": soak["planned_leaves"], "unit": "planned leaves",
+            "platform": soak["platform"],
+            "workers": soak["workers"],
+            "grace_s": soak["grace_s"],
+            "emergency_seconds": soak["emergency"]["seconds"],
+            "emergency_stored_fallback": soak["emergency"]["stored"],
+            "preempted_at_step": soak["preempted_at_step"],
+            "resume_start_steps": soak["resume_start_steps"],
+            "restart_budget_used": soak["restart_budget_used"],
+            "coord_restarts": soak["coord_restarts"],
+            "stragglers_flagged": len(soak["straggler_events"]),
+            "zero_steps_lost": True, "off_bitwise": True,
+            "preempt_loss_bitwise": True, "coord_loss_bitwise": True,
+            "leaked": 0, "wall_seconds": soak["wall_seconds"]}
+
+
 def main() -> None:
     import jax
 
@@ -1244,6 +1332,7 @@ def main() -> None:
                      ("grad_compression", bench_grad_compression),
                      ("chaos_recovery", bench_chaos_recovery),
                      ("multihost_chaos_recovery", bench_multihost_chaos),
+                     ("preemption_recovery", bench_preemption),
                      ("serving_throughput", bench_serving),
                      ("serving_chaos_recovery", bench_serving_chaos),
                      ("input_pipeline_overlap", bench_input_pipeline),

@@ -218,15 +218,18 @@ def _resolve_device(spec: str):
 
 
 def _parse_chaos(spec: str):
-    """'kind@step[,kind@step...][,seed=S][,hang=SECONDS]' →
-    (FaultSchedule, seed, hang_seconds).  Fault kinds are the
-    parallel/chaos.py FaultKind names (device_loss, ckpt_write_crash,
-    ckpt_truncate, ckpt_bitflip, hung_step, nan_grads); every parse
-    failure is a one-line CLI error, not a traceback."""
+    """'kind@step[,kind@step...][,seed=S][,hang=SECONDS][,slow=SECONDS]' →
+    (FaultSchedule, seed, hang_seconds, slow_seconds).  Fault kinds are
+    the parallel/chaos.py FaultKind names (device_loss, ckpt_write_crash,
+    ckpt_truncate, ckpt_bitflip, hung_step, nan_grads, proc_kill,
+    proc_hang, preempt_notice, coord_kill, slow_worker); ``slow=`` is the
+    per-step drag a scheduled slow_worker adds (default: the hang
+    seconds); every parse failure is a one-line CLI error, not a
+    traceback."""
     from .parallel.chaos import FaultKind, FaultSchedule
 
     faults: dict = {}
-    seed, hang = 0, 5.0
+    seed, hang, slow = 0, 5.0, None
     for part in spec.split(","):
         part = part.strip()
         if "=" in part and "@" not in part:
@@ -236,9 +239,11 @@ def _parse_chaos(spec: str):
                     seed = int(val)
                 elif key == "hang":
                     hang = float(val)
+                elif key == "slow":
+                    slow = float(val)
                 else:
                     raise SystemExit(f"bad --chaos {spec!r}: unknown option "
-                                     f"{key!r} (seed=, hang=)")
+                                     f"{key!r} (seed=, hang=, slow=)")
             except ValueError:
                 raise SystemExit(f"bad --chaos {spec!r}: {key}= needs a "
                                  "number")
@@ -259,7 +264,7 @@ def _parse_chaos(spec: str):
         raise SystemExit(f"bad --chaos {spec!r}: no faults — expected "
                          "kind@step[,kind@step...], e.g. "
                          "'device_loss@5,nan_grads@9,seed=1'")
-    return FaultSchedule(faults), seed, hang
+    return FaultSchedule(faults), seed, hang, slow
 
 
 def _setup_trace(args):
@@ -425,25 +430,42 @@ def cmd_train(args) -> int:
         inner = trainer if trainer is not None else _Plain(net)
         injector = None
         if chaos_spec:
-            sched, seed, hang = _parse_chaos(chaos_spec)
+            sched, seed, hang, slow = _parse_chaos(chaos_spec)
             injector = inner = ChaosInjector(inner, sched,
-                                             hang_seconds=hang, seed=seed)
+                                             hang_seconds=hang, seed=seed,
+                                             slow_seconds=slow)
             print(f"chaos armed: {sched.pending()} fault(s) scheduled")
+        # announced failures (docs/FAULT_TOLERANCE.md): SIGTERM/SIGUSR1 is
+        # a preemption notice — grace-window emergency checkpoint at the
+        # next step boundary, then a distinct PREEMPTED exit so the
+        # launcher relaunches without burning the restart budget
+        from .parallel.preemption import PreemptionHandler
+        preemption = PreemptionHandler.install_from_env(grace_s=args.grace)
         trainer = ElasticTrainer(
             inner, args.elastic_dir, checkpoint_every=args.checkpoint_every,
             sync_every=min(10, args.checkpoint_every),
-            step_timeout=args.step_timeout, backoff_base=0.5, jitter_seed=0)
+            step_timeout=args.step_timeout, backoff_base=0.5, jitter_seed=0,
+            preemption=preemption)
         if injector is not None:
             injector.attach_checkpoints(trainer.ckpt)
         if heartbeat is not None:
             heartbeat.set_step_fn(lambda: trainer.global_step)
+            heartbeat.set_ckpt_step_fn(lambda: trainer.last_checkpoint_step)
         # host (re)join: a relaunched worker resumes from the cluster's
         # newest checkpoint instead of step 0
         resumed = trainer.resume()
         if resumed:
             print(f"resumed from checkpoint @ step {resumed}")
-    losses = (trainer.fit(it, epochs=args.epochs) if trainer
-              else net.fit(it, epochs=args.epochs))
+    from .parallel.preemption import PreemptedError
+    try:
+        losses = (trainer.fit(it, epochs=args.epochs) if trainer
+                  else net.fit(it, epochs=args.epochs))
+    except PreemptedError as exc:
+        print(f"preempted: {exc}")
+        _flush_trace(trace_path)
+        if heartbeat is not None:
+            heartbeat.stop()
+        return exc.exit_code
     if args.elastic_dir:
         et = trainer
         print(f"elastic: {et.total_restarts} recovery(ies), "
@@ -637,7 +659,11 @@ def cmd_launch(args) -> int:
         deadline_s=args.deadline,
         connect_timeout_s=args.connect_timeout,
         megascale_slices=args.megascale_slices,
-        trace_dir=trace_dir)
+        trace_dir=trace_dir,
+        grace_s=args.grace,
+        straggler_factor=args.straggler_factor,
+        straggler_beats=args.straggler_beats,
+        straggler_policy=args.straggler_policy)
     print(f"launch: {args.nprocs} worker(s) x "
           f"{args.devices_per_proc or 'default'} device(s), "
           f"bootstrap={args.bootstrap}, run dir {run_dir}"
@@ -645,7 +671,11 @@ def cmd_launch(args) -> int:
     report = launcher.run()
     print(f"launch: completed={report['completed']} "
           f"restarts={report['restarts']} "
-          f"epoch={report['epoch']} leaked={report['leaked_killed']} "
+          f"planned_leaves={report['planned_leaves']} "
+          f"stragglers={len(report['stragglers'])} "
+          f"epoch={report['epoch']} "
+          f"last_ckpt_step={report['last_checkpoint_step']} "
+          f"leaked={report['leaked_killed']} "
           f"wall={report['wall_seconds']}s")
     for e in report["events"]:
         print(f"  [{e['t']:8.2f}s] {e['kind']}"
@@ -724,16 +754,27 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--chaos", metavar="SPEC",
                    help="inject scripted faults (chaos drill; needs "
                    "--elastic-dir): 'kind@step[,kind@step...]"
-                   "[,seed=S][,hang=SECONDS]', kinds: device_loss/"
-                   "ckpt_write_crash/ckpt_truncate/ckpt_bitflip/hung_step/"
-                   "nan_grads/proc_kill/proc_hang (the proc_* kinds take "
-                   "down THIS worker process — only meaningful under "
-                   "`launch`, which restarts it)")
+                   "[,seed=S][,hang=SECONDS][,slow=SECONDS]', kinds: "
+                   "device_loss/ckpt_write_crash/ckpt_truncate/"
+                   "ckpt_bitflip/hung_step/nan_grads/proc_kill/proc_hang/"
+                   "preempt_notice/coord_kill/slow_worker (the process "
+                   "kinds take down THIS worker — only meaningful under "
+                   "`launch`, which restarts it; preempt_notice is the "
+                   "ANNOUNCED path: SIGTERM self, emergency checkpoint, "
+                   "PREEMPTED exit)")
     t.add_argument("--trace", metavar="PATH",
                    help="record step/span tracing and write a Chrome-"
                    "trace JSON to PATH on exit (view in chrome://tracing "
                    "or ui.perfetto.dev; '{process}' expands to the worker "
                    "index; docs/OBSERVABILITY.md)")
+    t.add_argument("--grace", type=float, default=None, metavar="SECONDS",
+                   help="preemption grace budget for --elastic-dir runs: "
+                   "on SIGTERM/SIGUSR1 (a preemption notice) the next "
+                   "step boundary writes a deadline-bounded emergency "
+                   "checkpoint (uncompressed fallback when deflate won't "
+                   "fit the remaining budget) and exits with the "
+                   "PREEMPTED code 75 (default: DL4J_TPU_GRACE_S env, "
+                   "else 30)")
     t.set_defaults(fn=cmd_train)
 
     ln = sub.add_parser(
@@ -772,6 +813,26 @@ def build_parser() -> argparse.ArgumentParser:
                     "workers (feeds detect_num_slices → "
                     "ShardedTrainer.two_tier / build_two_tier_mesh); "
                     "distributed bootstrap defaults it to --nprocs")
+    ln.add_argument("--grace", type=float, default=30.0, metavar="S",
+                    help="preemption grace budget exported to workers "
+                    "(DL4J_TPU_GRACE_S) AND the launcher's escalation "
+                    "deadline: a notified worker still alive ~1.5x past "
+                    "it is SIGKILLed; workers exiting with the PREEMPTED "
+                    "code are relaunched WITHOUT consuming the restart "
+                    "budget")
+    ln.add_argument("--straggler-factor", type=float, default=2.0,
+                    metavar="K", help="flag a worker whose per-step wall "
+                    "time exceeds K x the median of its peers' (from "
+                    "heartbeats; default 2.0)")
+    ln.add_argument("--straggler-beats", type=int, default=3, metavar="M",
+                    help="consecutive over-threshold heartbeats before a "
+                    "worker is flagged a straggler (default 3)")
+    ln.add_argument("--straggler-policy",
+                    choices=("off", "flag", "relaunch"), default="flag",
+                    help="what to do with a flagged straggler: 'flag' = "
+                    "counter + trace instant + run-report event (default), "
+                    "'relaunch' = kill and relaunch it (consumes restart "
+                    "budget), 'off' = no detection")
     ln.add_argument("--chaos-worker", action="append", metavar="I:SPEC",
                     help="arm worker I with a --chaos spec (repeatable), "
                     "e.g. '1:proc_kill@10' — injected only into the FIRST "

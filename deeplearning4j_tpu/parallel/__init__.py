@@ -30,7 +30,11 @@ mechanism: a jax.sharding.Mesh + GSPMD-partitioned jit programs.
     elastic runtime (launcher.py + CLI ``launch``): a multi-process
     launcher with heartbeat membership epochs, host join/leave recovery
     (relaunch + ElasticTrainer.resume from the shared checkpoint store),
-    and process-kill chaos (FaultKind.PROC_KILL/PROC_HANG)
+    process-kill chaos (FaultKind.PROC_KILL/PROC_HANG), and ANNOUNCED
+    failures (preemption.py: SIGTERM notice → grace-window emergency
+    checkpoint → PREEMPTED exit relaunched without burning the restart
+    budget; coordinator restart/failover; straggler flagging —
+    docs/FAULT_TOLERANCE.md "Announced failures")
   TP / PP / SP — absent in the reference — are first-class here.
 
 Inference serving moved to the ``serving/`` subsystem (deadline-aware
@@ -62,12 +66,14 @@ from .chaos import (
 )
 from .moe import MoE, init_moe_params, moe_forward_dense, moe_forward_ep
 from .distributed import (
-    CoordinatorUnreachableError, detect_num_slices, initialize,
-    is_coordinator, local_batch_slice, probe_multiprocess_support,
-    process_count, process_index, resolve_process_index,
-    validate_coordinator_address,
+    CoordinatorUnreachableError, PREEMPTED_EXIT_CODE, detect_num_slices,
+    initialize, is_coordinator, local_batch_slice,
+    probe_multiprocess_support, process_count, process_index,
+    reinitialize, resolve_process_index, validate_coordinator_address,
 )
 from .launcher import (
     Heartbeat, HostLostError, Membership, MembershipChangedError,
-    PodLauncher, ProcessFailureDetector, maybe_bootstrap_from_env,
+    PodLauncher, ProcessFailureDetector, elect_coordinator,
+    maybe_bootstrap_from_env,
 )
+from .preemption import PreemptedError, PreemptionHandler

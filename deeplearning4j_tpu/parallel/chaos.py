@@ -72,6 +72,23 @@ class FaultKind:
     #: stop, exercising the heartbeat-expiry path: the launcher must
     #: declare it dead, SIGKILL it, and relaunch
     PROC_HANG = "proc_hang"
+    #: ANNOUNCED preemption: SIGTERM this worker at the scheduled step —
+    #: its PreemptionHandler flips the notice flag, the step completes,
+    #: and the next step boundary writes the grace-window emergency
+    #: checkpoint and exits PREEMPTED (planned leave: the launcher
+    #: relaunches WITHOUT consuming the restart budget)
+    PREEMPT_NOTICE = "preempt_notice"
+    #: SIGKILL the COORDINATOR process (legal only on the worker hosting
+    #: the coordinator role, i.e. process 0) — recovery is coordinator
+    #: restart: the launcher relaunches it, survivors re-initialize (or,
+    #: if it never comes back, elect the lowest alive id from the ledger
+    #: — launcher.elect_coordinator)
+    COORD_KILL = "coord_kill"
+    #: make THIS worker a straggler: every step from the scheduled one on
+    #: is slowed by ``slow_seconds`` — the launcher must flag it (step
+    #: time > k x the peer median for m consecutive beats) and, under the
+    #: opt-in policy, kill-and-relaunch it
+    SLOW_WORKER = "slow_worker"
     #: a serving replica THREAD dies mid-batch (uncaught exception) —
     #: the engine supervisor must complete the stranded futures, retry
     #: them on another replica, and respawn the thread (re-warmed)
@@ -92,11 +109,14 @@ class FaultKind:
 
     ALL = (DEVICE_LOSS, CKPT_WRITE_CRASH, CKPT_TRUNCATE, CKPT_BITFLIP,
            HUNG_STEP, NAN_GRADS, PROC_KILL, PROC_HANG,
+           PREEMPT_NOTICE, COORD_KILL, SLOW_WORKER,
            REPLICA_CRASH, REPLICA_HANG, POISON_INPUT, BAD_VERSION)
 
     #: kinds that take down the whole PROCESS — only meaningful under a
-    #: multi-process launcher (in-process soaks must not schedule them)
-    PROCESS_KINDS = (PROC_KILL, PROC_HANG)
+    #: multi-process launcher (in-process soaks must not schedule them).
+    #: preempt_notice is announced (SIGTERM -> graceful exit), coord_kill
+    #: and proc_kill are unannounced (SIGKILL), proc_hang is a wedge.
+    PROCESS_KINDS = (PROC_KILL, PROC_HANG, COORD_KILL, PREEMPT_NOTICE)
 
     #: kinds the TRAINING ChaosInjector can act on (FaultSchedule.random's
     #: default pool — serving kinds would be silent no-ops in a trainer)
@@ -200,12 +220,18 @@ class ChaosInjector:
     def __init__(self, trainer, schedule: FaultSchedule,
                  hang_seconds: float = 0.0,
                  sleep_fn: Callable[[float], None] = time.sleep,
-                 seed: int = 0):
+                 seed: int = 0,
+                 slow_seconds: Optional[float] = None):
         self.trainer = trainer
         self.schedule = schedule
         self.hang_seconds = hang_seconds
         self.sleep_fn = sleep_fn
         self.seed = seed
+        # slow_worker persistent per-step drag (defaults to hang_seconds
+        # so a bare 'slow_worker@k' spec still slows something)
+        self.slow_seconds = (hang_seconds if slow_seconds is None
+                             else slow_seconds)
+        self._slow_s = 0.0
         self.step = 0              # injector call index (1-based in events)
         self.events: List[dict] = []   # (step, kind) log, replayable
         self._ckpt = None
@@ -300,25 +326,59 @@ class ChaosInjector:
             elif kind == FaultKind.NAN_GRADS:
                 self._log(self.step, kind, "poisoning batch features")
                 ds = _poison_dataset(ds)
+            elif kind == FaultKind.SLOW_WORKER:
+                self._log(self.step, kind,
+                          f"+{self.slow_seconds}s per step from here on")
+                self._slow_s = self.slow_seconds
+            elif kind == FaultKind.PREEMPT_NOTICE:
+                self._announce_preemption()
             elif kind in FaultKind.PROCESS_KINDS:
                 self._kill_self(kind)
-        return self.trainer.fit_batch(ds)
+        out = self.trainer.fit_batch(ds)
+        if self._slow_s:
+            # the straggler drag: stretch THIS worker's step wall time
+            # (never the math) so the launcher's peer-median detection
+            # has a real slow host to flag
+            self.sleep_fn(self._slow_s)
+        return out
+
+    def _announce_preemption(self) -> None:
+        """SIGTERM self at the scheduled step — the ANNOUNCED failure:
+        unlike _kill_self the process survives the signal; the installed
+        PreemptionHandler flips its flag, this step completes normally,
+        and the next step boundary runs the grace-window emergency
+        checkpoint and exits PREEMPTED."""
+        import signal
+        self._log(self.step, FaultKind.PREEMPT_NOTICE,
+                  f"SIGTERM (notice) pid {os.getpid()}")
+        os.kill(os.getpid(), signal.SIGTERM)
 
     def _kill_self(self, kind: str) -> None:
-        """Take down THIS worker process — SIGKILL (proc_kill) or SIGSTOP
-        (proc_hang).  Self-injection makes the death exactly
+        """Take down THIS worker process — SIGKILL (proc_kill/coord_kill)
+        or SIGSTOP (proc_hang).  Self-injection makes the death exactly
         step-deterministic (no launcher-side polling race): the schedule
         says step k, the process is gone before step k runs.  The signal
         fires before any file I/O of the step, so checkpoints on disk stay
-        atomic-rename-clean."""
+        atomic-rename-clean.  coord_kill is proc_kill aimed at the
+        COORDINATOR process (process 0) — the distinct kind keeps the
+        event log honest about WHAT died, because recovery differs:
+        survivors must re-initialize against the restarted (or re-elected)
+        coordinator, not just keep training."""
         import signal
-        sig = (signal.SIGKILL if kind == FaultKind.PROC_KILL
-               else getattr(signal, "SIGSTOP", None))
+        if kind == FaultKind.COORD_KILL:
+            from .distributed import resolve_process_index
+            if resolve_process_index() != 0:
+                raise RuntimeError(
+                    "coord_kill scheduled on a non-coordinator worker "
+                    f"(process {resolve_process_index()}) — aim it at "
+                    "process 0, the coordinator host")
+        sig = (getattr(signal, "SIGSTOP", None)
+               if kind == FaultKind.PROC_HANG else signal.SIGKILL)
         if sig is None:
             raise RuntimeError(f"{kind} unsupported on this platform "
                                "(no SIGSTOP)")
         self._log(self.step, kind,
-                  f"{'SIGKILL' if kind == FaultKind.PROC_KILL else 'SIGSTOP'}"
+                  f"{'SIGSTOP' if kind == FaultKind.PROC_HANG else 'SIGKILL'}"
                   f" pid {os.getpid()}")
         # flush logging AND the trace ring before the process vanishes
         # mid-statement — the proc_kill instant must survive into the

@@ -41,6 +41,21 @@ ENV_CONNECT_TIMEOUT = "DL4J_TPU_CONNECT_TIMEOUT"
 #: ``launch --trace``; workers name files worker{i}.inc{j}.trace.json and
 #: the launcher merges them into one pod timeline — obs/trace.py)
 ENV_TRACE_DIR = "DL4J_TPU_TRACE_DIR"
+#: preemption grace budget in seconds: how long a worker has between a
+#: preemption notice (SIGTERM/SIGUSR1) and the host going away — the
+#: emergency-checkpoint deadline (parallel/preemption.py)
+ENV_GRACE_S = "DL4J_TPU_GRACE_S"
+#: comma-separated coordinator-capable port per process id, so a worker
+#: that finds the coordinator dead can re-``initialize`` against the
+#: survivor with the lowest alive id from the membership ledger instead
+#: of dying on CoordinatorUnreachableError (launcher.elect_coordinator)
+ENV_COORD_PORTS = "DL4J_TPU_COORD_PORTS"
+
+#: distinct exit code for a PLANNED leave: the worker received a
+#: preemption notice, wrote its emergency checkpoint, and exited on
+#: purpose — the launcher relaunches it WITHOUT consuming the per-worker
+#: restart budget (75 = BSD EX_TEMPFAIL: "temporary failure, retry").
+PREEMPTED_EXIT_CODE = 75
 
 
 class CoordinatorUnreachableError(ConnectionError):
@@ -148,6 +163,25 @@ def initialize(coordinator_address: Optional[str] = None,
     logger.info("distributed initialized: process %d/%d, %d local / %d "
                 "global devices", jax.process_index(), jax.process_count(),
                 jax.local_device_count(), jax.device_count())
+
+
+def reinitialize(coordinator_address: str,
+                 num_processes: Optional[int] = None,
+                 process_id: Optional[int] = None,
+                 timeout_s: Optional[float] = None) -> None:
+    """Tear down this process's distributed runtime and rejoin — the
+    coordinator-restart path: after the coordinator process is relaunched
+    (same address) or a survivor was elected to host a new one
+    (``launcher.elect_coordinator``), workers call this instead of
+    treating :class:`CoordinatorUnreachableError` as terminal.  The
+    shutdown is best-effort (a worker whose runtime already collapsed
+    with the coordinator just re-initializes)."""
+    try:
+        jax.distributed.shutdown()
+    except Exception as exc:   # not initialized / already torn down
+        logger.debug("distributed shutdown before rejoin: %s", exc)
+    initialize(coordinator_address, num_processes, process_id,
+               timeout_s=timeout_s)
 
 
 def resolve_process_index(explicit: Optional[int] = None) -> int:

@@ -67,9 +67,14 @@ class _HostSnapshot:
         # record the REAL network class, not the snapshot wrapper
         self._model_class = type(net).__name__
 
-    def save(self, path: str, save_updater: bool = True) -> None:
+    def save(self, path: str, save_updater: bool = True,
+             compression: Optional[int] = None) -> None:
+        import zipfile
+
         from ..utils.serializer import save_model
-        save_model(self, path, save_updater=save_updater)
+        save_model(self, path, save_updater=save_updater,
+                   compression=(zipfile.ZIP_DEFLATED if compression is None
+                                else compression))
 
 
 class CheckpointManager:
@@ -112,6 +117,10 @@ class CheckpointManager:
         self._suffix = f".h{self.process_id}" if role == "per_host" else ""
         self._executor = None
         self._pending = None
+        # wall clock of the most recent completed (deflate) write — the
+        # preemption handler's estimate of whether another deflate pass
+        # still fits the remaining grace budget (parallel/preemption.py)
+        self.last_save_seconds: Optional[float] = None
         os.makedirs(directory, exist_ok=True)
         if self.is_writer:
             self._clean_stale_tmp()
@@ -159,9 +168,36 @@ class CheckpointManager:
         # temp-file + atomic rename: a crash mid-write must never leave a
         # truncated zip as the latest (restore would load garbage)
         tmp = path + ".tmp"
+        t0 = time.monotonic()
         net.save(tmp)
+        self.last_save_seconds = time.monotonic() - t0
         os.replace(tmp, path)
         self._prune()
+        return path
+
+    def save_snapshot(self, snap: "_HostSnapshot", step: int,
+                      compressed: bool = True,
+                      prune: bool = True) -> Optional[str]:
+        """Write an already-captured :class:`_HostSnapshot` — the
+        emergency-checkpoint entry point (parallel/preemption.py): the
+        snapshot was taken the moment the preemption notice was
+        processed, and ``compressed=False`` writes ZIP_STORED when the
+        remaining grace budget won't fit a deflate pass.  Same atomic
+        temp-file + rename protocol and writer-role guard as ``save``;
+        ``prune=False`` skips the keep-last sweep (every millisecond of
+        grace goes to the write itself)."""
+        import zipfile
+        if not self.is_writer:
+            logger.debug("emergency checkpoint @%d skipped on non-writer "
+                         "host %d", step, self.process_id)
+            return None
+        path = self._path(step)
+        tmp = path + ".tmp"
+        snap.save(tmp, compression=(zipfile.ZIP_DEFLATED if compressed
+                                    else zipfile.ZIP_STORED))
+        os.replace(tmp, path)
+        if prune:
+            self._prune()
         return path
 
     def save_async(self, net, step: int):
@@ -186,7 +222,9 @@ class CheckpointManager:
         def write():
             path = self._path(step)
             tmp = path + ".tmp"
+            t0 = time.monotonic()
             snap.save(tmp)
+            self.last_save_seconds = time.monotonic() - t0
             os.replace(tmp, path)
             self._prune()
             return path
@@ -309,6 +347,10 @@ class FailureDetector:
                            "non-finite gradient")
 
     def is_recoverable(self, exc: Exception) -> bool:
+        if getattr(exc, "recoverable", None) is False:
+            return False   # non-recoverable by construction: a preemption
+            # notice (PreemptedError) means the HOST is going away —
+            # retrying the step here would burn the grace budget
         if isinstance(exc, RecoverableInfraError):
             return True    # recoverable by construction (hang, host lost)
         if isinstance(exc, (ValueError, TypeError, KeyError)):
@@ -360,7 +402,8 @@ class ElasticTrainer:
                  sleep_fn: Callable[[float], None] = time.sleep,
                  clock: Callable[[], float] = time.monotonic,
                  membership_check: Optional[Callable[[], None]] = None,
-                 checkpoint_role: str = "auto"):
+                 checkpoint_role: str = "auto",
+                 preemption=None):
         import random
 
         self.trainer = trainer
@@ -387,6 +430,18 @@ class ElasticTrainer:
         # granular recovery (smaller dcn mesh over the survivors) is the
         # existing recovery path, not a parallel one
         self.membership_check = membership_check
+        # announced failures (parallel/preemption.py): a PreemptionHandler
+        # whose notice flag is checked at every STEP BOUNDARY — the
+        # handler then captures an emergency checkpoint inside the grace
+        # budget and raises PreemptedError, which is NOT recoverable (the
+        # host is going away; the launcher relaunches the worker and
+        # resume() picks the emergency checkpoint up)
+        self.preemption = preemption
+        # newest checkpoint step known DURABLE on disk (-1 = none yet):
+        # sync saves record it inline, async saves when the background
+        # write lands — surfaced through the heartbeat so the launcher's
+        # pod-liveness report can answer "how much work would we lose"
+        self.last_checkpoint_step = -1
         self.restarts = 0        # consecutive-failure budget (resets)
         self.total_restarts = 0  # lifetime count, for observability
         self.recovery_seconds = 0.0  # total wall clock spent in recovery
@@ -422,7 +477,14 @@ class ElasticTrainer:
                 "restarts": self.restarts,
                 "total_restarts": self.total_restarts,
                 "recovery_seconds": round(self.recovery_seconds, 3),
-                "backoff_sleeps": len(self.backoff_sleeps)}
+                "backoff_sleeps": len(self.backoff_sleeps),
+                "last_checkpoint_step": self.last_checkpoint_step}
+
+    def _record_durable(self, step: int, path) -> None:
+        """A checkpoint write for ``step`` landed (path None = this host
+        is not the writer — the durable step is unknown here)."""
+        if path is not None and step > self.last_checkpoint_step:
+            self.last_checkpoint_step = step
 
     @staticmethod
     def _default_loader(path: str):
@@ -449,6 +511,8 @@ class ElasticTrainer:
         net.grad_residual = getattr(model, "grad_residual", None)
         net.iteration = model.iteration
         self.global_step = step
+        # the checkpoint just loaded is by definition durable on disk
+        self.last_checkpoint_step = max(self.last_checkpoint_step, step)
         logger.info("restored checkpoint @ step %d", step)
 
     def resume(self) -> int:
@@ -520,6 +584,11 @@ class ElasticTrainer:
         watchdog thread) or crawled through a degraded link (caught by the
         elapsed check) — is treated as hung and recovered."""
         while True:
+            # step boundary: the preemption flag is processed here, OUTSIDE
+            # the recovery try — a notice is not a failure to retry, it is
+            # an order to checkpoint and leave (PreemptedError propagates)
+            if self.preemption is not None:
+                self.preemption.check(self)
             t_start = self.clock()
             try:
                 if self.membership_check is not None:
@@ -550,9 +619,19 @@ class ElasticTrainer:
                             # zip/deflate overlaps the next training
                             # steps; the device→host snapshot happens
                             # here (the next step donates these buffers)
-                            self.ckpt.save_async(self.net, self.global_step)
+                            fut = self.ckpt.save_async(self.net,
+                                                       self.global_step)
+                            if fut is not None:
+                                step_saved = self.global_step
+                                fut.add_done_callback(
+                                    lambda f, s=step_saved:
+                                    self._record_durable(
+                                        s, None if f.exception()
+                                        else f.result()))
                         else:
-                            self.ckpt.save(self.net, self.global_step)
+                            self._record_durable(
+                                self.global_step,
+                                self.ckpt.save(self.net, self.global_step))
                 self._ok_steps += 1
                 if self._ok_steps >= self.restart_reset_after and self.restarts:
                     logger.info("%d successful steps since last failure — "
@@ -609,11 +688,14 @@ class ElasticTrainer:
         for _ in range(epochs):
             for ds in it:
                 losses.append(self.fit_batch(ds))
-        # final checkpoint so a clean shutdown is always resumable (wait
-        # for any in-flight async write so ordering stays monotonic; skip
-        # the re-serialization when the last step already checkpointed)
+        # final checkpoint so a clean shutdown is always resumable: FLUSH
+        # any in-flight save_async first — without the wait() a clean exit
+        # could return while the newest state is still half-written on the
+        # background thread — then skip the re-serialization when the last
+        # step already checkpointed durably
         self.ckpt.wait()
         latest = self.ckpt.latest()
         if latest is None or latest[1] != self.global_step:
-            self.ckpt.save(self.net, self.global_step)
+            self._record_durable(self.global_step,
+                                 self.ckpt.save(self.net, self.global_step))
         return losses

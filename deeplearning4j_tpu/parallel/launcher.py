@@ -52,9 +52,10 @@ from typing import Callable, Dict, List, Optional, Sequence
 from ..obs import trace as obs_trace
 from ..obs.metrics import get_registry, merge_snapshots
 from .distributed import (
-    ENV_CHAOS, ENV_CONNECT_TIMEOUT, ENV_COORDINATOR, ENV_INCARNATION,
-    ENV_NUM_PROCESSES, ENV_PROCESS_ID, ENV_RUN_DIR, ENV_TRACE_DIR,
-    initialize, resolve_process_index,
+    ENV_CHAOS, ENV_CONNECT_TIMEOUT, ENV_COORD_PORTS, ENV_COORDINATOR,
+    ENV_GRACE_S, ENV_INCARNATION, ENV_NUM_PROCESSES, ENV_PROCESS_ID,
+    ENV_RUN_DIR, ENV_TRACE_DIR, PREEMPTED_EXIT_CODE,
+    CoordinatorUnreachableError, initialize, resolve_process_index,
 )
 from .elastic import FailureDetector, RecoverableInfraError
 
@@ -124,19 +125,33 @@ class Membership:
     def _hb_path(self, process_id: int) -> str:
         return os.path.join(self.directory, f"hb_{int(process_id)}.json")
 
+    def _leaving_path(self, process_id: int) -> str:
+        return os.path.join(self.directory,
+                            f"leaving_{int(process_id)}.json")
+
     def beat(self, process_id: int, pid: Optional[int] = None,
-             step: Optional[int] = None) -> None:
+             step: Optional[int] = None,
+             step_s: Optional[float] = None,
+             ckpt_step: Optional[int] = None,
+             addr: Optional[str] = None) -> None:
+        """Liveness beat.  Beyond (pid, step, t): ``step_s`` is this
+        worker's current per-step wall time (straggler detection keys on
+        it), ``ckpt_step`` the newest checkpoint step known durable on
+        disk (pod-liveness reporting), ``addr`` a coordinator-capable
+        host address (coordinator election)."""
         _atomic_write_json(self._hb_path(process_id), {
             "process_id": int(process_id),
             "pid": int(pid if pid is not None else os.getpid()),
-            "step": step, "t": self.clock()})
+            "step": step, "step_s": step_s, "ckpt_step": ckpt_step,
+            "addr": addr, "t": self.clock()})
 
     def last_beat(self, process_id: int) -> Optional[dict]:
         try:
             with open(self._hb_path(process_id)) as f:
-                return json.load(f)
+                rec = json.load(f)
         except (OSError, ValueError):
             return None
+        return rec if isinstance(rec, dict) else None
 
     def remove(self, process_id: int) -> None:
         try:
@@ -144,9 +159,55 @@ class Membership:
         except OSError:
             pass
 
+    # -- announced leaves (preemption notices) -----------------------------
+
+    def mark_leaving(self, process_id: int,
+                     grace_s: Optional[float] = None) -> None:
+        """Record that this worker received a preemption notice and will
+        exit within ``grace_s`` — survivors and the launcher observe a
+        fast LEAVE instead of waiting out the heartbeat timeout
+        (parallel/preemption.py)."""
+        _atomic_write_json(self._leaving_path(process_id), {
+            "process_id": int(process_id), "grace_s": grace_s,
+            "t": self.clock()})
+
+    def clear_leaving(self, process_id: int) -> None:
+        try:
+            os.remove(self._leaving_path(process_id))
+        except OSError:
+            pass
+
+    def leaving(self) -> Dict[int, dict]:
+        """{process_id: marker} of workers that announced a leave (and
+        have not been respawned since — the launcher clears the marker
+        at spawn).  Torn/foreign files are skipped, same contract as
+        ``_scan``."""
+        out: Dict[int, dict] = {}
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for fn in names:
+            if not (fn.startswith("leaving_") and fn.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.directory, fn)) as f:
+                    rec = json.load(f)
+                if not isinstance(rec, dict):
+                    continue
+                out[int(rec["process_id"])] = rec
+            except (OSError, ValueError, KeyError, TypeError):
+                continue
+        return out
+
     # -- coordinator side --------------------------------------------------
 
     def _scan(self) -> Dict[int, dict]:
+        """Read every heartbeat file, hardened against torn state: a
+        worker killed mid-``beat()`` (or a foreign/garbage file matching
+        the glob) must read as a MISSED beat, never raise into the
+        coordinator's monitor loop — so empty files, truncated JSON,
+        non-dict payloads (``null``) and malformed ids are all skipped."""
         out: Dict[int, dict] = {}
         try:
             names = os.listdir(self.directory)
@@ -158,24 +219,60 @@ class Membership:
             try:
                 with open(os.path.join(self.directory, fn)) as f:
                     rec = json.load(f)
+                if not isinstance(rec, dict):
+                    continue   # json "null"/list — torn or foreign
                 out[int(rec["process_id"])] = rec
-            except (OSError, ValueError, KeyError):
+            except (OSError, ValueError, KeyError, TypeError):
                 continue   # torn/foreign file — not a member
         return out
 
+    @staticmethod
+    def _num(value, default: float = 0.0) -> float:
+        try:
+            return float(value)
+        except (TypeError, ValueError):
+            return default
+
     def alive(self) -> List[int]:
+        """Members with a fresh heartbeat, EXCLUDING those that announced
+        a leave — a preemption notice is an immediate logical departure
+        (the fast-LEAVE contract), even while the worker spends its grace
+        budget writing the emergency checkpoint."""
         now = self.clock()
+        leaving = self.leaving()
         return sorted(i for i, rec in self._scan().items()
-                      if now - float(rec.get("t", 0)) <= self.heartbeat_timeout)
+                      if i not in leaving
+                      and now - self._num(rec.get("t")) <=
+                      self.heartbeat_timeout)
+
+    def last_checkpoint_step(self) -> int:
+        """Newest checkpoint step any member reported durable in its
+        heartbeat (-1 when nobody reported one) — the launcher's
+        "how much work would a loss cost right now" number."""
+        steps = [int(self._num(rec.get("ckpt_step"), -1))
+                 for rec in self._scan().values()
+                 if rec.get("ckpt_step") is not None]
+        return max(steps, default=-1)
 
     def read(self) -> dict:
         """The persisted ledger: {"epoch": int, "members": [ids]} (epoch 0,
-        no members before the first refresh)."""
+        no members before the first refresh).  A truncated/garbage ledger
+        file degrades to the empty default — the next ``refresh()``
+        re-persists from the heartbeat scan — instead of raising."""
+        default = {"epoch": 0, "members": []}
         try:
             with open(os.path.join(self.directory, self.LEDGER)) as f:
-                return json.load(f)
+                led = json.load(f)
         except (OSError, ValueError):
-            return {"epoch": 0, "members": []}
+            return default
+        if (not isinstance(led, dict)
+                or not isinstance(led.get("members"), list)):
+            return default
+        try:
+            led["epoch"] = int(led["epoch"])
+        except (KeyError, TypeError, ValueError):
+            return default
+        return led
 
     @property
     def epoch(self) -> int:
@@ -212,11 +309,21 @@ class Heartbeat:
     def __init__(self, membership: Membership, process_id: int,
                  interval: float = 0.2,
                  step_fn: Optional[Callable[[], int]] = None,
+                 ckpt_step_fn: Optional[Callable[[], int]] = None,
                  export_metrics: bool = True, metrics_every: int = 5):
         self.membership = membership
         self.process_id = int(process_id)
         self.interval = interval
         self.step_fn = step_fn
+        # pod-liveness extras: the newest DURABLE checkpoint step (e.g.
+        # ``lambda: elastic_trainer.last_checkpoint_step``) rides the
+        # beat, and per-step wall time is DERIVED from step_fn deltas —
+        # the launcher's straggler detection needs no trainer wiring
+        self.ckpt_step_fn = ckpt_step_fn
+        self._last_step: Optional[int] = None
+        self._last_step_t: Optional[float] = None
+        self._step_s: Optional[float] = None
+        self._step_samples = 0
         # pod-level telemetry: every Nth beat also snapshots the global
         # MetricsRegistry into run_dir/obs/ — the launcher's
         # ``pod_metrics()`` aggregates these per-worker files into one
@@ -244,15 +351,43 @@ class Heartbeat:
     def set_step_fn(self, step_fn: Callable[[], int]) -> None:
         self.step_fn = step_fn
 
+    def set_ckpt_step_fn(self, ckpt_step_fn: Callable[[], int]) -> None:
+        self.ckpt_step_fn = ckpt_step_fn
+
+    def _observe_step(self, step: Optional[int]) -> Optional[float]:
+        """Derive per-step wall time from step_fn deltas.  The FIRST
+        delta is discarded — it includes jit compilation (the same
+        compile-grace reasoning as the elastic step watchdog), and a
+        compile-polluted sample would make every cold-starting worker
+        look like a straggler."""
+        if step is None:
+            return self._step_s
+        now = self.membership.clock()
+        if self._last_step is not None and step > self._last_step:
+            sample = (now - self._last_step_t) / (step - self._last_step)
+            self._step_samples += 1
+            if self._step_samples >= 2:
+                self._step_s = sample
+        if self._last_step is None or step != self._last_step:
+            self._last_step, self._last_step_t = step, now
+        return self._step_s
+
     def _beat_once(self) -> None:
-        step = None
+        step = ckpt_step = None
         if self.step_fn is not None:
             try:
                 step = int(self.step_fn())
             except Exception:
                 step = None
+        if self.ckpt_step_fn is not None:
+            try:
+                ckpt_step = int(self.ckpt_step_fn())
+            except Exception:
+                ckpt_step = None
         try:
-            self.membership.beat(self.process_id, step=step)
+            self.membership.beat(self.process_id, step=step,
+                                 step_s=self._observe_step(step),
+                                 ckpt_step=ckpt_step)
         except OSError as exc:   # run dir vanished mid-shutdown — not fatal
             logger.debug("heartbeat write failed: %s", exc)
         self._beats += 1
@@ -285,14 +420,17 @@ class Heartbeat:
 
     @classmethod
     def start_from_env(cls, step_fn: Optional[Callable[[], int]] = None,
-                       interval: float = 0.2) -> Optional["Heartbeat"]:
+                       interval: float = 0.2,
+                       ckpt_step_fn: Optional[Callable[[], int]] = None,
+                       ) -> Optional["Heartbeat"]:
         """Start beating iff launched under the pod launcher (the
         ``DL4J_TPU_RUN_DIR`` env is the contract); None otherwise."""
         run_dir = os.environ.get(ENV_RUN_DIR)
         if not run_dir:
             return None
         return cls(Membership(run_dir), resolve_process_index(),
-                   interval=interval, step_fn=step_fn).start()
+                   interval=interval, step_fn=step_fn,
+                   ckpt_step_fn=ckpt_step_fn).start()
 
 
 class ProcessFailureDetector(FailureDetector):
@@ -325,12 +463,47 @@ class ProcessFailureDetector(FailureDetector):
             raise MembershipChangedError(joined, epoch)
 
 
-def maybe_bootstrap_from_env(timeout_s: Optional[float] = None) -> bool:
+def elect_coordinator(membership: Membership, ports) -> tuple:
+    """→ (leader_id, 'host:port'): the survivor with the LOWEST alive id
+    from the heartbeat ledger, at its coordinator-capable port.  ``ports``
+    maps process id → port (dict or sequence — the launcher exports it as
+    the comma-separated ``DL4J_TPU_COORD_PORTS`` env).  The host comes
+    from the leader's own heartbeat ``addr`` field when it advertised one
+    (multi-box pods), else 127.0.0.1 (the single-box launcher).  Raises
+    CoordinatorUnreachableError when nobody is alive to elect — there is
+    no cluster left to rejoin."""
+    alive = membership.alive()
+    if not alive:
+        raise CoordinatorUnreachableError(
+            "coordinator election found no alive member in the ledger at "
+            f"{membership.directory} — nothing to fail over to")
+    leader = min(alive)
+    try:
+        port = int(ports[leader])
+    except (KeyError, IndexError, TypeError, ValueError):
+        raise CoordinatorUnreachableError(
+            f"no coordinator port known for elected leader {leader} "
+            f"(ports: {ports!r})")
+    beat = membership.last_beat(leader) or {}
+    host = beat.get("addr") or "127.0.0.1"
+    return leader, f"{host}:{port}"
+
+
+def maybe_bootstrap_from_env(timeout_s: Optional[float] = None,
+                             _initialize=None) -> bool:
     """Join the jax.distributed cluster iff the launcher exported a
     coordinator address (``DL4J_TPU_COORDINATOR``); workers in replica
     mode (no coordinator) return False and stay single-process.  The
     bounded-timeout ``initialize`` raises CoordinatorUnreachableError
-    instead of hanging when the coordinator is gone."""
+    instead of hanging when the coordinator is gone.
+
+    Coordinator restart: when the configured coordinator is unreachable
+    AND the launcher exported per-process coordinator ports
+    (``DL4J_TPU_COORD_PORTS``) plus a run dir, the worker does NOT die —
+    it elects the survivor with the lowest alive id from the membership
+    ledger (``elect_coordinator``) and re-initializes against that
+    address.  ``_initialize`` is injectable for tests."""
+    init = _initialize or initialize
     addr = os.environ.get(ENV_COORDINATOR)
     if not addr:
         return False
@@ -338,8 +511,24 @@ def maybe_bootstrap_from_env(timeout_s: Optional[float] = None) -> bool:
     i = resolve_process_index()
     if timeout_s is None:
         timeout_s = float(os.environ.get(ENV_CONNECT_TIMEOUT, "60"))
-    initialize(addr, n, i, timeout_s=timeout_s)
-    return True
+    try:
+        init(addr, n, i, timeout_s=timeout_s)
+        return True
+    except CoordinatorUnreachableError:
+        run_dir = os.environ.get(ENV_RUN_DIR)
+        ports_env = os.environ.get(ENV_COORD_PORTS)
+        if not run_dir or not ports_env:
+            raise   # no failover contract — the old terminal behavior
+        ports = [int(p) for p in ports_env.split(",") if p.strip()]
+        leader, new_addr = elect_coordinator(Membership(run_dir), ports)
+        if new_addr == addr:
+            raise   # election picked the address that just failed
+        obs_trace.instant("launcher/coordinator_failover", cat="launcher",
+                          leader=leader, addr=new_addr, process=i)
+        logger.warning("coordinator %s unreachable — failing over to "
+                       "elected survivor %d at %s", addr, leader, new_addr)
+        init(new_addr, n, i, timeout_s=timeout_s)
+        return True
 
 
 def free_port() -> int:
@@ -362,8 +551,15 @@ class _WorkerHandle:
         self.proc: Optional[subprocess.Popen] = None
         self.state = "pending"       # running | completed | unrecovered
         self.incarnation = 0
-        self.restarts = 0
+        self.restarts = 0            # budget-consuming relaunches only
+        self.planned_leaves = 0      # PREEMPTED exits (budget untouched)
         self.hang_killed = False
+        self.notice_t: Optional[float] = None   # wall clock of the notice
+        self.grace_escalated = False
+        self.straggler_streak = 0
+        self.straggler_flagged = False
+        self.straggler_killed = False
+        self._last_hb_seen: Optional[float] = None
         self.spawned_pids: List[int] = []
         self.log_path: Optional[str] = None
         self._log_f = None
@@ -405,7 +601,12 @@ class PodLauncher:
                  connect_timeout_s: float = 60.0,
                  platform: Optional[str] = None,
                  megascale_slices: Optional[int] = None,
-                 trace_dir: Optional[str] = None):
+                 trace_dir: Optional[str] = None,
+                 grace_s: float = 30.0,
+                 max_planned_leaves: int = 8,
+                 straggler_factor: float = 2.0,
+                 straggler_beats: int = 3,
+                 straggler_policy: str = "flag"):
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")
         if bootstrap not in ("replica", "distributed"):
@@ -435,22 +636,67 @@ class PodLauncher:
         # plus the launcher's own membership/leave/join instants — into
         # one pod timeline
         self.trace_dir = trace_dir
+        # announced failures (docs/FAULT_TOLERANCE.md "Announced
+        # failures"): grace_s is the emergency-checkpoint budget exported
+        # to workers AND the launcher-side escalation deadline — a
+        # notified worker still alive past ~1.5x the budget is SIGKILLed
+        # (it is wedged, and the scheduler is about to do the same).
+        # max_planned_leaves bounds PREEMPTED-exit relaunches separately
+        # from the restart budget (a worker that always exits 75 must not
+        # relaunch forever).
+        if grace_s <= 0:
+            raise ValueError(f"grace_s must be > 0, got {grace_s}")
+        self.grace_s = grace_s
+        self.max_planned_leaves = max_planned_leaves
+        # straggler policy: a worker whose per-step wall time (from its
+        # heartbeat) exceeds straggler_factor x the median of its PEERS'
+        # step times for straggler_beats consecutive fresh beats is
+        # flagged ("flag", the default: counter + trace instant + event)
+        # or killed-and-relaunched ("relaunch", consuming restart budget);
+        # "off" disables the scan
+        if straggler_policy not in ("off", "flag", "relaunch"):
+            raise ValueError(f"straggler_policy must be off/flag/relaunch, "
+                             f"got {straggler_policy!r}")
+        self.straggler_factor = straggler_factor
+        self.straggler_beats = max(1, int(straggler_beats))
+        self.straggler_policy = straggler_policy
         self.membership = Membership(run_dir, heartbeat_timeout)
         self.handles = [_WorkerHandle(i) for i in range(num_workers)]
         self.events: List[dict] = []
         self._t0: Optional[float] = None
-        get_registry().register_collector("launcher", self.stats,
-                                          unique=True)
+        self._shutting_down = False
+        self._shutdown_forwarded = False
+        self._prev_sigterm = None
+        self.coord_ports: Optional[List[int]] = None
+        reg = get_registry()
+        self._m_preempt_notices = reg.counter("launcher_preempt_notices_total")
+        self._m_planned_leaves = reg.counter("launcher_planned_leaves_total")
+        self._m_stragglers = reg.counter("launcher_stragglers_total")
+        self._m_grace_escalations = reg.counter(
+            "launcher_grace_escalations_total")
+        reg.register_collector("launcher", self.stats, unique=True)
 
     def stats(self) -> dict:
-        """Membership/fleet counters (the registry collector view)."""
+        """Membership/fleet counters (the registry collector view — this
+        is what ``/metrics`` shows under ``registry.collected.launcher``):
+        the pod-liveness answer an operator needs at a glance — epoch,
+        who is alive, who announced a leave, and the newest checkpoint
+        step known durable (how much work a loss would cost)."""
         by_kind: Dict[str, int] = {}
         for e in self.events:
             by_kind[e["kind"]] = by_kind.get(e["kind"], 0) + 1
         return {"workers": self.num_workers,
                 "epoch": self.membership.epoch,
                 "members": self.membership.members(),
+                "alive": self.membership.alive(),
+                "leaving": sorted(self.membership.leaving()),
+                "last_checkpoint_step":
+                    self.membership.last_checkpoint_step(),
                 "restarts": sum(h.restarts for h in self.handles),
+                "planned_leaves": sum(h.planned_leaves
+                                      for h in self.handles),
+                "stragglers_flagged": sum(1 for h in self.handles
+                                          if h.straggler_flagged),
                 "events": by_kind}
 
     # -- env / spawn -------------------------------------------------------
@@ -479,10 +725,21 @@ class PodLauncher:
                 env.get("XLA_FLAGS", ""), self.devices_per_worker)
         if self.platform:
             env["JAX_PLATFORMS"] = self.platform
+        env[ENV_GRACE_S] = str(self.grace_s)
         if self.bootstrap == "distributed":
             if self.coordinator_port is None:
                 self.coordinator_port = free_port()
             env[ENV_COORDINATOR] = f"127.0.0.1:{self.coordinator_port}"
+            # restartable coordinator: every worker gets a preassigned
+            # coordinator-capable port, so a worker that finds the
+            # configured coordinator dead can elect the survivor with the
+            # lowest alive id and re-initialize there (elect_coordinator
+            # + maybe_bootstrap_from_env failover)
+            if self.coord_ports is None:
+                self.coord_ports = [self.coordinator_port] + [
+                    free_port() for _ in range(self.num_workers - 1)]
+            env[ENV_COORD_PORTS] = ",".join(str(p)
+                                            for p in self.coord_ports)
             # feed slice detection (distributed.detect_num_slices →
             # build_two_tier_mesh / ShardedTrainer.two_tier): each worker
             # process is one "slice" unless the deployment already set
@@ -509,6 +766,15 @@ class PodLauncher:
         self.membership.remove(h.process_id)   # a stale beat from the dead
         # incarnation must not trip hang detection before the new process
         # gets through its imports to the first beat
+        self.membership.clear_leaving(h.process_id)   # the new incarnation
+        # is joining, not leaving — a stale marker would exclude it from
+        # alive() forever
+        h.notice_t = None
+        h.grace_escalated = False
+        h.straggler_streak = 0
+        h.straggler_flagged = False
+        h.straggler_killed = False
+        h._last_hb_seen = None
         logs = os.path.join(self.run_dir, "logs")
         os.makedirs(logs, exist_ok=True)
         h.log_path = os.path.join(
@@ -545,6 +811,7 @@ class PodLauncher:
 
     def _poll_once(self) -> None:
         now = time.time()
+        leaving = self.membership.leaving()
         for h in self.handles:
             if h.state != "running":
                 continue
@@ -557,7 +824,50 @@ class PodLauncher:
                     self._event("complete", h.process_id,
                                 incarnation=h.incarnation)
                     continue
-                kind = "hang" if h.hang_killed else "crash"
+                if (rc == PREEMPTED_EXIT_CODE and not h.hang_killed
+                        and not h.grace_escalated):
+                    # PLANNED leave: the worker processed its notice,
+                    # wrote the emergency checkpoint, and exited on
+                    # purpose — relaunch WITHOUT consuming the restart
+                    # budget (preemption is the platform's fault, not the
+                    # worker's)
+                    h.planned_leaves += 1
+                    self._m_planned_leaves.inc()
+                    self._event("leave", h.process_id, cause="preempted",
+                                rc=rc, incarnation=h.incarnation,
+                                planned=True)
+                    if self._shutting_down:
+                        h.state = "completed"
+                        self.membership.remove(h.process_id)
+                    elif h.planned_leaves <= self.max_planned_leaves:
+                        h.incarnation += 1
+                        self._spawn(h)
+                        self._event("join", h.process_id,
+                                    incarnation=h.incarnation)
+                    else:
+                        h.state = "unrecovered"
+                        self._event("unrecovered", h.process_id,
+                                    cause="preempt_loop", rc=rc,
+                                    log_tail=self._log_tail(h))
+                    continue
+                if self._shutting_down:
+                    # pod shutdown in progress: exits are expected; a
+                    # worker without a preemption handler dies on the
+                    # forwarded SIGTERM itself (rc -15) — that is still a
+                    # clean shutdown, not a crash to relaunch
+                    h.state = "completed"
+                    self.membership.remove(h.process_id)
+                    self._event("leave", h.process_id, cause="shutdown",
+                                rc=rc, incarnation=h.incarnation)
+                    continue
+                if h.grace_escalated:
+                    kind = "grace_expired"
+                elif h.straggler_killed:
+                    kind = "straggler"
+                elif h.hang_killed:
+                    kind = "hang"
+                else:
+                    kind = "crash"
                 self._event("leave", h.process_id, cause=kind, rc=rc,
                             incarnation=h.incarnation)
                 if h.restarts < self.max_restarts:
@@ -571,14 +881,40 @@ class PodLauncher:
                     self._event("unrecovered", h.process_id, cause=kind,
                                 rc=rc, log_tail=self._log_tail(h))
                 continue
-            # alive — hang detection: a beat from THIS incarnation (the hb
+            # alive — observe a self-announced leave (the worker's
+            # preemption handler wrote the ledger marker, e.g. the
+            # scheduler SIGTERMed it directly): start the escalation
+            # clock from the marker's own timestamp
+            if h.notice_t is None and h.process_id in leaving:
+                h.notice_t = Membership._num(
+                    leaving[h.process_id].get("t"), now)
+                self._m_preempt_notices.inc()
+                self._event("preempt_notice", h.process_id,
+                            source="worker", incarnation=h.incarnation)
+            # grace escalation: a notified worker still alive well past
+            # the budget is wedged — SIGKILL it (the scheduler is about
+            # to anyway) and recover through the normal leave path
+            if (h.notice_t is not None and not h.grace_escalated
+                    and now - h.notice_t >
+                    self.grace_s + max(1.0, 0.5 * self.grace_s)):
+                h.grace_escalated = True
+                self._m_grace_escalations.inc()
+                self._event("grace_expired", h.process_id,
+                            overdue_s=round(now - h.notice_t, 2))
+                try:
+                    h.proc.kill()
+                except OSError:
+                    pass
+                continue
+            # hang detection: a beat from THIS incarnation (the hb
             # file is removed at spawn) that has gone stale means the
             # process is wedged or stopped; never-beaten workers get
             # startup grace (imports/compiles) and are bounded by the
             # overall deadline instead
             hb = self.membership.last_beat(h.process_id)
             if hb is not None and \
-                    now - float(hb.get("t", now)) > self.heartbeat_timeout:
+                    now - Membership._num(hb.get("t"), now) > \
+                    self.heartbeat_timeout:
                 h.hang_killed = True
                 self._event("hang_detected", h.process_id,
                             stale_s=round(now - float(hb["t"]), 2))
@@ -586,9 +922,122 @@ class PodLauncher:
                     h.proc.kill()    # SIGKILL terminates SIGSTOPped too
                 except OSError:
                     pass
+        self._check_stragglers()
+
+    def _check_stragglers(self) -> None:
+        """Flag (or relaunch) workers whose per-step wall time — derived
+        by their Heartbeat and carried in the beat — exceeds
+        ``straggler_factor`` x the median of their PEERS' step times for
+        ``straggler_beats`` consecutive FRESH beats.  Peer median (not
+        pod median including self) so a single slow worker among few
+        can't drag the threshold up to meet itself; requires >= 2 running
+        workers with steady-state samples.  One flag per incarnation."""
+        if self.straggler_policy == "off" or self.num_workers < 2:
+            return
+        beats: Dict[int, dict] = {}
+        for h in self.handles:
+            if h.state != "running":
+                continue
+            hb = self.membership.last_beat(h.process_id)
+            if hb is not None:
+                beats[h.process_id] = hb
+        for h in self.handles:
+            hb = beats.get(h.process_id)
+            if hb is None or h.state != "running":
+                continue
+            t = Membership._num(hb.get("t"))
+            if h._last_hb_seen is not None and t <= h._last_hb_seen:
+                continue          # same beat — don't recount the streak
+            h._last_hb_seen = t
+            step_s = hb.get("step_s")
+            if not isinstance(step_s, (int, float)) or step_s <= 0:
+                continue
+            peers = [b.get("step_s") for i, b in beats.items()
+                     if i != h.process_id
+                     and isinstance(b.get("step_s"), (int, float))
+                     and b.get("step_s") > 0]
+            if not peers:
+                continue
+            peers.sort()
+            median = peers[len(peers) // 2] if len(peers) % 2 else \
+                0.5 * (peers[len(peers) // 2 - 1] + peers[len(peers) // 2])
+            if median > 0 and step_s > self.straggler_factor * median:
+                h.straggler_streak += 1
+            else:
+                h.straggler_streak = 0
+                continue
+            if (h.straggler_streak >= self.straggler_beats
+                    and not h.straggler_flagged):
+                h.straggler_flagged = True
+                self._m_stragglers.inc()
+                self._event("straggler", h.process_id,
+                            step_s=round(float(step_s), 4),
+                            peer_median_s=round(float(median), 4),
+                            streak=h.straggler_streak,
+                            policy=self.straggler_policy)
+                if self.straggler_policy == "relaunch":
+                    h.straggler_killed = True
+                    try:
+                        h.proc.kill()
+                    except OSError:
+                        pass
 
     def _running(self) -> bool:
         return any(h.state == "running" for h in self.handles)
+
+    # -- announced preemption ----------------------------------------------
+
+    def preempt_worker(self, process_id: int) -> bool:
+        """Deliver a preemption notice (SIGTERM) to one running worker —
+        the launcher-side half of the announced-failure path: the worker's
+        PreemptionHandler writes its emergency checkpoint and exits
+        PREEMPTED within the grace budget, or the monitor escalates to
+        SIGKILL past it.  → True when the signal was sent."""
+        h = self.handles[process_id]
+        if h.state != "running" or h.proc is None:
+            return False
+        try:
+            h.proc.send_signal(signal.SIGTERM)
+        except OSError:
+            return False
+        if h.notice_t is None:
+            h.notice_t = time.time()
+            self._m_preempt_notices.inc()
+            self._event("preempt_notice", process_id, source="launcher",
+                        incarnation=h.incarnation)
+        return True
+
+    def preempt_all(self) -> int:
+        """Forward a preemption notice to every running worker (the
+        launcher's own SIGTERM handler calls this: pod-level preemption
+        notices cascade down as worker notices).  → count notified."""
+        return sum(1 for h in self.handles
+                   if self.preempt_worker(h.process_id))
+
+    def _on_sigterm(self, signum, frame) -> None:
+        # the launcher itself was told to go away: cascade the notice and
+        # stop healing — workers get their grace window, nobody relaunches
+        self._shutting_down = True
+
+    def _install_sigterm(self) -> None:
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM,
+                                               self._on_sigterm)
+        except ValueError:   # not the main thread (tests drive run() from
+            self._prev_sigterm = None        # a helper thread) — skip
+
+    def _restore_sigterm(self) -> None:
+        if self._prev_sigterm is not None:
+            try:
+                signal.signal(signal.SIGTERM, self._prev_sigterm)
+            except ValueError:
+                pass
+            self._prev_sigterm = None
+
+    def shutdown_gracefully(self) -> None:
+        """Programmatic equivalent of SIGTERMing the launcher: notify
+        every worker and let the monitor loop drain them within grace."""
+        self._shutting_down = True
 
     def _reap_all(self) -> int:
         """Kill anything still alive and count it; then verify every pid
@@ -673,6 +1122,7 @@ class PodLauncher:
         budget/deadline runs out), and return the run report."""
         self._t0 = time.time()
         os.makedirs(self.run_dir, exist_ok=True)
+        self._install_sigterm()
         for h in self.handles:
             self._spawn(h)
         deadline_hit = False
@@ -680,6 +1130,10 @@ class PodLauncher:
         try:
             while self._running():
                 time.sleep(self.poll_interval)
+                if self._shutting_down and not self._shutdown_forwarded:
+                    self._shutdown_forwarded = True
+                    self._event("shutdown",
+                                notified=self.preempt_all())
                 self.membership.refresh()
                 self._poll_once()
                 if time.time() - self._t0 > self.deadline_s:
@@ -694,6 +1148,7 @@ class PodLauncher:
             self.membership.refresh()
         finally:
             leaked = self._reap_all()
+            self._restore_sigterm()
         completed = [h.process_id for h in self.handles
                      if h.state == "completed"]
         unrecovered = [h.process_id for h in self.handles
@@ -703,11 +1158,23 @@ class PodLauncher:
             "completed": completed,
             "unrecovered": unrecovered,
             "restarts": sum(h.restarts for h in self.handles),
+            "budget_used": {h.process_id: h.restarts
+                            for h in self.handles},
+            "planned_leaves": sum(h.planned_leaves for h in self.handles),
+            "preempt_notices": sum(1 for e in self.events
+                                   if e["kind"] == "preempt_notice"),
+            "grace_escalations": sum(1 for e in self.events
+                                     if e["kind"] == "grace_expired"),
+            "stragglers": [e for e in self.events
+                           if e["kind"] == "straggler"],
             "leaves": [e for e in self.events if e["kind"] == "leave"],
             "joins": sum(1 for e in self.events if e["kind"] == "join"),
             "hang_detected": sum(1 for e in self.events
                                  if e["kind"] == "hang_detected"),
             "epoch": self.membership.epoch,
+            "alive": self.membership.alive(),
+            "leaving": sorted(self.membership.leaving()),
+            "last_checkpoint_step": self.membership.last_checkpoint_step(),
             "deadline_hit": deadline_hit,
             "leaked_killed": leaked,
             "wall_seconds": round(time.time() - self._t0, 2),

@@ -120,7 +120,15 @@ def _digest(data: bytes) -> str:
     return hashlib.sha256(data).hexdigest()
 
 
-def save_model(net, path: str, save_updater: bool = True) -> None:
+def save_model(net, path: str, save_updater: bool = True,
+               compression: int = zipfile.ZIP_DEFLATED) -> None:
+    """``compression`` picks the zip entry codec: the default
+    ``ZIP_DEFLATED`` for routine checkpoints, ``ZIP_STORED`` for the
+    preemption grace-window emergency path (parallel/preemption.py) —
+    skipping deflate trades disk bytes for write latency when the host
+    is seconds from going away.  Readers don't care: the zip headers
+    carry the codec per entry, and the v4 integrity digests are over the
+    UNCOMPRESSED entry bytes, so verification is codec-independent."""
     entries = {"configuration.json":
                json.dumps(net.conf.to_dict(), indent=1).encode(),
                "params.npz": _npz_bytes(_flatten_tree(net.params)),
@@ -140,7 +148,7 @@ def save_model(net, path: str, save_updater: bool = True) -> None:
         # is verified against these on load
         "integrity": {name: _digest(data) for name, data in entries.items()},
     }
-    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+    with zipfile.ZipFile(path, "w", compression) as zf:
         zf.writestr("meta.json", json.dumps(meta))
         for name, data in entries.items():
             zf.writestr(name, data)

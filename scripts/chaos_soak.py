@@ -1,6 +1,12 @@
 """Chaos soak: a scripted fault schedule against a REAL training loop.
 
-Two soaks share this file:
+Three soaks share this file:
+
+``chaos_soak.py --preempt [--quick]`` — the ANNOUNCED-failure soak
+(bench config ``preemption_recovery``): preemption notices with
+grace-window emergency checkpoints, planned-leave relaunch without
+restart-budget consumption, coordinator kill → restart → completion,
+and heartbeat-based straggler flagging (see ``run_preempt_soak``).
 
 ``chaos_soak.py [--quick]`` — the single-process soak (bench config
 ``chaos_recovery``), three arms over the same seeded MLP/blobs workload
@@ -279,12 +285,14 @@ class _Paced:
 def run_worker() -> None:
     """One cluster member (launcher child): 4 virtual CPU devices, a
     data=4 ShardedTrainer, ElasticTrainer over the SHARED checkpoint
-    store, heartbeats, env-armed chaos.  Resumes from the newest
-    checkpoint (host join), trains to SOAK_STEPS, records every loss with
-    its global step."""
+    store, heartbeats, env-armed chaos, and the preemption handler
+    (SIGTERM → grace-window emergency checkpoint → PREEMPTED exit).
+    Resumes from the newest checkpoint (host join), trains to
+    SOAK_STEPS, records every loss with its global step."""
     from deeplearning4j_tpu.cli import _parse_chaos
     from deeplearning4j_tpu.parallel import (
-        ChaosInjector, ElasticTrainer, ShardedTrainer, build_mesh,
+        ChaosInjector, ElasticTrainer, PreemptedError, PreemptionHandler,
+        ShardedTrainer, build_mesh,
     )
     from deeplearning4j_tpu.parallel.distributed import (
         ENV_CHAOS, ENV_INCARNATION, resolve_process_index,
@@ -303,10 +311,15 @@ def run_worker() -> None:
     inner = _Paced(trainer, sleep_s)
     chaos_spec = os.environ.get(ENV_CHAOS)
     if chaos_spec:
-        sched, seed, hang = _parse_chaos(chaos_spec)
-        inner = ChaosInjector(inner, sched, hang_seconds=hang, seed=seed)
-    et = ElasticTrainer(inner, ckpt_dir, checkpoint_every=4, sync_every=1)
-    hb = Heartbeat.start_from_env(step_fn=lambda: et.global_step)
+        sched, seed, hang, slow = _parse_chaos(chaos_spec)
+        inner = ChaosInjector(inner, sched, hang_seconds=hang, seed=seed,
+                              slow_seconds=slow)
+    handler = PreemptionHandler.install_from_env()
+    et = ElasticTrainer(inner, ckpt_dir, checkpoint_every=4, sync_every=1,
+                        preemption=handler)
+    hb = Heartbeat.start_from_env(
+        step_fn=lambda: et.global_step,
+        ckpt_step_fn=lambda: et.last_checkpoint_step)
     # incarnation 0 is initial cluster formation — everyone starts from
     # seeded init; a RELAUNCHED worker (host rejoin) resumes the shared
     # store.  Resuming at first start would let a slow-booting worker
@@ -314,18 +327,39 @@ def run_worker() -> None:
     start_step = et.resume() if incarnation > 0 else 0
     ds = _data()
     losses = []
-    while et.global_step < steps:
-        losses.append(float(et.fit_batch(ds)))
-    os.makedirs(out_dir, exist_ok=True)
     out = {"process": proc, "incarnation": incarnation,
            "start_step": start_step, "losses": losses,
            "writer": et.ckpt.is_writer}
+    preempted = None
+    try:
+        while et.global_step < steps:
+            losses.append(float(et.fit_batch(ds)))
+    except PreemptedError as exc:
+        # planned leave: record what we know (the loss trail up to the
+        # preempted step + the emergency-checkpoint evidence the soak
+        # gates on), then exit with the distinct PREEMPTED code
+        preempted = exc
+        out.update({
+            "preempted": True,
+            "preempted_at_step": exc.step,
+            "emergency": {
+                "path": (os.path.basename(exc.checkpoint_path)
+                         if exc.checkpoint_path else None),
+                "stored": exc.stored,
+                "seconds": exc.seconds,
+                "grace_s": handler.grace_s,
+                "within_grace": (exc.seconds is not None
+                                 and exc.seconds <= handler.grace_s),
+            }})
+    os.makedirs(out_dir, exist_ok=True)
     path = os.path.join(out_dir, f"proc{proc}_inc{incarnation}.json")
     with open(path + ".tmp", "w") as f:
         json.dump(out, f)
     os.replace(path + ".tmp", path)
     if hb is not None:
         hb.stop()
+    if preempted is not None:
+        raise SystemExit(preempted.exit_code)
 
 
 def _spawn_baseline(root, steps, sleep_s):
@@ -358,7 +392,7 @@ def _spawn_baseline(root, steps, sleep_s):
 
 
 def _launch_arm(root, name, steps, sleep_s, chaos, heartbeat_timeout,
-                deadline_s):
+                deadline_s, grace_s=30.0):
     import sys as _sys
 
     from deeplearning4j_tpu.parallel.launcher import PodLauncher
@@ -374,7 +408,8 @@ def _launch_arm(root, name, steps, sleep_s, chaos, heartbeat_timeout,
         [_sys.executable, os.path.abspath(__file__), "--worker"],
         num_workers=2, run_dir=run_dir, devices_per_worker=4,
         base_env=env, chaos=chaos, heartbeat_timeout=heartbeat_timeout,
-        max_restarts=2, deadline_s=deadline_s, platform="cpu")
+        max_restarts=2, deadline_s=deadline_s, platform="cpu",
+        grace_s=grace_s)
     report = launcher.run()
     results = []
     if os.path.isdir(out_dir):
@@ -475,6 +510,143 @@ def run_multiproc_soak(quick=QUICK, root=None):
     return out
 
 
+# ---------------------------------------------------------------------------
+# announced-failure soak (bench config preemption_recovery)
+# ---------------------------------------------------------------------------
+
+def run_preempt_soak(quick=QUICK, root=None):
+    """The ANNOUNCED-failure soak (bench config ``preemption_recovery``),
+    four arms over the multiproc topology (2 workers x 4 virtual CPU
+    devices, shared checkpoint store, process 0 = writer + coordinator):
+
+      baseline — ONE worker subprocess, chaos off (the reference loss
+              trajectory, bit-comparable).
+      off     — 2 launched workers under the NEW launcher defaults
+              (straggler detection armed, grace exported, preemption
+              handler installed) but zero faults: must be BIT-IDENTICAL
+              to the baseline with zero restarts/planned leaves/straggler
+              flags — the announced-failure machinery changes no math.
+      preempt — worker 0 (the WRITER) receives a scheduled
+              preempt_notice (SIGTERM self): the emergency checkpoint
+              must land within the grace budget, the worker must exit
+              PREEMPTED and relaunch WITHOUT consuming the restart
+              budget, and the relaunched incarnation must resume at
+              exactly the preempted step (zero steps lost) with a
+              bit-exact trajectory replay.  Worker 1 is made a straggler
+              (slow_worker) and must be FLAGGED from its heartbeat step
+              times within the beat budget.
+      coord   — worker 0 (the COORDINATOR process) is SIGKILLed
+              (coord_kill): the launcher must relaunch it (coordinator
+              restart) and training must still complete bit-exactly.
+    """
+    import tempfile
+
+    steps = 16 if quick else 24
+    sleep_s = 0.3 if quick else 0.35
+    hb_timeout = 2.0
+    deadline = 180.0 if quick else 240.0
+    grace = 10.0
+    notice_step = max(3, steps // 3)           # worker 0: announced leave
+    slow_step = max(2, steps // 4)             # worker 1: becomes slow
+    slow_s = 0.9                               # vs the 0.3s pace → >2x peers
+    coord_step = max(3, steps // 3)            # worker 0: coordinator death
+    root = root or tempfile.mkdtemp(prefix="chaos_soak_pre_")
+    out = {"config": "preemption_recovery", "platform": "cpu",
+           "steps": steps, "workers": 2, "devices_per_worker": 4,
+           "grace_s": grace, "notice_step": notice_step,
+           "slow_step": slow_step, "coord_kill_step": coord_step}
+
+    t0 = time.perf_counter()
+    # -- arm 1: single-process baseline ------------------------------------
+    baseline = _spawn_baseline(root, steps, sleep_s)
+    out["baseline_final_loss"] = baseline[-1]
+
+    # -- arm 2: 2 workers, announced-failure machinery armed, no faults ----
+    off_report, off_results = _launch_arm(
+        root, "off", steps, sleep_s, chaos=None,
+        heartbeat_timeout=hb_timeout, deadline_s=deadline)
+    out["off_ok"] = bool(off_report["ok"] and off_report["restarts"] == 0
+                         and off_report["planned_leaves"] == 0
+                         and len(off_report["stragglers"]) == 0
+                         and len(off_results) == 2)
+    out["off_bitwise"] = bool(
+        len(off_results) == 2
+        and all(r["start_step"] == 0 and r["losses"] == baseline
+                for r in off_results))
+    out["off_leaked"] = off_report["leaked_killed"]
+
+    # -- arm 3: announced preemption + straggler ---------------------------
+    chaos = {0: f"preempt_notice@{notice_step}",
+             1: f"slow_worker@{slow_step},slow={slow_s}"}
+    report, results = _launch_arm(
+        root, "preempt", steps, sleep_s, chaos=chaos,
+        heartbeat_timeout=hb_timeout, deadline_s=deadline, grace_s=grace)
+    pre = [r for r in results if r.get("preempted")]
+    resumed = [r for r in results
+               if r["process"] == 0 and r["incarnation"] > 0]
+    emergency = pre[0]["emergency"] if pre else {}
+    out.update({
+        "unrecovered": len(report["unrecovered"]),
+        "completed": report["completed"],
+        "planned_leaves": report["planned_leaves"],
+        "preempt_notices": report["preempt_notices"],
+        "restart_budget_used": report["restarts"],
+        "grace_escalations": report["grace_escalations"],
+        "preempted_workers": [r["process"] for r in pre],
+        "preempted_at_step": pre[0]["preempted_at_step"] if pre else None,
+        "emergency": emergency,
+        "resume_start_steps": [r["start_step"] for r in resumed],
+        "straggler_events": report["stragglers"],
+        "preempt_loss_bitwise": _losses_match_baseline(results, baseline),
+        "preempt_leaked": report["leaked_killed"],
+        "preempt_events": report["events"],
+    })
+    # zero steps lost beyond the preempted step: the relaunched writer
+    # resumes EXACTLY where the notice stopped it
+    out["zero_steps_lost"] = bool(
+        pre and resumed
+        and resumed[0]["start_step"] == pre[0]["preempted_at_step"]
+        and pre[0]["preempted_at_step"] == len(pre[0]["losses"]))
+    out["emergency_within_grace"] = bool(emergency.get("within_grace")
+                                         and emergency.get("path"))
+    out["straggler_flagged"] = bool(
+        any(e["worker"] == 1 for e in report["stragglers"]))
+    out["budget_untouched"] = report["restarts"] == 0
+    out["preempt_ok"] = bool(
+        not report["unrecovered"] and not report["deadline_hit"]
+        and sorted(report["completed"]) == [0, 1]
+        and report["planned_leaves"] == 1
+        and out["zero_steps_lost"] and out["emergency_within_grace"]
+        and out["straggler_flagged"] and out["budget_untouched"]
+        and out["preempt_loss_bitwise"] and out["preempt_leaked"] == 0
+        and out["grace_escalations"] == 0)
+
+    # -- arm 4: coordinator kill → restart → completion --------------------
+    coord_report, coord_results = _launch_arm(
+        root, "coord", steps, sleep_s,
+        chaos={0: f"coord_kill@{coord_step}"},
+        heartbeat_timeout=hb_timeout, deadline_s=deadline, grace_s=grace)
+    out.update({
+        "coord_unrecovered": len(coord_report["unrecovered"]),
+        "coord_completed": coord_report["completed"],
+        "coord_restarts": coord_report["restarts"],
+        "coord_loss_bitwise": _losses_match_baseline(coord_results,
+                                                     baseline),
+        "coord_leaked": coord_report["leaked_killed"],
+    })
+    out["coord_ok"] = bool(
+        not coord_report["unrecovered"] and not coord_report["deadline_hit"]
+        and sorted(coord_report["completed"]) == [0, 1]
+        and coord_report["restarts"] == 1
+        and out["coord_loss_bitwise"] and out["coord_leaked"] == 0)
+
+    out["wall_seconds"] = round(time.perf_counter() - t0, 2)
+    out["soak_ok"] = bool(
+        out["off_ok"] and out["off_bitwise"] and out["off_leaked"] == 0
+        and out["preempt_ok"] and out["coord_ok"])
+    return out
+
+
 def main() -> None:
     if "--worker" in sys.argv:
         run_worker()
@@ -484,7 +656,12 @@ def main() -> None:
     # recovery / checkpoint timeline is debuggable from one file
     from deeplearning4j_tpu.obs import trace as obs_trace
     rec = obs_trace.enable_tracing(capacity=131072)
-    out = run_multiproc_soak() if "--multiproc" in sys.argv else run_soak()
+    if "--preempt" in sys.argv:
+        out = run_preempt_soak()
+    elif "--multiproc" in sys.argv:
+        out = run_multiproc_soak()
+    else:
+        out = run_soak()
     if not out["soak_ok"]:
         import tempfile
         path = os.path.join(tempfile.gettempdir(),

@@ -558,11 +558,11 @@ class TestChaosInjector:
 class TestChaosCLI:
     def test_parse_chaos_ok(self):
         from deeplearning4j_tpu.cli import _parse_chaos
-        sched, seed, hang = _parse_chaos(
+        sched, seed, hang, slow = _parse_chaos(
             "device_loss@5,nan_grads@9,nan_grads@10,seed=3,hang=2.5")
         assert sched.faults == {5: ["device_loss"], 9: ["nan_grads"],
                                 10: ["nan_grads"]}
-        assert seed == 3 and hang == 2.5
+        assert seed == 3 and hang == 2.5 and slow is None
 
     @pytest.mark.parametrize("spec", [
         "meteor@3", "device_loss@", "device_loss@0", "seed=3",

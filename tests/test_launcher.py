@@ -377,7 +377,9 @@ class TestProcessFaults:
         assert FaultKind.PROC_KILL in FaultKind.ALL
         assert FaultKind.PROC_HANG in FaultKind.ALL
         assert set(FaultKind.PROCESS_KINDS) == {FaultKind.PROC_KILL,
-                                                FaultKind.PROC_HANG}
+                                                FaultKind.PROC_HANG,
+                                                FaultKind.COORD_KILL,
+                                                FaultKind.PREEMPT_NOTICE}
 
     def test_scripted_schedule_accepts_proc_kinds(self):
         s = FaultSchedule.scripted({3: FaultKind.PROC_KILL,
@@ -395,7 +397,8 @@ class TestProcessFaults:
 
     def test_cli_parse_proc_kinds(self):
         from deeplearning4j_tpu.cli import _parse_chaos
-        sched, seed, hang = _parse_chaos("proc_kill@4,proc_hang@9,seed=2")
+        sched, seed, hang, _slow = _parse_chaos(
+            "proc_kill@4,proc_hang@9,seed=2")
         assert sched.faults == {4: ["proc_kill"], 9: ["proc_hang"]}
         assert seed == 2
 

@@ -1,0 +1,799 @@
+"""Preemption-tolerant pod training (PR 9): announced failures.
+
+Covers the PreemptionHandler lifecycle (notice idempotence, grace from
+env/CLI, signal installation), the grace-window emergency checkpoint
+(deflate vs ZIP_STORED fallback, bit-exact restore), the ElasticTrainer
+step-boundary check, the Membership leaving ledger + torn-JSON
+hardening, heartbeat step-time/durable-step derivation, launcher-side
+straggler flagging, coordinator election/failover, planned-leave
+restart-budget semantics, and the signal paths (SIGTERM during step /
+during checkpoint write, grace-expiry SIGKILL escalation) — the
+subprocess/signal tests are slow-marked so tier-1 stays fast."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+import zipfile
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import (
+    MultiLayerNetwork, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.updaters import Sgd
+from deeplearning4j_tpu.parallel import (
+    CheckpointManager, CoordinatorUnreachableError, ElasticTrainer,
+    FailureDetector, FaultKind, FaultSchedule, Heartbeat, HostLostError,
+    Membership, PodLauncher, PreemptedError, PreemptionHandler,
+    ProcessFailureDetector, PREEMPTED_EXIT_CODE, elect_coordinator,
+)
+from deeplearning4j_tpu.parallel.chaos import ChaosInjector
+from deeplearning4j_tpu.parallel.distributed import (
+    ENV_COORD_PORTS, ENV_COORDINATOR, ENV_GRACE_S, ENV_NUM_PROCESSES,
+    ENV_PROCESS_ID, ENV_RUN_DIR,
+)
+from deeplearning4j_tpu.parallel.launcher import maybe_bootstrap_from_env
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+
+def _mlp(seed=3, lr=0.05):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(lr=lr))
+            .layer(Dense(n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(4)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def _data(batch=32):
+    rng = np.random.default_rng(0)
+    xs = rng.normal(size=(batch, 4)).astype(np.float32)
+    ys = np.eye(3, dtype=np.float32)[rng.integers(0, 3, batch)]
+    return DataSet(xs, ys)
+
+
+class _Plain:
+    def __init__(self, net):
+        self.net = net
+
+    def fit_batch(self, ds):
+        return self.net.fit_batch(ds)
+
+
+# ---------------------------------------------------------------------------
+# PreemptionHandler lifecycle
+# ---------------------------------------------------------------------------
+
+class TestHandlerLifecycle:
+    def test_notice_is_idempotent(self, tmp_path):
+        clock = FakeClock()
+        m = Membership(str(tmp_path), clock=clock)
+        h = PreemptionHandler(grace_s=10.0, membership=m, process_id=3,
+                              clock=clock)
+        assert not h.requested and h.remaining_s == 10.0
+        h.notice(signal.SIGTERM)
+        clock.t += 4.0
+        h.notice(signal.SIGTERM)        # scheduler re-signals
+        h.notice(signal.SIGUSR1)        # launcher forwards on top
+        assert h.requested and h.notice_count == 3
+        # the deadline is anchored at the FIRST notice
+        assert h.remaining_s == pytest.approx(6.0)
+        # exactly one leaving marker, stamped at the first notice
+        assert sorted(m.leaving()) == [3]
+        assert m.leaving()[3]["t"] == 1000.0
+
+    def test_grace_from_env_and_validation(self, monkeypatch):
+        monkeypatch.setenv(ENV_GRACE_S, "12.5")
+        assert PreemptionHandler().grace_s == 12.5
+        assert PreemptionHandler(grace_s=3.0).grace_s == 3.0
+        with pytest.raises(ValueError, match="grace_s"):
+            PreemptionHandler(grace_s=0)
+
+    def test_install_uninstall_roundtrip(self):
+        prev_term = signal.getsignal(signal.SIGTERM)
+        h = PreemptionHandler(grace_s=5.0).install()
+        try:
+            assert signal.getsignal(signal.SIGTERM) == h._on_signal
+            assert signal.getsignal(signal.SIGUSR1) == h._on_signal
+        finally:
+            h.uninstall()
+        assert signal.getsignal(signal.SIGTERM) == prev_term
+
+    def test_preempted_error_is_not_recoverable(self):
+        exc = PreemptedError(7, "/tmp/x.zip", stored=True, seconds=0.1)
+        assert not FailureDetector().is_recoverable(exc)
+        assert exc.exit_code == PREEMPTED_EXIT_CODE
+        assert PREEMPTED_EXIT_CODE not in (0, 1, 2)
+
+
+# ---------------------------------------------------------------------------
+# emergency checkpoint: codec decision + restore fidelity
+# ---------------------------------------------------------------------------
+
+class TestEmergencyCheckpoint:
+    def _codecs(self, path):
+        with zipfile.ZipFile(path) as zf:
+            return {i.compress_type for i in zf.infolist()}
+
+    def test_deflate_when_grace_affords_it(self, tmp_path):
+        net = _mlp()
+        ds = _data()
+        [net.fit_batch(ds) for _ in range(2)]
+        ckpt = CheckpointManager(str(tmp_path))
+        h = PreemptionHandler(grace_s=30.0)
+        h.notice()
+        path, stored, seconds = h.emergency_checkpoint(ckpt, net, 2)
+        assert not stored and self._codecs(path) == {zipfile.ZIP_DEFLATED}
+        assert seconds <= 30.0
+
+    def test_stored_fallback_when_grace_is_tight(self, tmp_path):
+        clock = FakeClock()
+        net = _mlp()
+        ds = _data()
+        [net.fit_batch(ds) for _ in range(2)]
+        ckpt = CheckpointManager(str(tmp_path))
+        # pretend the last deflate write took 2s: with 3s of a 4s budget
+        # already burned, deflate (3x2s margin) cannot fit -> ZIP_STORED
+        ckpt.last_save_seconds = 2.0
+        h = PreemptionHandler(grace_s=4.0, clock=clock)
+        h.notice()
+        clock.t += 3.0
+        path, stored, _ = h.emergency_checkpoint(ckpt, net, 5)
+        assert stored and self._codecs(path) == {zipfile.ZIP_STORED}
+        # the uncompressed emergency checkpoint restores bit-identically
+        from deeplearning4j_tpu.utils.serializer import load_model
+        loaded = load_model(path)
+        flat = lambda t: np.concatenate(  # noqa: E731
+            [np.ravel(x) for x in
+             __import__("jax").tree_util.tree_leaves(t)])
+        assert np.array_equal(flat(loaded.params), flat(net.params))
+
+    def test_non_writer_host_skips_the_write(self, tmp_path):
+        net = _mlp()
+        ckpt = CheckpointManager(str(tmp_path), role="reader")
+        h = PreemptionHandler(grace_s=10.0)
+        h.notice()
+        path, stored, seconds = h.emergency_checkpoint(ckpt, net, 3)
+        assert path is None and seconds is not None
+
+
+# ---------------------------------------------------------------------------
+# ElasticTrainer step-boundary integration
+# ---------------------------------------------------------------------------
+
+class TestElasticBoundary:
+    def test_notice_mid_run_checkpoints_and_resumes_bitwise(self, tmp_path):
+        ds = _data()
+        ref_net = _mlp()
+        ref = [float(ref_net.fit_batch(ds)) for _ in range(10)]
+
+        h = PreemptionHandler(grace_s=30.0)
+        et = ElasticTrainer(_Plain(_mlp()), str(tmp_path),
+                            checkpoint_every=4, preemption=h)
+        losses = [float(et.fit_batch(ds)) for _ in range(6)]
+        h.notice(signal.SIGTERM)          # arrives "mid-step"
+        with pytest.raises(PreemptedError) as ei:
+            et.fit_batch(ds)
+        assert ei.value.step == 6
+        assert et.last_checkpoint_step == 6
+        # a fresh process (relaunch) resumes at EXACTLY the preempted step
+        et2 = ElasticTrainer(_Plain(_mlp()), str(tmp_path),
+                             checkpoint_every=4)
+        assert et2.resume() == 6
+        tail = [float(et2.fit_batch(ds)) for _ in range(4)]
+        assert losses + tail == ref       # zero steps lost, bit-exact
+
+    def test_notice_during_checkpoint_write_defers_to_boundary(
+            self, tmp_path):
+        """A notice landing while ckpt.save is mid-write (the signal
+        handler only flips the flag) must let the write complete and be
+        processed at the NEXT boundary with a fresh emergency
+        checkpoint."""
+        ds = _data()
+        h = PreemptionHandler(grace_s=30.0)
+        et = ElasticTrainer(_Plain(_mlp()), str(tmp_path),
+                            checkpoint_every=3, preemption=h)
+        real_save = et.ckpt.save
+
+        def noisy_save(net, step):
+            h.notice(signal.SIGTERM)      # "signal" arrives mid-write
+            return real_save(net, step)
+
+        et.ckpt.save = noisy_save
+        for _ in range(2):
+            et.fit_batch(ds)
+        # step 3 checkpoints (notice fires inside the write, write lands),
+        # the step itself completes, and the NEXT call preempts at 3
+        float(et.fit_batch(ds))
+        assert (tmp_path / "checkpoint_0000000003.zip").exists()
+        et.ckpt.save = real_save          # emergency path uses save_snapshot
+        with pytest.raises(PreemptedError) as ei:
+            et.fit_batch(ds)
+        assert ei.value.step == 3
+
+    def test_preemption_not_swallowed_by_recovery(self, tmp_path):
+        """PreemptedError must propagate even with a permissive detector
+        and retries configured — the host is going away."""
+        class EverythingRecovers(FailureDetector):
+            def is_recoverable(self, exc):
+                return super().is_recoverable(exc) or True
+
+        h = PreemptionHandler(grace_s=30.0)
+        et = ElasticTrainer(_Plain(_mlp()), str(tmp_path), max_restarts=99,
+                            failure_detector=EverythingRecovers(),
+                            preemption=h)
+        ds = _data()
+        et.fit_batch(ds)
+        h.notice()
+        with pytest.raises(PreemptedError):
+            et.fit_batch(ds)
+
+    def test_fit_flushes_inflight_async_checkpoint(self, tmp_path):
+        """Satellite: fit() must wait() the in-flight save_async so the
+        final checkpoint is durable and intact on disk when it returns."""
+        from deeplearning4j_tpu.datasets import ListDataSetIterator
+        from deeplearning4j_tpu.utils.serializer import load_model
+
+        et = ElasticTrainer(_Plain(_mlp()), str(tmp_path),
+                            checkpoint_every=2, async_checkpoints=True)
+        ds = _data()
+        et.fit(ListDataSetIterator([ds] * 5), epochs=1)
+        latest = et.ckpt.latest()
+        assert latest is not None and latest[1] == 5
+        loaded = load_model(latest[0])    # intact: loads + digests verify
+        assert loaded.iteration == 5
+        assert et.last_checkpoint_step == 5
+
+
+# ---------------------------------------------------------------------------
+# membership: torn JSON hardening + leaving ledger (satellites)
+# ---------------------------------------------------------------------------
+
+class TestMembershipHardening:
+    def test_scan_survives_torn_and_garbage_heartbeats(self, tmp_path):
+        clock = FakeClock()
+        m = Membership(str(tmp_path), heartbeat_timeout=5.0, clock=clock)
+        m.beat(0)
+        # a worker killed mid-beat() leaves every flavor of torn file:
+        (tmp_path / "hb_1.json").write_text("")              # empty
+        (tmp_path / "hb_2.json").write_text('{"process_id"')  # truncated
+        (tmp_path / "hb_3.json").write_text("null")          # non-dict
+        (tmp_path / "hb_4.json").write_text('{"pid": 7}')    # missing id
+        (tmp_path / "hb_5.json").write_text('{"process_id": "x"}')
+        assert m.alive() == [0]           # torn beats = missed beats
+        assert m.refresh() == 1           # monitor loop must not raise
+        assert m.last_beat(3) is None
+        assert m.last_checkpoint_step() == -1
+
+    def test_truncated_ledger_degrades_to_default(self, tmp_path):
+        """Regression: a truncated membership.json must read as the empty
+        default (re-persisted by the next refresh), not raise
+        JSONDecodeError in the coordinator's monitor loop."""
+        clock = FakeClock()
+        m = Membership(str(tmp_path), heartbeat_timeout=5.0, clock=clock)
+        m.beat(0)
+        m.refresh()
+        ledger = tmp_path / Membership.LEDGER
+        full = ledger.read_text()
+        ledger.write_text(full[:len(full) // 2])   # torn write
+        assert m.read() == {"epoch": 0, "members": []}
+        assert m.refresh() == 1           # recovers by re-persisting
+        ledger.write_text("[1, 2]")       # garbage of the wrong shape
+        assert m.read() == {"epoch": 0, "members": []}
+
+    def test_leaving_marker_is_a_fast_leave(self, tmp_path):
+        clock = FakeClock()
+        m = Membership(str(tmp_path), heartbeat_timeout=5.0, clock=clock)
+        m.beat(0)
+        m.beat(1)
+        assert m.refresh() == 1 and m.members() == [0, 1]
+        # preemption notice: worker 1 still BEATS (it is writing its
+        # emergency checkpoint) but is logically gone immediately
+        m.mark_leaving(1, grace_s=10.0)
+        m.beat(1)
+        assert m.alive() == [0]
+        assert m.refresh() == 2 and m.members() == [0]
+        # relaunch clears the marker: the new incarnation rejoins
+        m.clear_leaving(1)
+        m.beat(1)
+        assert m.alive() == [0, 1]
+
+    def test_detector_sees_fast_leave_without_heartbeat_expiry(
+            self, tmp_path):
+        clock = FakeClock()
+        m = Membership(str(tmp_path), heartbeat_timeout=5.0, clock=clock)
+        m.beat(0)
+        m.beat(1)
+        det = ProcessFailureDetector(m)
+        det.check()                       # baseline
+        m.mark_leaving(1)                 # no clock advance at all
+        with pytest.raises(HostLostError) as ei:
+            det.check()
+        assert ei.value.lost == [1]
+
+    def test_beat_carries_ckpt_step(self, tmp_path):
+        clock = FakeClock()
+        m = Membership(str(tmp_path), clock=clock)
+        m.beat(0, step=10, ckpt_step=8)
+        m.beat(1, step=12, ckpt_step=12)
+        assert m.last_checkpoint_step() == 12
+
+
+# ---------------------------------------------------------------------------
+# heartbeat-derived step time
+# ---------------------------------------------------------------------------
+
+class TestHeartbeatStepTime:
+    def test_first_sample_discarded_then_derived(self, tmp_path):
+        clock = FakeClock()
+        m = Membership(str(tmp_path), clock=clock)
+        state = {"step": 0, "ckpt": -1}
+        hb = Heartbeat(m, 0, step_fn=lambda: state["step"],
+                       ckpt_step_fn=lambda: state["ckpt"],
+                       export_metrics=False)
+        hb._beat_once()                               # step 0 baseline
+        clock.t += 5.0
+        state["step"] = 1                             # compile-polluted
+        hb._beat_once()
+        assert m.last_beat(0)["step_s"] is None       # discarded
+        clock.t += 0.4
+        state["step"] = 2
+        state["ckpt"] = 2
+        hb._beat_once()
+        rec = m.last_beat(0)
+        assert rec["step_s"] == pytest.approx(0.4)
+        assert rec["ckpt_step"] == 2
+        clock.t += 0.8
+        state["step"] = 4                             # 2 steps per beat
+        hb._beat_once()
+        assert m.last_beat(0)["step_s"] == pytest.approx(0.4)
+
+
+# ---------------------------------------------------------------------------
+# launcher: straggler detection (driven directly, no processes)
+# ---------------------------------------------------------------------------
+
+class _FakeProc:
+    def __init__(self):
+        self.killed = 0
+
+    def poll(self):
+        return None
+
+    def kill(self):
+        self.killed += 1
+
+
+class TestStragglerDetection:
+    def _launcher(self, tmp_path, n=3, policy="flag", **kw):
+        clock = FakeClock()
+        lp = PodLauncher(["true"], num_workers=n, run_dir=str(tmp_path),
+                         straggler_policy=policy, straggler_beats=3,
+                         straggler_factor=2.0, **kw)
+        lp.membership.clock = clock
+        for h in lp.handles:
+            h.state = "running"
+            h.proc = _FakeProc()
+        return lp, clock
+
+    def _beat_all(self, lp, clock, step_times):
+        clock.t += 1.0
+        for i, s in enumerate(step_times):
+            lp.membership.beat(i, step_s=s)
+
+    def test_flagged_after_m_consecutive_beats(self, tmp_path):
+        lp, clock = self._launcher(tmp_path)
+        for round_i in range(3):
+            self._beat_all(lp, clock, [0.3, 0.31, 1.0])   # 1.0 > 2x0.305
+            lp._check_stragglers()
+        events = [e for e in lp.events if e["kind"] == "straggler"]
+        assert len(events) == 1 and events[0]["worker"] == 2
+        assert events[0]["streak"] == 3
+        assert lp.stats()["stragglers_flagged"] == 1
+        # flagged once per incarnation — more beats don't re-flag
+        self._beat_all(lp, clock, [0.3, 0.31, 1.0])
+        lp._check_stragglers()
+        assert len([e for e in lp.events
+                    if e["kind"] == "straggler"]) == 1
+
+    def test_streak_resets_when_pace_recovers(self, tmp_path):
+        lp, clock = self._launcher(tmp_path)
+        self._beat_all(lp, clock, [0.3, 0.3, 1.0])
+        lp._check_stragglers()
+        self._beat_all(lp, clock, [0.3, 0.3, 0.32])       # recovered
+        lp._check_stragglers()
+        self._beat_all(lp, clock, [0.3, 0.3, 1.0])
+        lp._check_stragglers()
+        self._beat_all(lp, clock, [0.3, 0.3, 1.0])
+        lp._check_stragglers()
+        assert not [e for e in lp.events if e["kind"] == "straggler"]
+
+    def test_same_beat_not_recounted(self, tmp_path):
+        lp, clock = self._launcher(tmp_path)
+        self._beat_all(lp, clock, [0.3, 0.3, 1.0])
+        for _ in range(5):                # poll 5x on ONE beat
+            lp._check_stragglers()
+        assert not [e for e in lp.events if e["kind"] == "straggler"]
+
+    def test_relaunch_policy_kills(self, tmp_path):
+        lp, clock = self._launcher(tmp_path, policy="relaunch")
+        for _ in range(3):
+            self._beat_all(lp, clock, [0.3, 0.3, 1.0])
+            lp._check_stragglers()
+        assert lp.handles[2].straggler_killed
+        assert lp.handles[2].proc.killed == 1
+
+    def test_off_policy_and_single_worker_no_scan(self, tmp_path):
+        lp, clock = self._launcher(tmp_path, policy="off")
+        for _ in range(3):
+            self._beat_all(lp, clock, [0.3, 0.3, 9.9])
+            lp._check_stragglers()
+        assert not [e for e in lp.events if e["kind"] == "straggler"]
+        with pytest.raises(ValueError, match="straggler_policy"):
+            PodLauncher(["true"], 1, str(tmp_path / "x"),
+                        straggler_policy="maybe")
+
+
+# ---------------------------------------------------------------------------
+# launcher stats / run-report surfaces (satellite)
+# ---------------------------------------------------------------------------
+
+class TestPodLivenessSurfaces:
+    def test_stats_carries_pod_liveness(self, tmp_path):
+        clock = FakeClock()
+        lp = PodLauncher(["true"], num_workers=2, run_dir=str(tmp_path))
+        lp.membership.clock = clock
+        lp.membership.beat(0, step=9, ckpt_step=8)
+        lp.membership.beat(1, step=9, ckpt_step=8)
+        lp.membership.refresh()
+        lp.membership.mark_leaving(1)
+        s = lp.stats()
+        assert s["epoch"] == 1
+        assert s["alive"] == [0]
+        assert s["leaving"] == [1]
+        assert s["last_checkpoint_step"] == 8
+        assert s["planned_leaves"] == 0
+
+    def test_metrics_registry_exposes_launcher_collector(self, tmp_path):
+        from deeplearning4j_tpu.obs.metrics import get_registry
+
+        lp = PodLauncher(["true"], num_workers=2, run_dir=str(tmp_path))
+        lp.membership.beat(0, ckpt_step=4)
+        snap = get_registry().snapshot()
+        mine = [v for k, v in snap.get("collected", {}).items()
+                if k.startswith("launcher") and isinstance(v, dict)
+                and v.get("last_checkpoint_step") == 4]
+        assert mine and {"epoch", "alive", "leaving",
+                         "last_checkpoint_step"} <= set(mine[0])
+
+
+# ---------------------------------------------------------------------------
+# coordinator election + bootstrap failover
+# ---------------------------------------------------------------------------
+
+class TestCoordinatorFailover:
+    def test_elect_lowest_alive_id(self, tmp_path):
+        clock = FakeClock()
+        m = Membership(str(tmp_path), heartbeat_timeout=5.0, clock=clock)
+        for i in (0, 1, 2):
+            m.beat(i)
+        assert elect_coordinator(m, [9000, 9001, 9002]) == \
+            (0, "127.0.0.1:9000")
+        clock.t += 6.0                    # coordinator's beat expires
+        m.beat(1)
+        m.beat(2)
+        assert elect_coordinator(m, [9000, 9001, 9002]) == \
+            (1, "127.0.0.1:9001")
+        # a LEAVING survivor is skipped too (it announced departure)
+        m.mark_leaving(1)
+        assert elect_coordinator(m, [9000, 9001, 9002])[0] == 2
+
+    def test_elect_uses_advertised_addr(self, tmp_path):
+        m = Membership(str(tmp_path))
+        m.beat(1, addr="10.0.0.7")
+        assert elect_coordinator(m, {1: 8476}) == (1, "10.0.0.7:8476")
+
+    def test_elect_raises_when_nobody_alive(self, tmp_path):
+        m = Membership(str(tmp_path))
+        with pytest.raises(CoordinatorUnreachableError, match="no alive"):
+            elect_coordinator(m, [9000])
+
+    def test_bootstrap_fails_over_to_elected_survivor(self, tmp_path,
+                                                      monkeypatch):
+        """Coordinator restart: a worker whose initialize() finds the
+        configured coordinator dead must re-initialize against the
+        survivor with the lowest alive id — not die terminal."""
+        m = Membership(str(tmp_path))
+        m.beat(1)
+        m.beat(2)
+        monkeypatch.setenv(ENV_COORDINATOR, "127.0.0.1:9000")
+        monkeypatch.setenv(ENV_NUM_PROCESSES, "3")
+        monkeypatch.setenv(ENV_PROCESS_ID, "2")
+        monkeypatch.setenv(ENV_RUN_DIR, str(tmp_path))
+        monkeypatch.setenv(ENV_COORD_PORTS, "9000,9001,9002")
+        calls = []
+
+        def fake_init(addr, n, i, timeout_s=None):
+            calls.append(addr)
+            if addr == "127.0.0.1:9000":
+                raise CoordinatorUnreachableError("dead")
+
+        assert maybe_bootstrap_from_env(_initialize=fake_init)
+        assert calls == ["127.0.0.1:9000", "127.0.0.1:9001"]
+
+    def test_bootstrap_stays_terminal_without_failover_contract(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setenv(ENV_COORDINATOR, "127.0.0.1:9000")
+        monkeypatch.setenv(ENV_NUM_PROCESSES, "2")
+        monkeypatch.setenv(ENV_PROCESS_ID, "1")
+        monkeypatch.delenv(ENV_COORD_PORTS, raising=False)
+        monkeypatch.delenv(ENV_RUN_DIR, raising=False)
+
+        def fake_init(addr, n, i, timeout_s=None):
+            raise CoordinatorUnreachableError("dead")
+
+        with pytest.raises(CoordinatorUnreachableError):
+            maybe_bootstrap_from_env(_initialize=fake_init)
+
+    def test_launcher_exports_coord_ports_in_distributed_mode(
+            self, tmp_path):
+        lp = PodLauncher(["true"], num_workers=3, run_dir=str(tmp_path),
+                         bootstrap="distributed", coordinator_port=7001)
+        env = lp._env_for(lp.handles[1])
+        ports = [int(p) for p in env[ENV_COORD_PORTS].split(",")]
+        assert len(ports) == 3 and ports[0] == 7001
+        assert env[ENV_COORDINATOR] == "127.0.0.1:7001"
+        assert float(env[ENV_GRACE_S]) == 30.0
+
+
+# ---------------------------------------------------------------------------
+# chaos kinds
+# ---------------------------------------------------------------------------
+
+class TestNewChaosKinds:
+    def test_kinds_registered_and_parseable(self):
+        for kind in (FaultKind.PREEMPT_NOTICE, FaultKind.COORD_KILL,
+                     FaultKind.SLOW_WORKER):
+            assert kind in FaultKind.ALL
+        from deeplearning4j_tpu.cli import _parse_chaos
+        sched, seed, hang, slow = _parse_chaos(
+            "preempt_notice@4,slow_worker@2,slow=0.9")
+        assert sched.faults == {4: ["preempt_notice"], 2: ["slow_worker"]}
+        assert slow == 0.9
+
+    def test_slow_worker_drags_every_later_step(self):
+        class Recorder:
+            def __init__(self):
+                self.net = self
+                self.sleeps = []
+
+            def fit_batch(self, ds):
+                return 0.0
+
+        rec = Recorder()
+        inj = ChaosInjector(rec, FaultSchedule.scripted(
+            {2: FaultKind.SLOW_WORKER}), slow_seconds=0.5,
+            sleep_fn=rec.sleeps.append)
+        for _ in range(4):
+            inj.fit_batch(None)
+        assert rec.sleeps == [0.5, 0.5, 0.5]    # steps 2, 3, 4
+
+    def test_coord_kill_rejected_off_coordinator(self, monkeypatch):
+        monkeypatch.setenv(ENV_PROCESS_ID, "1")
+        inj = ChaosInjector(object(), FaultSchedule.scripted(
+            {1: FaultKind.COORD_KILL}))
+        with pytest.raises(RuntimeError, match="non-coordinator"):
+            inj._kill_self(FaultKind.COORD_KILL)
+
+    def test_preempt_notice_signals_not_kills(self, tmp_path):
+        """The announced kind delivers SIGTERM and RETURNS — the step
+        completes; with a handler installed the flag flips in-process."""
+        h = PreemptionHandler(grace_s=30.0).install()
+        try:
+            class T:
+                net = None
+
+                def fit_batch(self, ds):
+                    return 1.25
+
+            inj = ChaosInjector(T(), FaultSchedule.scripted(
+                {2: FaultKind.PREEMPT_NOTICE}))
+            assert inj.fit_batch(None) == 1.25
+            assert not h.requested
+            assert inj.fit_batch(None) == 1.25   # step 2 still completes
+            assert h.requested                   # but the notice is in
+        finally:
+            h.uninstall()
+
+
+# ---------------------------------------------------------------------------
+# signal paths through real processes (slow: subprocess + signals)
+# ---------------------------------------------------------------------------
+
+def _run_py(body, env=None, timeout=120):
+    code = ("import os, sys\n"
+            "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+            f"sys.path.insert(0, {_REPO!r})\n" + textwrap.dedent(body))
+    full_env = dict(os.environ)
+    full_env.pop("XLA_FLAGS", None)
+    full_env["JAX_PLATFORMS"] = "cpu"
+    full_env.update(env or {})
+    return subprocess.run([sys.executable, "-c", code], env=full_env,
+                          capture_output=True, text=True, timeout=timeout)
+
+
+@pytest.mark.slow
+class TestSignalPaths:
+    def test_sigterm_during_step_exits_preempted(self, tmp_path):
+        """A real SIGTERM delivered while the training loop runs: the
+        worker must write an emergency checkpoint and exit with the
+        distinct PREEMPTED code, well inside the grace budget."""
+        script = f"""
+        import time
+        import numpy as np
+        from deeplearning4j_tpu.datasets import DataSet
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import (
+            MultiLayerNetwork, NeuralNetConfiguration)
+        from deeplearning4j_tpu.nn.updaters import Sgd
+        from deeplearning4j_tpu.parallel import (
+            ElasticTrainer, PreemptedError, PreemptionHandler)
+        conf = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(lr=.05))
+                .layer(Dense(n_out=8, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4)).build())
+        net = MultiLayerNetwork(conf); net.init()
+        rng = np.random.default_rng(0)
+        ds = DataSet(rng.normal(size=(16, 4)).astype(np.float32),
+                     np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)])
+        class P:
+            def __init__(s, n): s.net = n
+            def fit_batch(s, d):
+                time.sleep(0.05)
+                return s.net.fit_batch(d)
+        h = PreemptionHandler.install_from_env(grace_s=15.0)
+        et = ElasticTrainer(P(net), {str(tmp_path)!r}, checkpoint_every=50,
+                            preemption=h)
+        print("READY", flush=True)
+        try:
+            for _ in range(2000):
+                et.fit_batch(ds)
+            raise SystemExit("never preempted")
+        except PreemptedError as e:
+            print("PREEMPTED", e.step, e.seconds, flush=True)
+            raise SystemExit(e.exit_code)
+        """
+        code = ("import os, sys\n"
+                "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+                f"sys.path.insert(0, {_REPO!r})\n" + textwrap.dedent(script))
+        env = dict(os.environ)
+        env.pop("XLA_FLAGS", None)
+        env["JAX_PLATFORMS"] = "cpu"
+        p = subprocess.Popen([sys.executable, "-c", code], env=env,
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True)
+        assert p.stdout.readline().strip() == "READY"
+        time.sleep(1.0)                   # mid-training
+        t0 = time.monotonic()
+        p.send_signal(signal.SIGTERM)
+        out, err = p.communicate(timeout=60)
+        elapsed = time.monotonic() - t0
+        assert p.returncode == PREEMPTED_EXIT_CODE, (out, err)
+        assert "PREEMPTED" in out
+        assert elapsed < 15.0, f"emergency exit took {elapsed:.1f}s"
+        ckpts = [f for f in os.listdir(tmp_path) if f.endswith(".zip")]
+        assert ckpts, "no emergency checkpoint on disk"
+
+    def test_grace_expired_launcher_escalates_to_sigkill(self, tmp_path):
+        """A worker that ignores its notice must be SIGKILLed by the
+        launcher once the grace budget (plus margin) expires, then
+        relaunched through the budgeted leave path."""
+        worker = tmp_path / "stubborn.py"
+        worker.write_text(textwrap.dedent(f"""
+            import os, signal, sys, time
+            sys.path.insert(0, {_REPO!r})
+            from deeplearning4j_tpu.parallel.launcher import (
+                Heartbeat, Membership)
+            signal.signal(signal.SIGTERM, signal.SIG_IGN)   # stubborn
+            hb = Heartbeat.start_from_env()
+            inc = int(os.environ.get("DL4J_TPU_INCARNATION", "0"))
+            # first incarnation ignores its notice forever; the relaunch
+            # behaves and completes
+            time.sleep(30.0 if inc == 0 else 0.5)
+            hb.stop()
+        """))
+        lp = PodLauncher([sys.executable, str(worker)], num_workers=1,
+                         run_dir=str(tmp_path / "run"), grace_s=0.6,
+                         heartbeat_timeout=5.0, deadline_s=60.0,
+                         max_restarts=2, poll_interval=0.05)
+        t = threading.Thread(target=lambda: setattr(
+            lp, "_report", lp.run()), daemon=True)
+        t.start()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if lp.handles[0].state == "running" and \
+                    lp.membership.last_beat(0) is not None:
+                break
+            time.sleep(0.05)
+        assert lp.preempt_worker(0)
+        t.join(timeout=45)
+        assert not t.is_alive()
+        report = lp._report
+        assert report["grace_escalations"] == 1
+        causes = [e["cause"] for e in report["leaves"]]
+        assert "grace_expired" in causes
+        assert report["completed"] == [0]      # relaunched and finished
+        assert report["budget_used"][0] == 1   # escalation consumes budget
+        assert report["leaked_killed"] == 0
+
+    def test_planned_leave_does_not_consume_budget(self, tmp_path):
+        """A worker that self-notices (handler installed) and exits with
+        the PREEMPTED code must be relaunched with the restart budget
+        untouched — even with max_restarts=0."""
+        worker = tmp_path / "polite.py"
+        worker.write_text(textwrap.dedent(f"""
+            import os, sys, time
+            sys.path.insert(0, {_REPO!r})
+            from deeplearning4j_tpu.parallel.launcher import Heartbeat
+            from deeplearning4j_tpu.parallel.preemption import (
+                PreemptionHandler)
+            from deeplearning4j_tpu.parallel.distributed import (
+                PREEMPTED_EXIT_CODE)
+            hb = Heartbeat.start_from_env()
+            h = PreemptionHandler.install_from_env()
+            inc = int(os.environ.get("DL4J_TPU_INCARNATION", "0"))
+            if inc == 0:
+                h.notice()                 # the scheduler's SIGTERM
+                hb.stop(deregister=True)
+                raise SystemExit(PREEMPTED_EXIT_CODE)
+            time.sleep(0.3)
+            hb.stop()
+        """))
+        lp = PodLauncher([sys.executable, str(worker)], num_workers=1,
+                         run_dir=str(tmp_path / "run"), grace_s=10.0,
+                         max_restarts=0, deadline_s=60.0,
+                         poll_interval=0.05)
+        report = lp.run()
+        assert report["completed"] == [0]
+        assert report["planned_leaves"] == 1
+        assert report["restarts"] == 0         # budget untouched
+        assert report["budget_used"][0] == 0
+        causes = [(e["cause"], e.get("planned")) for e in report["leaves"]]
+        assert ("preempted", True) in causes
+        assert report["preempt_notices"] == 1  # observed via the ledger
+        assert report["leaked_killed"] == 0
+
+    def test_preempt_soak_quick_end_to_end(self, tmp_path):
+        """The full announced-failure soak (the bench gate's engine) in
+        quick mode — the acceptance e2e."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "chaos_soak", os.path.join(_REPO, "scripts", "chaos_soak.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        out = mod.run_preempt_soak(quick=True, root=str(tmp_path))
+        assert out["soak_ok"], json.dumps(out, indent=1)[:3000]
+        assert out["emergency_within_grace"] and out["zero_steps_lost"]
+        assert out["budget_untouched"] and out["straggler_flagged"]
+        assert out["coord_ok"] and out["off_bitwise"]
