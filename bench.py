@@ -1312,6 +1312,45 @@ def bench_preemption():
             "leaked": 0, "wall_seconds": soak["wall_seconds"]}
 
 
+def bench_static_analysis():
+    """Config 18: graftcheck clean gate (scripts/graftcheck.py; no
+    accelerator — pure AST analysis).  HARD gate: the analyzer runs
+    over the whole package with >= 12 rules across the four families
+    (jit purity / determinism / thread safety / contracts) and reports
+    ZERO unsuppressed findings; every suppression carries a
+    justification (a justification-less pragma or baseline entry is
+    itself a finding, so it cannot pass).  The bench trail thereby
+    records the zero-findings state per round — a future PR that trips
+    a rule shows up here as well as in tier-1
+    (tests/test_static_analysis.py).  The reported value is the number
+    of enforced rules."""
+    import subprocess
+    import sys
+
+    script = os.path.join(_REPO, "scripts", "graftcheck.py")
+    p = subprocess.run([sys.executable, script, "--format", "json"],
+                       capture_output=True, text=True, timeout=600,
+                       cwd=_REPO)
+    if p.returncode not in (0, 1):
+        raise RuntimeError(f"graftcheck crashed (rc={p.returncode}): "
+                           f"{p.stderr[-1000:]}")
+    report = json.loads(p.stdout)
+    if not report["ok"] or report["summary"]["unsuppressed"] != 0:
+        heads = [f"{f['path']}:{f['line']} {f['rule']} {f['message']}"
+                 for f in report["findings"][:10]]
+        raise RuntimeError(
+            f"graftcheck gate FAILED: {report['summary']['unsuppressed']} "
+            f"unsuppressed finding(s): " + "; ".join(heads))
+    n_rules = len(report["rules"])
+    if n_rules < 12:
+        raise RuntimeError(f"rule catalog shrank below 12 ({n_rules}) — "
+                           "the analyzer lost coverage")
+    return {"metric": "static_analysis_clean", "value": n_rules,
+            "unit": "rules enforced", "files": report["files"],
+            "unsuppressed": 0,
+            "suppressed": report["summary"]["suppressed"]}
+
+
 def main() -> None:
     import jax
 
@@ -1336,7 +1375,8 @@ def main() -> None:
                      ("serving_throughput", bench_serving),
                      ("serving_chaos_recovery", bench_serving_chaos),
                      ("input_pipeline_overlap", bench_input_pipeline),
-                     ("telemetry_overhead", bench_telemetry_overhead)]:
+                     ("telemetry_overhead", bench_telemetry_overhead),
+                     ("static_analysis_clean", bench_static_analysis)]:
         try:
             t0 = time.perf_counter()
             out = fn()

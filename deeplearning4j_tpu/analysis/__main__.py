@@ -1,0 +1,8 @@
+"""``python -m deeplearning4j_tpu.analysis`` — run graftcheck."""
+
+import sys
+
+from . import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,187 @@
+"""GC1xx — JIT purity rules over the traced-code set.
+
+Everything here runs only inside functions the jit-boundary pass marked
+traced (callgraph.CallGraph.traced): host syncs, host side effects, and
+host-state mutation are legal in eager code, so the traced set is what
+keeps these rules quiet where they should be.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from .callgraph import CallGraph, FunctionInfo, dotted
+from .findings import Finding
+
+# calls that force a device->host sync (or outright fail) on traced values
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+# builtins that coerce a traced value to a host scalar
+_COERCERS = {"float", "int", "bool", "complex"}
+# numpy entry points that materialize a traced value on host
+_NP_MATERIALIZE = {"asarray", "array", "copy", "save", "savez"}
+
+# dotted prefixes whose call is a host side effect frozen at trace time
+_IMPURE_CALLS = {
+    "print", "input", "open",
+    "time.time", "time.time_ns", "time.monotonic", "time.perf_counter",
+    "time.sleep", "time.process_time",
+    "os.getenv", "os.urandom", "os.system",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.date.today",
+}
+_IMPURE_PREFIXES = ("random.", "np.random.", "numpy.random.", "logging.",
+                    "logger.", "warnings.")
+# jax.debug.* is the sanctioned way to print from traced code
+_ALLOWED_PREFIXES = ("jax.debug.",)
+
+
+def _body_nodes(fn: FunctionInfo):
+    """Walk fn's body, NOT descending into nested function defs (they
+    are separate FunctionInfos, checked iff themselves traced)."""
+    stack = list(ast.iter_child_nodes(fn.node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _tainted_names(fn: FunctionInfo) -> Set[str]:
+    """First-order taint: parameters + names assigned from expressions
+    mentioning a tainted name.  Iterates to a fixed point (bodies are
+    small)."""
+    tainted = set(fn.params)
+    changed = True
+    while changed:
+        changed = False
+        for node in _body_nodes(fn):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                value = node.value
+                if value is None:
+                    continue
+                rhs_names = {n.id for n in ast.walk(value)
+                             if isinstance(n, ast.Name)}
+                if not (rhs_names & tainted):
+                    continue
+                targets = node.targets if isinstance(node, ast.Assign) \
+                    else [node.target]
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name) and n.id not in tainted:
+                            tainted.add(n.id)
+                            changed = True
+            elif isinstance(node, ast.For):
+                it_names = {n.id for n in ast.walk(node.iter)
+                            if isinstance(n, ast.Name)}
+                if it_names & tainted:
+                    for n in ast.walk(node.target):
+                        if isinstance(n, ast.Name) and n.id not in tainted:
+                            tainted.add(n.id)
+                            changed = True
+    return tainted
+
+
+def _is_tainted_expr(expr: ast.AST, tainted: Set[str]) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Name) and n.id in tainted:
+            return True
+    return False
+
+
+def check_purity(graph: CallGraph) -> List[Finding]:
+    out: List[Finding] = []
+    for fi in graph.functions.values():
+        if graph.is_traced(fi):
+            out.extend(_check_traced_fn(graph, fi))
+        out.extend(_check_jit_in_loop(fi))
+    return out
+
+
+def _check_traced_fn(graph: CallGraph, fi: FunctionInfo) -> List[Finding]:
+    out: List[Finding] = []
+    reason = graph.traced.get(fi.gid, "")
+    tainted = _tainted_names(fi)
+    rel = fi.module.relpath
+    for node in _body_nodes(fi):
+        if isinstance(node, ast.Call):
+            name = dotted(node.func)
+            # GC101: host syncs
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _SYNC_METHODS:
+                out.append(Finding(
+                    "GC101", rel, node.lineno, node.col_offset, fi.qual,
+                    f".{node.func.attr}() inside traced code forces a "
+                    "host sync (or fails under jit)", reason))
+            elif name in _COERCERS and node.args and \
+                    _is_tainted_expr(node.args[0], tainted):
+                out.append(Finding(
+                    "GC101", rel, node.lineno, node.col_offset, fi.qual,
+                    f"{name}() of a traced value inside traced code "
+                    "forces a host sync", reason))
+            elif name is not None and name.split(".")[0] in ("np", "numpy") \
+                    and name.split(".")[-1] in _NP_MATERIALIZE \
+                    and node.args and _is_tainted_expr(node.args[0], tainted):
+                out.append(Finding(
+                    "GC101", rel, node.lineno, node.col_offset, fi.qual,
+                    f"{name}() materializes a traced value on host",
+                    reason))
+            # GC102: host side effects
+            elif name is not None and \
+                    not name.startswith(_ALLOWED_PREFIXES) and \
+                    (name in _IMPURE_CALLS
+                     or name.startswith(_IMPURE_PREFIXES)):
+                out.append(Finding(
+                    "GC102", rel, node.lineno, node.col_offset, fi.qual,
+                    f"{name}() inside traced code runs at trace time "
+                    "only — its effect/value is frozen into the "
+                    "compiled program", reason))
+        # GC103: host-state mutation
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                if isinstance(t, ast.Attribute) and \
+                        isinstance(t.value, ast.Name) and \
+                        t.value.id == "self":
+                    out.append(Finding(
+                        "GC103", rel, t.lineno, t.col_offset, fi.qual,
+                        f"assignment to self.{t.attr} inside traced "
+                        "code mutates host state at trace time — it "
+                        "will not re-run per step", reason))
+        elif isinstance(node, ast.Global):
+            out.append(Finding(
+                "GC103", rel, node.lineno, node.col_offset, fi.qual,
+                "`global` declaration inside traced code — host-state "
+                "mutation at trace time", reason))
+    return out
+
+
+def _check_jit_in_loop(fi: FunctionInfo) -> List[Finding]:
+    """GC104: jax.jit(...) constructed lexically inside a loop body."""
+    out: List[Finding] = []
+    rel = fi.module.relpath
+
+    def scan(node: ast.AST, in_loop: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # a def in a loop builds once per call, not here
+            child_in_loop = in_loop
+            if isinstance(child, (ast.For, ast.While, ast.AsyncFor)):
+                child_in_loop = True
+            if in_loop and isinstance(child, ast.Call):
+                name = dotted(child.func)
+                norm = fi.module.normalize(name) if name else None
+                leaf = norm.split(".")[-1] if norm else ""
+                if leaf == "jit" and (("jax" in norm) or name == "jit"):
+                    out.append(Finding(
+                        "GC104", rel, child.lineno, child.col_offset,
+                        fi.qual,
+                        "jax.jit(...) constructed inside a loop body — "
+                        "a fresh callable (and jit cache) per "
+                        "iteration; hoist it out of the loop"))
+            scan(child, child_in_loop)
+
+    scan(fi.node, False)
+    return out

@@ -705,6 +705,21 @@ def cmd_summary(args) -> int:
     return 0
 
 
+def cmd_check(args) -> int:
+    """graftcheck static analysis (docs/STATIC_ANALYSIS.md)."""
+    from .analysis import main as analysis_main
+
+    argv = list(args.paths)
+    argv += ["--format", args.format]
+    if args.baseline != "<default>":
+        argv += ["--baseline", args.baseline]
+    if args.baseline_update:
+        argv += ["--baseline-update", "--justification", args.justification]
+    if args.show_suppressed:
+        argv += ["--show-suppressed"]
+    return analysis_main(argv)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="deeplearning4j_tpu",
                                 description=__doc__.split("\n")[0])
@@ -909,6 +924,23 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--model", required=True)
     s.add_argument("--batch-size", type=int, default=32)
     s.set_defaults(fn=cmd_summary)
+
+    c = sub.add_parser(
+        "check", help="graftcheck: repo-native static analysis — jit "
+        "purity, determinism, thread safety, telemetry contracts "
+        "(docs/STATIC_ANALYSIS.md)")
+    c.add_argument("paths", nargs="*",
+                   help="specific .py files (default: whole package)")
+    c.add_argument("--format", choices=("text", "json"), default="text")
+    c.add_argument("--baseline", default="<default>",
+                   help="baseline json ('none' disables)")
+    c.add_argument("--baseline-update", action="store_true",
+                   help="accept current findings into the baseline "
+                   "(REQUIRES --justification)")
+    c.add_argument("--justification", default="",
+                   help="why the baselined findings are accepted")
+    c.add_argument("--show-suppressed", action="store_true")
+    c.set_defaults(fn=cmd_check)
     return p
 
 

@@ -88,6 +88,7 @@ def device_put_batch(batch, placement=None):
                         return a
                 elif a.committed and a.devices() == {placement}:
                     return a
+            # graftcheck: disable=GC404 (placement probe over jax APIs that differ across supported jax versions; the fall-through device_put is the always-correct path)
             except Exception:
                 pass  # conservative: fall through to an explicit put
         return jax.device_put(a, placement)

@@ -134,5 +134,7 @@ class NativeDataSetIterator(DataSetIterator):
     def __del__(self):
         try:
             self.close()
-        except Exception:
+        except (OSError, AttributeError):
+            # interpreter teardown: the ctypes lib or attrs may already
+            # be gone — nothing to release at that point
             pass

@@ -143,6 +143,7 @@ def get_activation(name: str | Activation) -> Activation:
         base, _, arg = key.partition("(")
         if base in _PARAMETRIC:
             try:
+                # graftcheck: disable=GC101 (parses a STATIC activation-name string at trace time — never a traced value)
                 alpha = float(arg[:-1])
             except ValueError:
                 raise ValueError(

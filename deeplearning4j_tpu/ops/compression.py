@@ -60,6 +60,7 @@ DEFAULT_BUCKET_BYTES = 4 << 20
 
 def default_k_max(n: int) -> int:
     """Threshold-encoding message capacity for an n-element bucket."""
+    # graftcheck: disable=GC101 (n is a STATIC bucket size known at trace time, not a traced value)
     return 0 if n == 0 else max(1, int(n * THRESHOLD_DENSITY_CAP))
 
 

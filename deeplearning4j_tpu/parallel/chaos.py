@@ -387,8 +387,8 @@ class ChaosInjector:
         for h in logging.getLogger().handlers + logger.handlers:
             try:
                 h.flush()
-            except Exception:
-                pass
+            except (OSError, ValueError):
+                pass   # closed/broken stream — the process dies next line
         obs_trace.flush()
         os.kill(os.getpid(), sig)
         # SIGSTOP parks the process here until the launcher SIGKILLs (or

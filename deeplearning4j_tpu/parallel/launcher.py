@@ -606,7 +606,8 @@ class PodLauncher:
                  max_planned_leaves: int = 8,
                  straggler_factor: float = 2.0,
                  straggler_beats: int = 3,
-                 straggler_policy: str = "flag"):
+                 straggler_policy: str = "flag",
+                 clock: Callable[[], float] = time.time):
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")
         if bootstrap not in ("replica", "distributed"):
@@ -660,7 +661,12 @@ class PodLauncher:
         self.straggler_factor = straggler_factor
         self.straggler_beats = max(1, int(straggler_beats))
         self.straggler_policy = straggler_policy
-        self.membership = Membership(run_dir, heartbeat_timeout)
+        # one injectable wall clock shared with the membership ledger:
+        # launcher event times, notice deadlines and heartbeat staleness
+        # all read the SAME clock, and fake-clock tests can drive it
+        self.clock = clock
+        self.membership = Membership(run_dir, heartbeat_timeout,
+                                     clock=clock)
         self.handles = [_WorkerHandle(i) for i in range(num_workers)]
         self.events: List[dict] = []
         self._t0: Optional[float] = None
@@ -702,7 +708,7 @@ class PodLauncher:
     # -- env / spawn -------------------------------------------------------
 
     def _event(self, kind: str, worker: Optional[int] = None, **extra):
-        e = {"t": round(time.time() - (self._t0 or time.time()), 3),
+        e = {"t": round(self.clock() - (self._t0 or self.clock()), 3),
              "kind": kind}
         if worker is not None:
             e["worker"] = worker
@@ -810,7 +816,7 @@ class PodLauncher:
     # -- monitor -----------------------------------------------------------
 
     def _poll_once(self) -> None:
-        now = time.time()
+        now = self.clock()
         leaving = self.membership.leaving()
         for h in self.handles:
             if h.state != "running":
@@ -1001,7 +1007,7 @@ class PodLauncher:
         except OSError:
             return False
         if h.notice_t is None:
-            h.notice_t = time.time()
+            h.notice_t = self.clock()
             self._m_preempt_notices.inc()
             self._event("preempt_notice", process_id, source="launcher",
                         incarnation=h.incarnation)
@@ -1120,7 +1126,7 @@ class PodLauncher:
     def run(self) -> dict:
         """Launch the fleet, heal it until every worker completes (or its
         budget/deadline runs out), and return the run report."""
-        self._t0 = time.time()
+        self._t0 = self.clock()
         os.makedirs(self.run_dir, exist_ok=True)
         self._install_sigterm()
         for h in self.handles:
@@ -1136,7 +1142,7 @@ class PodLauncher:
                                 notified=self.preempt_all())
                 self.membership.refresh()
                 self._poll_once()
-                if time.time() - self._t0 > self.deadline_s:
+                if self.clock() - self._t0 > self.deadline_s:
                     deadline_hit = True
                     for h in self.handles:
                         if h.state == "running":
@@ -1177,7 +1183,7 @@ class PodLauncher:
             "last_checkpoint_step": self.membership.last_checkpoint_step(),
             "deadline_hit": deadline_hit,
             "leaked_killed": leaked,
-            "wall_seconds": round(time.time() - self._t0, 2),
+            "wall_seconds": round(self.clock() - self._t0, 2),
             "events": self.events,
         }
         report["ok"] = (not unrecovered and not deadline_hit

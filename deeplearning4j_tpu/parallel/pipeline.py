@@ -164,6 +164,7 @@ def _resolve_specs(mesh, stacked_params, x, axis, data_axis, x_spec,
         # GPipe bubble fraction is (stages-1)/(m+stages-1): shrinking m
         # degrades pipelining — at m=1 every stage but one idles.  Never
         # do this silently (a prime b_local collapses all the way to 1).
+        # graftcheck: disable=GC102 (shape-static degradation warning: firing ONCE at trace time is exactly the intended behavior)
         logger.warning(
             "n_microbatches=%d does not divide local batch %d — degraded to "
             "%d%s; pad the batch or pick a divisor to keep the pipeline full",

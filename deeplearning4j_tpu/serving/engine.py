@@ -54,7 +54,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -95,7 +95,7 @@ def _fail_safe(fut: Future, exc: BaseException) -> None:
     if not fut.done():
         try:
             fut.set_exception(exc)
-        except Exception:   # lost a completion race — already resolved
+        except InvalidStateError:   # lost a completion race — resolved
             pass
 
 
@@ -104,7 +104,7 @@ def _set_safe(fut: Future, value) -> bool:
         try:
             fut.set_result(value)
             return True
-        except Exception:
+        except InvalidStateError:   # lost a completion race — resolved
             pass
     return False
 
@@ -818,10 +818,14 @@ class Engine:
         try:
             with obs_trace.span("serve/respawn", cat="serve", replica=r.idx):
                 self._rewarm_replica(r.idx)   # cache-hit pass: 0 compiles
-        except Exception:
+        except Exception as e:
             # the replica will fail its next batch and re-enter the
-            # supervisor; the breaker bounds how often we retry
-            pass
+            # supervisor; the breaker bounds how often we retry — but a
+            # failed re-warm must be visible on the timeline, not silent
+            self.metrics.inc("respawn_failures")
+            obs_trace.instant("serve/respawn_failed", cat="serve",
+                              replica=r.idx,
+                              error=f"{type(e).__name__}: {e}")
         self.metrics.inc("replica_respawns")
         if batch:
             self._retry_or_fail(
@@ -915,6 +919,7 @@ class Engine:
         dict; usually driven via ``registry.set_alias(..., canary=)``."""
         if self._canary is not None:
             raise RuntimeError("a canary evaluation is already running")
+        # graftcheck: disable=GC201 (wall-anchor: human-facing default tag names WHEN the canary started; never feeds math or replay)
         nv = _ModelVersion(model, tag or f"canary@{time.time():.0f}",
                            self._devices)
         if self._loaded:
@@ -983,6 +988,7 @@ class Engine:
         requests keep their version; a batch never mixes two versions.
         Returns the retired version's tag (rollback = swap back, or an
         alias move in the registry)."""
+        # graftcheck: disable=GC201 (wall-anchor: human-facing default tag names WHEN the swap happened; never feeds math or replay)
         nv = _ModelVersion(model, tag or f"swap@{time.time():.0f}",
                            self._devices)
         if self._loaded:

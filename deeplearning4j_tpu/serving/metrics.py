@@ -85,6 +85,7 @@ _COUNTER_KEYS = (
     "requests", "rows", "batches", "padded_rows",
     "shed", "deadline_missed", "errors", "swaps", "unwarmed_serves",
     "replica_crashes", "replica_hangs", "replica_respawns",
+    "respawn_failures",
     "retries", "poison_isolated", "circuit_opens",
     "canary_promotions", "canary_rollbacks", "canary_mirrored_batches",
 )

@@ -58,5 +58,9 @@ def profile_trace(logdir: str, create_perfetto_link: bool = False) -> Iterator[N
                 import jax
 
                 jax.profiler.stop_trace()
-            except Exception:
-                pass
+            except Exception as e:
+                # a failed stop loses the on-chip trace: record it on
+                # the span timeline instead of dropping it silently
+                obs_trace.instant("profiler/stop_failed", cat="profiler",
+                                  logdir=logdir,
+                                  error=f"{type(e).__name__}: {e}")

@@ -46,14 +46,21 @@ class StatsListener(TrainingListener):
 
     ``update_frequency`` throttles collection (reference updateFrequency);
     histograms are optional (they dominate record size, as in DL4J).
+
+    ``clock`` is injectable (defaults to wall time): dashboard records
+    are deliberately wall-anchored — session ids, timestamps and
+    examples/sec all describe when training *actually* ran — but tests
+    (and deterministic replays that diff record streams) can pin it.
     """
 
     def __init__(self, storage, session_id: Optional[str] = None,
                  update_frequency: int = 1, collect_histograms: bool = True,
                  histogram_bins: int = 20, collect_memory: bool = True,
-                 collect_input_stats: bool = True):
+                 collect_input_stats: bool = True,
+                 clock=time.time):
         self.storage = storage
-        self.session_id = session_id or f"session_{int(time.time())}"
+        self.clock = clock
+        self.session_id = session_id or f"session_{int(self.clock())}"
         self.update_frequency = max(1, update_frequency)
         self.collect_histograms = collect_histograms
         self.histogram_bins = histogram_bins
@@ -61,7 +68,7 @@ class StatsListener(TrainingListener):
         self.collect_input_stats = collect_input_stats
         self._last_time: Optional[float] = None
         self._last_params: Optional[List[Dict[str, np.ndarray]]] = None
-        self._start_time = time.time()
+        self._start_time = self.clock()
 
     # -- helpers -----------------------------------------------------------
 
@@ -86,7 +93,8 @@ class StatsListener(TrainingListener):
             if stats:
                 return {"bytes_in_use": int(stats.get("bytes_in_use", 0)),
                         "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0))}
-        except Exception:
+        except (ImportError, IndexError, AttributeError, RuntimeError):
+            # no jax / no devices / backend without memory_stats (CPU)
             pass
         return {}
 
@@ -99,7 +107,7 @@ class StatsListener(TrainingListener):
     def iteration_done(self, model, iteration: int, loss: float) -> None:
         if iteration % self.update_frequency != 0:
             return
-        now = time.time()
+        now = self.clock()
         record: Dict[str, Any] = {
             "iteration": int(iteration),
             "timestamp": now,
@@ -154,6 +162,6 @@ class StatsListener(TrainingListener):
     def epoch_done(self, model, epoch: int) -> None:
         self.storage.put_update(self.session_id, {
             "iteration": int(getattr(model, "iteration", 0)),
-            "timestamp": time.time(),
+            "timestamp": self.clock(),
             "epoch_done": int(epoch),
         })

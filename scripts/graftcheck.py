@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""Thin runner for graftcheck, the repo-native static analyzer.
+
+Usage (from the repo root):
+
+    python scripts/graftcheck.py                       # whole package
+    python scripts/graftcheck.py --format=json         # machine output
+    python scripts/graftcheck.py path/to/file.py       # one file
+    python scripts/graftcheck.py --baseline-update \\
+        --justification "why these findings are accepted"
+
+Exit codes: 0 clean, 1 unsuppressed findings, 2 usage error (e.g.
+``--baseline-update`` without a justification — the runner REFUSES to
+grow the baseline without one).
+
+Equivalent surfaces: ``python -m deeplearning4j_tpu.analysis`` and
+``python -m deeplearning4j_tpu check``.  The tier-1 gate is
+``tests/test_static_analysis.py``; the bench trail records the
+zero-findings state per round via the ``static_analysis_clean`` config
+in bench.py.  Rule catalog: docs/STATIC_ANALYSIS.md.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu.analysis import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,12 @@
+"""GC101 negative: syncs in host code, statics in traced code."""
+import jax
+
+
+@jax.jit
+def step(x):
+    scale = float(2)        # not tainted: a literal
+    return x * scale
+
+
+def host_read(arr):
+    return float(arr.item())   # eager code may sync freely

@@ -1,0 +1,10 @@
+"""GC101 positive: host syncs on traced values inside traced code."""
+import jax
+
+
+@jax.jit
+def step(x):
+    v = x * 2
+    y = v.item()            # GC101: .item() in traced code
+    z = float(v)            # GC101: float() of tainted value
+    return y + z

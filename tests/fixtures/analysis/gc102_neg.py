@@ -1,0 +1,14 @@
+"""GC102 negative: sanctioned debug prints, effects in host code."""
+import time
+
+import jax
+
+
+@jax.jit
+def step(x):
+    jax.debug.print("x={x}", x=x)   # sanctioned in-trace print
+    return x * 2
+
+
+def host_log():
+    print("eager code may print", time.time())

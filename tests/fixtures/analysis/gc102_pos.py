@@ -1,0 +1,11 @@
+"""GC102 positive: host side effects inside traced code."""
+import time
+
+import jax
+
+
+@jax.jit
+def step(x):
+    print("step!")          # GC102: runs at trace time only
+    t = time.time()         # GC102: frozen into the program
+    return x + t

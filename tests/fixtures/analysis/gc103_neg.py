@@ -1,0 +1,11 @@
+"""GC103 negative: mutation on the host side of the boundary."""
+import jax
+
+
+class Model:
+    def build(self):
+        @jax.jit
+        def step(x):
+            return x * 2
+        self.step = step          # host method: mutation is fine
+        return step

@@ -1,0 +1,11 @@
+"""GC103 positive: self-mutation inside traced code."""
+import jax
+
+
+class Model:
+    def build(self):
+        @jax.jit
+        def step(x):
+            self.last_x = x       # GC103: trace-time host mutation
+            return x * 2
+        return step

@@ -1,0 +1,7 @@
+"""GC104 negative: jit hoisted out of the loop."""
+import jax
+
+
+def run_all(fn, xs):
+    jitted = jax.jit(fn)
+    return [jitted(x) for x in xs]

@@ -1,0 +1,10 @@
+"""GC104 positive: jax.jit constructed inside a loop body."""
+import jax
+
+
+def run_all(fns, x):
+    outs = []
+    for fn in fns:
+        jitted = jax.jit(fn)      # GC104: fresh callable per iteration
+        outs.append(jitted(x))
+    return outs

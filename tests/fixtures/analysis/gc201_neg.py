@@ -1,0 +1,12 @@
+"""GC201 negative: injectable clock; monotonic durations are fine."""
+import time
+
+
+class Trainer:
+    def __init__(self, clock=time.time):
+        self.clock = clock
+
+    def fit_batch(self, ds):
+        t0 = time.monotonic()
+        stamp = self.clock()
+        return stamp, time.monotonic() - t0

@@ -1,0 +1,14 @@
+"""GC201 positive: raw wall-clock reads, one on a step path."""
+import time
+
+
+def make_run_id():
+    return f"run_{int(time.time())}"      # GC201
+
+
+class Trainer:
+    def fit_batch(self, ds):
+        return self._stamp(ds)
+
+    def _stamp(self, ds):
+        return time.time()                # GC201, reachable from fit_batch

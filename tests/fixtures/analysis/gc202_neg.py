@@ -1,0 +1,11 @@
+"""GC202 negative: seeded, threaded generators."""
+import random
+
+import numpy as np
+
+
+def shuffle_batch(rows, seed):
+    rng = np.random.default_rng(seed)
+    rng.shuffle(rows)
+    jitter = random.Random(seed).random()
+    return rows, jitter

@@ -1,0 +1,11 @@
+"""GC202 positive: process-global RNG state."""
+import random
+
+import numpy as np
+
+
+def shuffle_batch(rows):
+    random.shuffle(rows)                  # GC202
+    noise = np.random.normal(size=3)      # GC202
+    rng = np.random.default_rng()         # GC202: unseeded
+    return rows, noise, rng

@@ -1,0 +1,15 @@
+"""GC203 negative: stable hashing, and __hash__ itself."""
+import hashlib
+
+
+def shard_for(key: str, n_shards: int) -> int:
+    digest = hashlib.sha256(key.encode()).digest()
+    return digest[0] % n_shards
+
+
+class Key:
+    def __init__(self, v):
+        self.v = v
+
+    def __hash__(self):
+        return hash(self.v)               # defining __hash__ is the point

@@ -1,0 +1,5 @@
+"""GC203 positive: PYTHONHASHSEED-dependent hash in a sharding key."""
+
+
+def shard_for(key: str, n_shards: int) -> int:
+    return hash(key) % n_shards           # GC203: varies per process

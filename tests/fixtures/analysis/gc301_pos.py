@@ -1,0 +1,20 @@
+"""GC301 positive: unlocked RMW of state shared across the thread
+boundary."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def _loop(self):
+        self.count += 1                   # GC301: unlocked RMW
+
+    def read(self):
+        return self.count

@@ -1,0 +1,16 @@
+"""GC302 negative: daemon thread, and a joined non-daemon thread."""
+import threading
+
+
+class Server:
+    def start(self):
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        self._worker = threading.Thread(target=self._serve)
+        self._worker.start()
+
+    def _serve(self):
+        pass
+
+    def stop(self):
+        self._worker.join()

@@ -1,0 +1,11 @@
+"""GC302 positive: non-daemon thread, no join anywhere."""
+import threading
+
+
+class Server:
+    def start(self):
+        self._thread = threading.Thread(target=self._serve)   # GC302
+        self._thread.start()
+
+    def _serve(self):
+        pass

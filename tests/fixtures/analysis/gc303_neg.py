@@ -1,0 +1,18 @@
+"""GC303 negative: one global acquisition order."""
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+
+    def a_then_b(self):
+        with self._lock_a:
+            with self._lock_b:
+                pass
+
+    def also_a_then_b(self):
+        with self._lock_a:
+            with self._lock_b:
+                pass

@@ -1,0 +1,18 @@
+"""GC303 positive: opposite lock nesting on two paths."""
+import threading
+
+
+class Transfer:
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+
+    def a_then_b(self):
+        with self._lock_a:
+            with self._lock_b:
+                pass
+
+    def b_then_a(self):
+        with self._lock_b:
+            with self._lock_a:            # GC303: cycle a->b->a
+                pass

@@ -1,0 +1,9 @@
+"""GC401 negative: names present in the taxonomy fixture (wildcards
+cover the f-string)."""
+from deeplearning4j_tpu.obs import trace as obs_trace
+
+
+def work(kind):
+    with obs_trace.span("app/step", cat="app"):
+        pass
+    obs_trace.instant(f"launcher/{kind}", cat="launcher")

@@ -1,0 +1,8 @@
+"""GC401 positive: span names missing from the taxonomy fixture."""
+from deeplearning4j_tpu.obs import trace as obs_trace
+
+
+def work(kind):
+    with obs_trace.span("app/unknown", cat="app"):        # GC401
+        pass
+    obs_trace.instant(f"bogus/{kind}", cat="app")         # GC401

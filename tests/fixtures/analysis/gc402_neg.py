@@ -1,0 +1,14 @@
+"""GC402 negative: compliant names; private registries keep legacy
+counter keys."""
+from deeplearning4j_tpu.obs.metrics import MetricsRegistry, get_registry
+
+
+class Engine:
+    def __init__(self):
+        self.registry = MetricsRegistry()
+        self.requests = self.registry.counter("requests")   # private: ok
+
+    def export(self):
+        reg = get_registry()
+        reg.counter("engine_restarts_total")
+        reg.histogram("forward_ms")

@@ -1,0 +1,10 @@
+"""GC402 positive: naming-convention violations."""
+from deeplearning4j_tpu.obs.metrics import get_registry
+
+
+def setup():
+    reg = get_registry()
+    a = reg.counter("myRetries")          # GC402: not snake_case
+    b = reg.counter("restart_events")     # GC402: global counter, no _total
+    c = reg.histogram("forward_latency")  # GC402: no unit suffix
+    return a, b, c

@@ -1,0 +1,18 @@
+"""GC403 negative: every path resolves or re-raises; the race-guard
+idiom is exempt."""
+
+
+def dispatch(batch, run):
+    try:
+        for req in batch:
+            req.future.set_result(run(req))
+    except Exception as e:
+        for req in batch:
+            fail_safe(req.future, e)      # resolves on the error path
+
+
+def fail_safe(fut, exc):
+    try:
+        fut.set_exception(exc)            # race-guard idiom: exempt
+    except Exception:
+        return

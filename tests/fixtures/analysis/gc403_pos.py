@@ -1,0 +1,13 @@
+"""GC403 positive: an exception path that can strand futures."""
+
+
+def dispatch(batch, run):
+    try:
+        for req in batch:
+            req.future.set_result(run(req))
+    except Exception:                     # GC403: mates stay pending
+        log_somewhere("batch failed")
+
+
+def log_somewhere(msg):
+    pass

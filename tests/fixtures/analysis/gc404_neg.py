@@ -1,0 +1,15 @@
+"""GC404 negative: narrow types may pass; broad handlers must act."""
+import logging
+
+
+def read_config(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except OSError:                       # narrow: intentional suppress
+        pass
+    try:
+        return path.default
+    except Exception as e:
+        logging.warning("config fallback: %s", e)
+        return None

@@ -1,0 +1,14 @@
+"""GC404 positive: silent broad swallows."""
+
+
+def read_config(path):
+    try:
+        with open(path) as f:
+            return f.read()
+    except Exception:                     # GC404
+        pass
+    try:
+        return path.default
+    except:                               # GC404: bare
+        pass
+    return None

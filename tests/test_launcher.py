@@ -592,3 +592,20 @@ class TestMultiprocSoak:
         assert out["leaked"] == 0 and out["off_leaked"] == 0
         assert out["writer_guard_ok"] and out["completion_steps_ok"]
         assert out["soak_ok"], out
+
+
+class TestInjectableLauncherClock:
+    def test_pod_launcher_shares_one_injected_clock(self, tmp_path):
+        """GC201 regression (graftcheck): launcher event times, notice
+        deadlines and heartbeat staleness all read ONE injectable clock
+        (shared with the Membership ledger) instead of raw time.time()."""
+        t = [5000.0]
+        launcher = PodLauncher(["true"], num_workers=1,
+                               run_dir=str(tmp_path),
+                               clock=lambda: t[0])
+        assert launcher.clock() == 5000.0
+        assert launcher.membership.clock is launcher.clock
+        launcher._t0 = launcher.clock()
+        t[0] = 5001.5
+        launcher._event("probe", 0)
+        assert launcher.events[-1]["t"] == 1.5

@@ -643,3 +643,71 @@ class TestServingChaosSoak:
         assert soak["canary_rollback_fired"] and soak["canary_promoted_good"]
         assert soak["respawn_zero_compiles"]
         assert soak["off_behavior_identical"]
+
+
+# ---------------------------------------------------------------------------
+# PR 10 (graftcheck) regressions
+# ---------------------------------------------------------------------------
+
+class TestRespawnFailureVisibility:
+    def test_failed_rewarm_is_counted_and_on_the_timeline(self):
+        """GC404 regression: a re-warm failure during replica recovery
+        used to vanish into `except Exception: pass` — it must now bump
+        respawn_failures and drop a serve/respawn_failed instant."""
+        from deeplearning4j_tpu import obs
+
+        eng = Engine(_mlp(), max_batch=4, replicas=1,
+                     supervise_interval_s=0.01).load()
+        try:
+            def boom(idx):
+                raise RuntimeError("warmup device lost")
+            eng._rewarm_replica = boom
+            rec = obs.enable_tracing()
+            try:
+                eng._recover_replica(eng._replicas[0], None,
+                                     ReplicaCrashError("injected"))
+            finally:
+                obs.disable_tracing()
+            snap = eng.metrics_snapshot()
+            assert snap["counters"]["respawn_failures"] == 1
+            assert snap["counters"]["replica_respawns"] == 1
+            names = [e["name"] for e in rec.events()]
+            assert "serve/respawn_failed" in names
+        finally:
+            eng.shutdown()
+
+    def test_respawn_failures_key_present_at_zero(self):
+        eng = Engine(_mlp(), max_batch=4, replicas=1).load()
+        try:
+            snap = eng.metrics_snapshot()
+            assert snap["counters"]["respawn_failures"] == 0
+        finally:
+            eng.shutdown()
+
+    def test_future_race_guard_is_narrow(self):
+        """The helpers must swallow ONLY the completion race
+        (InvalidStateError) — any other failure propagates."""
+        from concurrent.futures import Future
+
+        from deeplearning4j_tpu.serving.engine import _fail_safe, _set_safe
+
+        f = Future()
+        f.set_result(1)
+        _fail_safe(f, RuntimeError("late"))       # race: swallowed
+        assert _set_safe(f, 2) is False           # race: swallowed
+        assert f.result() == 1
+
+        class ExplodingFuture(Future):
+            def done(self):
+                return False
+
+            def set_result(self, v):
+                raise TypeError("not a race — must propagate")
+
+            def set_exception(self, e):
+                raise TypeError("not a race — must propagate")
+
+        with pytest.raises(TypeError):
+            _set_safe(ExplodingFuture(), 3)
+        with pytest.raises(TypeError):
+            _fail_safe(ExplodingFuture(), RuntimeError("x"))

@@ -170,3 +170,30 @@ class TestEmbeddingViewer:
         from deeplearning4j_tpu.ui import render_embedding_html
         with pytest.raises(ValueError, match="N,2"):
             render_embedding_html(np.zeros((5, 3)))
+
+
+class TestInjectableClock:
+    def test_stats_listener_records_ride_the_injected_clock(self):
+        """GC201 regression (graftcheck): dashboard timestamps are
+        wall-anchored by design, but the clock is injectable so record
+        streams can be made deterministic."""
+        ticks = iter(float(t) for t in range(1000, 1100))
+        storage = InMemoryStatsStorage()
+        lst = StatsListener(storage, clock=lambda: next(ticks),
+                            collect_histograms=False, collect_memory=False,
+                            collect_input_stats=False)
+        assert lst.session_id == "session_1000"
+        assert lst._start_time == 1001.0
+
+        class _M:
+            params = []
+
+            class conf:
+                layers = []
+        lst.iteration_done(_M(), 0, 0.5)
+        lst.iteration_done(_M(), 1, 0.4)
+        recs = storage.get_updates(lst.session_id)
+        assert [r["timestamp"] for r in recs] == [1002.0, 1003.0]
+        assert recs[1]["relative_time"] == 1003.0 - 1001.0
+        # examples/sec derives from the same clock: dt is exactly 1s
+        assert recs[1]["iterations_per_sec"] == pytest.approx(1.0)
