@@ -1351,6 +1351,146 @@ def bench_static_analysis():
             "suppressed": report["summary"]["suppressed"]}
 
 
+def _kernel_ab(script: str, probe_program: Optional[str] = None) -> dict:
+    """Run one kernel A/B script (and optionally the structural HLO
+    probe for its pallas program) and return the parsed JSON line(s)."""
+    import subprocess
+    import sys
+
+    cmd = [sys.executable, os.path.join(_REPO, "scripts", script)]
+    if QUICK:
+        cmd.append("--quick")
+    p = subprocess.run(cmd, capture_output=True, text=True, timeout=1800,
+                       cwd=_REPO)
+    if p.returncode != 0:
+        raise RuntimeError(f"{script} failed (rc={p.returncode}): "
+                           f"{p.stderr[-1500:]}")
+    ab = json.loads(p.stdout.strip().splitlines()[-1])
+    if probe_program is not None:
+        probe = os.path.join(_REPO, "scripts", "ab_hlo_probe.py")
+        q = subprocess.run([sys.executable, probe, _REPO, "bench",
+                            probe_program],
+                           capture_output=True, text=True, timeout=600,
+                           cwd=_REPO)
+        if q.returncode != 0:
+            raise RuntimeError(
+                f"structural probe {probe_program} FAILED: "
+                f"{q.stdout.strip().splitlines()[-1:] or q.stderr[-800:]}")
+        ab["structure"] = json.loads(q.stdout.strip().splitlines()[-1])
+    return ab
+
+
+def bench_fused_update_ab():
+    """Config 19: the fused-update and one-pass-encode kernel A/Bs
+    (scripts/fused_update_ab.py + scripts/one_pass_encode_ab.py,
+    interpret-mode pallas arms on CPU).  HARD gates on EVERY platform —
+    the correctness contract the kernels ride on:
+
+      * fused update parity vs the per-leaf plain path: moments within
+        2 ulp (one contractible FMA each; measured 0), params within
+        1e-8 ABSOLUTE (the step's few-ulp FMA jitter at lr scale —
+        measured ~1e-9; a ulp gate on the subtracted param output would
+        reject bit-equivalent math wherever p - step cancels);
+      * one-pass encode decode round-trips BIT-identical to the top_k
+        path, with the selection sets equal;
+      * structural landing (ab_hlo_probe): exactly one pallas_call per
+        program, no stray transposes/convert pairs, no sort outside the
+        encode's overflow branch.
+
+    The SPEED gate (>=1.05x on the gated metric) binds on TPU only —
+    interpret-mode pallas and XLA:CPU's scatter/top_k costs make CPU
+    arm times meaningless for the TPU decision, and both kernels stay
+    opt-in (DL4J_TPU_FUSED_UPDATE / DL4J_TPU_FUSED_ENCODE) until a TPU
+    round accepts them; the CPU numbers are still recorded, honestly
+    labeled, as the protocol artifact."""
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+
+    upd = _kernel_ab("fused_update_ab.py", probe_program="fused_update")
+    for k in ("parity_moments_max_ulp_jnp", "parity_moments_max_ulp_pallas"):
+        if upd[k] > 2:
+            raise RuntimeError(f"fused update moment-parity gate FAILED: "
+                               f"{k}={upd[k]} ulp (allow <= 2): {upd}")
+    for k in ("parity_params_max_abs_jnp", "parity_params_max_abs_pallas"):
+        if upd[k] > 1e-8:
+            raise RuntimeError(f"fused update param-parity gate FAILED: "
+                               f"{k}={upd[k]} (allow <= 1e-8): {upd}")
+    if on_tpu and upd["speedup_fused_pallas"] < 1.05:
+        raise RuntimeError("fused update TPU speed gate FAILED "
+                           f"(need >=1.05x): {upd}")
+
+    enc = _kernel_ab("one_pass_encode_ab.py", probe_program="one_pass_encode")
+    if not (enc["roundtrip_bitwise_streaming"]
+            and enc["roundtrip_bitwise_pallas"]
+            and enc["selection_set_equal"]):
+        raise RuntimeError(f"one-pass encode round-trip gate FAILED: {enc}")
+    if on_tpu and enc["speedup_pallas"] < 1.05:
+        raise RuntimeError("one-pass encode TPU speed gate FAILED "
+                           f"(need >=1.05x): {enc}")
+
+    return [{"metric": "fused_update_speedup",
+             "value": upd["speedup_fused_pallas"],
+             "unit": "x vs per-leaf (CPU-interpret arm)" if not on_tpu
+                     else "x vs per-leaf",
+             "plain_ms": upd["plain_ms"], "fused_jnp_ms": upd["fused_jnp_ms"],
+             "fused_pallas_ms": upd["fused_pallas_ms"],
+             "speedup_fused_jnp": upd["speedup_fused_jnp"],
+             "parity_moments_max_ulp": max(
+                 upd["parity_moments_max_ulp_jnp"],
+                 upd["parity_moments_max_ulp_pallas"]),
+             "parity_params_max_abs": max(
+                 upd["parity_params_max_abs_jnp"],
+                 upd["parity_params_max_abs_pallas"]),
+             "n_params": upd["n_params"], "structure_ok": True,
+             "platform": upd["platform"]},
+            {"metric": "one_pass_encode_speedup",
+             "value": enc["speedup_pallas"],
+             "unit": "x vs top_k (CPU-interpret arm)" if not on_tpu
+                     else "x vs top_k",
+             "topk_ms": enc["topk_ms"], "streaming_ms": enc["streaming_ms"],
+             "pallas_ms": enc["pallas_ms"],
+             "speedup_streaming": enc["speedup_streaming"],
+             "roundtrip_bitwise": True, "n": enc["n"], "k": enc["k"],
+             "structure_ok": True, "platform": enc["platform"]}]
+
+
+def bench_quantized_serving_ab():
+    """Config 20: int8 quantized serving A/B
+    (scripts/quantized_serving_ab.py — the raw jitted forward, f32 vs
+    calibrated int8, interleaved windows).  HARD gates on EVERY
+    platform — the numerics envelope that makes the fast path safe to
+    offer at all: top-1 agreement >= 0.98 and max relative logit
+    divergence <= 0.05 between the arms on identical inputs.  The
+    SPEED gate (int8 >= 1.2x f32) binds on TPU only: XLA:CPU has no
+    int8 matmul fast path (it widens to i32 scalar loops), so the CPU
+    ratio measures the wrong backend; the serving contract itself
+    (zero serve-time compiles under Engine.load(quantize="int8")) is
+    enforced in tier-1 (tests/test_quantize.py)."""
+    import jax
+
+    on_tpu = jax.devices()[0].platform == "tpu"
+    ab = _kernel_ab("quantized_serving_ab.py")
+    if ab["top1_agree"] < 0.98:
+        raise RuntimeError("int8 top-1 agreement gate FAILED "
+                           f"(need >=0.98): {ab}")
+    if ab["max_rel_logit_diff"] > 0.05:
+        raise RuntimeError("int8 logit-divergence gate FAILED "
+                           f"(need <=0.05): {ab}")
+    if on_tpu and ab["speedup_int8"] < 1.2:
+        raise RuntimeError("int8 TPU speed gate FAILED (need >=1.2x): "
+                           f"{ab}")
+    return {"metric": "quantized_serving_speedup",
+            "value": ab["speedup_int8"],
+            "unit": "x vs f32 (CPU arm)" if not on_tpu else "x vs f32",
+            "f32_ms": ab["f32_ms"], "int8_ms": ab["int8_ms"],
+            "f32_qps": ab["f32_qps"], "int8_qps": ab["int8_qps"],
+            "top1_agree": ab["top1_agree"],
+            "max_rel_logit_diff": ab["max_rel_logit_diff"],
+            "batch": ab["batch"], "hidden": ab["hidden"],
+            "platform": ab["platform"]}
+
+
 def main() -> None:
     import jax
 
@@ -1376,7 +1516,9 @@ def main() -> None:
                      ("serving_chaos_recovery", bench_serving_chaos),
                      ("input_pipeline_overlap", bench_input_pipeline),
                      ("telemetry_overhead", bench_telemetry_overhead),
-                     ("static_analysis_clean", bench_static_analysis)]:
+                     ("static_analysis_clean", bench_static_analysis),
+                     ("fused_update_ab", bench_fused_update_ab),
+                     ("quantized_serving_ab", bench_quantized_serving_ab)]:
         try:
             t0 = time.perf_counter()
             out = fn()

@@ -20,7 +20,8 @@ from .googlenet import GoogLeNet
 from .inception_resnet_v1 import InceptionResNetV1
 from .facenet_nn4 import FaceNetNN4Small2
 from .pretrained import (
-    PretrainedType, cached_path, checksum, init_pretrained, install_weights,
+    PretrainedType, cached_path, checksum, init_pretrained,
+    init_pretrained_int8, install_weights,
 )
 
 ZOO = {

@@ -120,3 +120,29 @@ def init_pretrained(model_name: str,
                 f"checksum mismatch for {path}: expected {expected_checksum}, "
                 f"got {got}" + ("" if local_file else " (cached copy evicted)"))
     return load_model(path)
+
+
+def init_pretrained_int8(model_name: str,
+                         pretrained_type: str = PretrainedType.IMAGENET,
+                         calibration_inputs=None,
+                         expected_checksum=None,
+                         cache_dir=None, local_file=None):
+    """The zoo's int8 serving entry: ``init_pretrained`` + the
+    calibration sweep + per-channel weight quantization in one step
+    (ops/quantize.py).  ``calibration_inputs`` is an array or list of
+    arrays of REPRESENTATIVE per-example inputs (leading batch axis) —
+    activation scales are only as good as the sweep; there is no
+    synthetic default here because zoo models ship with known input
+    distributions and the caller has them.  Returns a ``QuantizedModel``
+    ready for ``serving.Engine`` (already quantized — load() without
+    ``quantize=``)."""
+    from ..ops.quantize import quantize_model
+
+    if calibration_inputs is None:
+        raise ValueError(
+            "init_pretrained_int8 needs calibration_inputs — a batch (or "
+            "list of batches) of representative per-example inputs")
+    net = init_pretrained(model_name, pretrained_type,
+                          expected_checksum=expected_checksum,
+                          cache_dir=cache_dir, local_file=local_file)
+    return quantize_model(net, calibration_inputs)

@@ -674,10 +674,10 @@ class ComputationGraph:
                 g = normalize_gradients(g, conf.gradient_normalization,
                                         conf.gradient_normalization_threshold)
             upd = self._updater_for(spec.vertex.layer)
-            updates, os2 = upd.update(g, opt_state[name], itf)
-            new_params[name] = jax.tree_util.tree_map(
-                lambda pp, uu: (pp.astype(jnp.float32) - uu).astype(pp.dtype),
-                params[name], updates)
+            # apply = updater math + param step; Adam/Nadam route through
+            # the fused one-pass kernel (ops/update_kernel.py) when enabled
+            new_params[name], os2 = upd.apply(params[name], g,
+                                              opt_state[name], itf)
             if spec.vertex.layer.constraints:
                 new_params[name] = apply_constraints(
                     spec.vertex.layer.constraints, new_params[name])
@@ -905,10 +905,7 @@ class ComputationGraph:
                 g = normalize_gradients(
                     g, self.conf.gradient_normalization,
                     self.conf.gradient_normalization_threshold)
-            updates, opt2 = updater.update(g, opt_v, it)
-            p2 = jax.tree_util.tree_map(
-                lambda pp, uu: (pp.astype(jnp.float32) - uu).astype(pp.dtype),
-                params[name], updates)
+            p2, opt2 = updater.apply(params[name], g, opt_v, it)
             if layer.constraints:
                 p2 = apply_constraints(layer.constraints, p2)
             return p2, opt2, loss

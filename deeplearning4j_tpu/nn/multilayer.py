@@ -394,9 +394,9 @@ class MultiLayerNetwork:
                 g = normalize_gradients(g, conf.gradient_normalization,
                                         conf.gradient_normalization_threshold)
             # L2/L1 gradient contribution comes via autodiff of the reg score.
-            updates, os2 = self._updater_for(layer).update(g, os, itf)
-            p2 = jax.tree_util.tree_map(
-                lambda pp, uu: (pp.astype(jnp.float32) - uu).astype(pp.dtype), p, updates)
+            # apply = updater math + param step; Adam/Nadam route through
+            # the fused one-pass kernel (ops/update_kernel.py) when enabled
+            p2, os2 = self._updater_for(layer).apply(p, g, os, itf)
             if layer.constraints:
                 p2 = apply_constraints(layer.constraints, p2)
             new_params.append(p2)
@@ -669,10 +669,7 @@ class MultiLayerNetwork:
                 g = normalize_gradients(
                     g, self.conf.gradient_normalization,
                     self.conf.gradient_normalization_threshold)
-            updates, opt2 = updater.update(g, opt_i, it)
-            p2 = jax.tree_util.tree_map(
-                lambda pp, uu: (pp.astype(jnp.float32) - uu).astype(pp.dtype),
-                params[i], updates)
+            p2, opt2 = updater.apply(params[i], g, opt_i, it)
             if layer.constraints:
                 p2 = apply_constraints(layer.constraints, p2)
             return p2, opt2, loss

@@ -139,6 +139,18 @@ class Updater:
     def update(self, grads, state, it: Array):
         raise NotImplementedError
 
+    def apply(self, params, grads, state, it: Array):
+        """One full optimizer application: updater math + the param step
+        (``params -= updates`` in f32, cast back to each leaf's dtype) —
+        what nn/multilayer._apply_updates runs per layer.  Subclasses
+        with a fused one-pass kernel (ops/update_kernel.py) override
+        this; the base implementation is the bit-exact reference."""
+        updates, new_state = self.update(grads, state, it)
+        new_params = jax.tree_util.tree_map(
+            lambda pp, uu: (pp.astype(jnp.float32) - uu).astype(pp.dtype),
+            params, updates)
+        return new_params, new_state
+
 
 @register_config
 @dataclasses.dataclass
@@ -215,6 +227,23 @@ class Adam(Updater):
 
         updates, new_m, new_v = _tree_update(upd, grads, state["m"], state["v"])
         return updates, {"m": new_m, "v": new_v}
+
+    def apply(self, params, grads, state, it):
+        """Routes through the fused one-pass kernel (moment update +
+        param step in one VMEM pass over flat bucketed buffers,
+        ops/update_kernel.py) when it is enabled and applicable; the
+        kernel's output is bit-identical to the per-leaf base path, which
+        remains the fallback.  Exact Adam/Nadam only — AdaMax/AMSGrad
+        subclasses carry different math and always take the base path."""
+        from ..ops import update_kernel
+
+        kind = update_kernel.kind_of(self)
+        if kind is not None:
+            fused = update_kernel.fused_apply(
+                kind, self, params, grads, state, it)
+            if fused is not None:
+                return fused
+        return super().apply(params, grads, state, it)
 
 
 @register_config

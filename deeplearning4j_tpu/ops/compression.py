@@ -36,7 +36,9 @@ gradient's actual sparsity — the property the bench gate asserts.
 
 from __future__ import annotations
 
+import functools
 import math
+import os
 from typing import List, Optional
 
 import jax
@@ -44,6 +46,23 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..utils.jax_compat import axis_size
+
+#: opt-in one-pass fixed-threshold encode (sort-free select+pack; see
+#: the "one-pass threshold encode" section).  Read once at import, like
+#: ops/update_kernel.ENABLED — checked at TRACE time.
+FUSED_ENCODE = os.environ.get("DL4J_TPU_FUSED_ENCODE", "0") == "1"
+#: route the one-pass encode through the pallas kernel instead of the
+#: fused-jnp streaming pass (the kernel is the TPU seam; streaming jnp
+#: is the arm the CPU A/B measures)
+FUSED_ENCODE_PALLAS = os.environ.get(
+    "DL4J_TPU_FUSED_ENCODE_PALLAS", "0") == "1"
+
+try:
+    from jax.experimental import pallas as pl
+
+    _HAS_PALLAS = True
+except Exception:  # pragma: no cover
+    _HAS_PALLAS = False
 
 METHODS = ("threshold", "bitmap")
 #: reference EncodingHandler default threshold (fixed-threshold mode)
@@ -62,6 +81,100 @@ def default_k_max(n: int) -> int:
     """Threshold-encoding message capacity for an n-element bucket."""
     # graftcheck: disable=GC101 (n is a STATIC bucket size known at trace time, not a traced value)
     return 0 if n == 0 else max(1, int(n * THRESHOLD_DENSITY_CAP))
+
+
+# ---------------------------------------------------------------------------
+# one-pass threshold encode (sort-free select + signed-index pack)
+# ---------------------------------------------------------------------------
+#
+# Fixed-threshold mode does not need top_k's O(n log n) sort at all: the
+# selection predicate (|g| >= t) is local, so each selected element's
+# output slot is just the running count of selected elements before it —
+# a cumsum — and the pack is one scatter.  The encoded SET is identical
+# to the top_k path whenever at most k elements clear the threshold;
+# entry ORDER differs (index-ascending vs magnitude-descending), which
+# threshold_decode's scatter-add never observes — decode round-trips are
+# bit-identical (every dense index receives the same +-scale entries,
+# and partial sums of m·t are exact for integral m).  Overflow (> k
+# selected) lax.cond's into the exact top_k path, keeping its
+# largest-first selection.  Adaptive mode (threshold=None) genuinely
+# needs the k-th order statistic and always uses top_k.
+
+_ENC_LANES = 128
+#: pallas variant: single-block kernel, so cap the VMEM footprint
+_ENC_PALLAS_MAX_BYTES = 8 << 20
+
+
+def _topk_pack(g, mag, k: int, threshold):
+    """The reference-exact fixed-mode pack: top_k over the masked
+    magnitudes (largest-first selection under overflow)."""
+    vals, idx = jax.lax.top_k(jnp.where(mag >= threshold, mag, 0.0), k)
+    valid = vals > 0.0
+    sign = jnp.where(g[idx] >= 0, 1, -1).astype(jnp.int32)
+    return jnp.where(valid, sign * (idx + 1), 0).astype(jnp.int32)
+
+
+def _streaming_pack(g, mag, k: int, threshold: float, n: int):
+    """One fused pass: slot = exclusive running count of selections.
+    Precondition (caller's lax.cond): at most k elements clear t."""
+    sel = mag >= threshold
+    pos = jnp.cumsum(sel.astype(jnp.int32)) - 1
+    payload = (jnp.where(g >= 0, 1, -1).astype(jnp.int32)
+               * (jnp.arange(n, dtype=jnp.int32) + 1))
+    slot = jnp.where(sel & (pos < k), pos, k)
+    return jnp.zeros((k,), jnp.int32).at[slot].set(payload, mode="drop")
+
+
+def _encode_kernel(g_ref, o_ref, *, k: int, k_pad: int, threshold: float,
+                   n: int):
+    g = g_ref[...].reshape(-1)          # row-major == original order
+    mag = jnp.abs(g)
+    idx = jax.lax.iota(jnp.int32, g.shape[0])
+    sel = (mag >= threshold) & (idx < n)   # zero padding never selects
+    pos = jnp.cumsum(sel.astype(jnp.int32)) - 1
+    payload = jnp.where(g >= 0, 1, -1).astype(jnp.int32) * (idx + 1)
+    slot = jnp.where(sel & (pos < k), pos, k_pad)
+    out = jnp.zeros((k_pad,), jnp.int32).at[slot].set(payload, mode="drop")
+    o_ref[...] = out.reshape(-1, _ENC_LANES)
+
+
+def _pallas_pack(g, k: int, threshold: float, n: int):
+    """Select+pack as ONE pallas pass over the whole (VMEM-resident)
+    bucket; interpret-mode on CPU.  Caller guarantees the size gate."""
+    pad = (-n) % (8 * _ENC_LANES)
+    rows = (n + pad) // _ENC_LANES
+    k_pad = k + ((-k) % _ENC_LANES)
+    out = pl.pallas_call(
+        functools.partial(_encode_kernel, k=k, k_pad=k_pad,
+                          threshold=threshold, n=n),
+        out_shape=jax.ShapeDtypeStruct((k_pad // _ENC_LANES, _ENC_LANES),
+                                       jnp.int32),
+        interpret=(jax.default_backend() == "cpu"),
+    )(jnp.pad(g, (0, pad)).reshape(rows, _ENC_LANES))
+    return out.reshape(-1)[:k]
+
+
+def _pallas_encode_ok(n: int) -> bool:
+    return (_HAS_PALLAS
+            and jax.default_backend() in ("tpu", "cpu")
+            and n >= 8 * _ENC_LANES
+            and 4 * n <= _ENC_PALLAS_MAX_BYTES)
+
+
+def _one_pass_threshold_encode(g, mag, k: int, threshold: float, n: int):
+    """enc int32[k] via the sort-free path, falling back to the exact
+    top_k pack inside lax.cond when more than k elements clear t."""
+    count = jnp.sum((mag >= threshold).astype(jnp.int32))
+
+    def fits(_):
+        if FUSED_ENCODE_PALLAS and _pallas_encode_ok(n):
+            return _pallas_pack(g, k, threshold, n)
+        return _streaming_pack(g, mag, k, threshold, n)
+
+    def overflow(_):
+        return _topk_pack(g, mag, k, threshold)
+
+    return jax.lax.cond(count <= k, fits, overflow, None)
 
 
 # ---------------------------------------------------------------------------
@@ -96,9 +209,14 @@ def threshold_encode(g, k_max: int, threshold: Optional[float] = None):
     else:
         if threshold <= 0:
             raise ValueError(f"threshold must be > 0, got {threshold}")
-        vals, idx = jax.lax.top_k(jnp.where(mag >= threshold, mag, 0.0), k)
-        valid = vals > 0.0
         scale = jnp.asarray(threshold, jnp.float32)
+        # one-pass path needs a static threshold (it is baked into the
+        # kernel); a traced threshold stays on the top_k path
+        if FUSED_ENCODE and isinstance(threshold, (int, float)):
+            # graftcheck: disable=GC101 (the isinstance guard above makes threshold a STATIC Python number here — a traced threshold takes the top_k branch)
+            enc = _one_pass_threshold_encode(g, mag, k, float(threshold), n)
+            return enc, scale
+        return _topk_pack(g, mag, k, threshold), scale
     sign = jnp.where(g[idx] >= 0, 1, -1).astype(jnp.int32)
     enc = jnp.where(valid, sign * (idx + 1), 0).astype(jnp.int32)
     return enc, scale.astype(jnp.float32)

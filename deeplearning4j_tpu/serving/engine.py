@@ -328,12 +328,23 @@ class Engine:
             return None
 
     def load(self, input_shape: Optional[Sequence[int]] = None,
-             dtypes: Sequence[str] = ("float32",)) -> "Engine":
+             dtypes: Sequence[str] = ("float32",),
+             quantize: Optional[str] = None,
+             calibration_inputs=None) -> "Engine":
         """AOT warmup: compile every (bucket, dtype) pair on every
         replica so no user request pays a compile.  ``input_shape`` is
         the per-example shape; inferred from the model's configured
         InputType when omitted.  Warmup timings seed the batcher's
-        per-bucket exec EMA (the deadline-slack close)."""
+        per-bucket exec EMA (the deadline-slack close).
+
+        ``quantize="int8"`` serves the int8 fast path (ops/quantize.py):
+        the current version's Dense-style matmul weights are quantized
+        per-output-channel with activation scales calibrated on
+        ``calibration_inputs`` (an array or list of arrays of
+        representative per-example inputs; a fixed-seed synthetic batch
+        when omitted — pass real inputs for production envelopes), and
+        warmup compiles the QUANTIZED executables, so the
+        zero-serve-time-compiles contract covers the int8 path too."""
         shape = tuple(input_shape) if input_shape is not None else (
             self._infer_example_shape())
         if shape is None:
@@ -342,6 +353,19 @@ class Engine:
                 "configuration — pass input_shape=(...) explicitly")
         self._example_shape = shape
         self._warm_dtypes = tuple(dtypes)
+        if quantize is not None:
+            if quantize != "int8":
+                raise ValueError(
+                    f"unsupported quantize mode {quantize!r}; only 'int8'")
+            from ..ops.quantize import quantize_model
+            if calibration_inputs is None:
+                rng = np.random.default_rng(0)
+                calibration_inputs = rng.standard_normal(
+                    (max(self.batcher.buckets),) + shape).astype(np.float32)
+            qm = quantize_model(self._current.model, calibration_inputs)
+            with self._vlock:
+                self._current = _ModelVersion(
+                    qm, self._current.tag + "+int8", self._devices)
         self._warm_version(self._current)
         self._loaded = True
         return self

@@ -1,6 +1,29 @@
-"""Dump compiled-step diagnostics for the LeNet bench config: cost
-analysis (flops/bytes), memory analysis, and an HLO op histogram.
-Usage: python hlo_probe.py <tree> <tag>
+"""Dump compiled-step diagnostics: cost analysis (flops/bytes), memory
+analysis, and an HLO op histogram — plus STRUCTURAL assertions for the
+pallas kernel programs.
+
+Usage: python hlo_probe.py <tree> <tag> [program]
+
+Programs:
+  lenet (default)   the LeNet bench step (histogram only, no assertions)
+  fused_update      the fused Adam update (ops/update_kernel.py)
+  one_pass_encode   the one-pass threshold encode (ops/compression.py)
+
+For the two pallas programs the probe asserts the landing actually
+happened structurally — the failure mode being a silently-fallen-back
+kernel that still passes parity tests:
+
+  * exactly ONE pallas_call equation in the traced jaxpr (recursively,
+    including lax.cond branches — interpret-mode lowering erases the op
+    from compiled CPU HLO, so the jaxpr is where the claim is checkable
+    on every backend);
+  * the pallas branch contains no sort (the whole point is removing it —
+    for the encode, sort may appear ONLY in the cond's overflow branch);
+  * no transpose equations and no stray convert PAIRS (a convert whose
+    input is itself a convert — a round trip the flat f32 layout should
+    never need).
+
+Exit code 1 with a clear message when a structural assertion fails.
 """
 import collections
 import json
@@ -8,11 +31,129 @@ import re
 import sys
 
 tree, tag = sys.argv[1], sys.argv[2]
+program = sys.argv[3] if len(sys.argv) > 3 else "lenet"
 sys.path.insert(0, tree)
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 import jax.random as jrandom
+
+
+def _sub_jaxprs(eqn):
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (list, tuple)) else [v]
+        for item in vals:
+            if hasattr(item, "jaxpr"):      # ClosedJaxpr
+                yield item.jaxpr
+            elif hasattr(item, "eqns"):     # raw Jaxpr
+                yield item
+
+
+def count_primitive(jaxpr, name: str) -> int:
+    total = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == name:
+            total += 1
+        for sub in _sub_jaxprs(eqn):
+            total += count_primitive(sub, name)
+    return total
+
+
+def convert_pairs(jaxpr) -> int:
+    """Stray convert chains: convert eqns whose input is itself produced
+    by a convert (recursively per sub-jaxpr scope)."""
+    producer = {}
+    pairs = 0
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "convert_element_type":
+            src = eqn.invars[0]
+            if producer.get(id(src)) == "convert_element_type":
+                pairs += 1
+        for out in eqn.outvars:
+            producer[id(out)] = eqn.primitive.name
+        for sub in _sub_jaxprs(eqn):
+            pairs += convert_pairs(sub)
+    return pairs
+
+
+def assert_pallas_structure(jaxpr, out: dict, allow_sort_in_overflow: bool):
+    out["pallas_calls"] = count_primitive(jaxpr, "pallas_call")
+    out["transposes_jaxpr"] = count_primitive(jaxpr, "transpose")
+    out["convert_pairs"] = convert_pairs(jaxpr)
+    # top_k is the sort-backed selection this work removes; count both
+    # the generic sort and the top_k primitive
+    out["sorts"] = (count_primitive(jaxpr, "sort")
+                    + count_primitive(jaxpr, "top_k"))
+    errs = []
+    if out["pallas_calls"] != 1:
+        errs.append(f"expected exactly 1 pallas_call, found "
+                    f"{out['pallas_calls']}")
+    if out["transposes_jaxpr"]:
+        errs.append(f"{out['transposes_jaxpr']} stray transpose(s)")
+    if out["convert_pairs"]:
+        errs.append(f"{out['convert_pairs']} stray convert pair(s)")
+    if out["sorts"] and not allow_sort_in_overflow:
+        errs.append(f"{out['sorts']} sort(s) in a sort-free program")
+    if allow_sort_in_overflow and out["sorts"]:
+        # the sort may live ONLY in the cond's overflow branch, never
+        # alongside the pallas_call
+        for eqn in jaxpr.eqns:
+            if eqn.primitive.name != "cond":
+                continue
+            for sub in _sub_jaxprs(eqn):
+                if (count_primitive(sub, "pallas_call")
+                        and (count_primitive(sub, "sort")
+                             + count_primitive(sub, "top_k"))):
+                    errs.append("sort found in the PALLAS branch of cond")
+    if errs:
+        print(json.dumps({"tag": tag, "program": program,
+                          "structure_ok": False, "errors": errs, **out}))
+        raise SystemExit(1)
+    out["structure_ok"] = True
+
+
+if program == "fused_update":
+    from deeplearning4j_tpu.nn.updaters import Adam
+    from deeplearning4j_tpu.ops import update_kernel
+
+    update_kernel.ENABLED = True
+    update_kernel.FORCE_JNP = False
+    rng = np.random.default_rng(0)
+    params = {f"l{i}": {"W": jnp.asarray(rng.normal(size=(256, 256)),
+                                         jnp.float32)}
+              for i in range(4)}
+    upd = Adam(lr=1e-3)
+    state = {"m": jax.tree_util.tree_map(jnp.zeros_like, params),
+             "v": jax.tree_util.tree_map(jnp.zeros_like, params)}
+    it = jnp.asarray(0.0, jnp.float32)
+
+    def fn(p, g, s, i):
+        return update_kernel.fused_apply("adam", upd, p, g, s, i)
+
+    jaxpr = jax.make_jaxpr(fn)(params, params, state, it).jaxpr
+    out = {"tag": tag, "program": program}
+    assert_pallas_structure(jaxpr, out, allow_sort_in_overflow=False)
+    print(json.dumps(out))
+    raise SystemExit(0)
+
+if program == "one_pass_encode":
+    from deeplearning4j_tpu.ops import compression
+
+    compression.FUSED_ENCODE = True
+    compression.FUSED_ENCODE_PALLAS = True
+    n = 1 << 17
+    k = compression.default_k_max(n)
+    g = jnp.zeros((n,), jnp.float32)
+
+    def fn(gg):
+        return compression.threshold_encode(gg, k, threshold=1e-3)
+
+    jaxpr = jax.make_jaxpr(fn)(g).jaxpr
+    out = {"tag": tag, "program": program}
+    assert_pallas_structure(jaxpr, out, allow_sort_in_overflow=True)
+    print(json.dumps(out))
+    raise SystemExit(0)
 
 from deeplearning4j_tpu.models import LeNet
 from deeplearning4j_tpu.nn.updaters import Nesterovs

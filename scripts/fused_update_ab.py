@@ -1,0 +1,140 @@
+"""Interleaved A/B for the fused optimizer update (ops/update_kernel.py).
+
+Arms (identical timing protocol, alternating windows so tenancy drift
+hits all arms equally — scripts/ab_probe.py's discipline):
+
+  plain         per-leaf Adam.update + f32 param subtract (the baseline
+                nn/updaters path)
+  fused_jnp     one flat-bucketed pass, plain jnp (DL4J_TPU_FUSED_UPDATE_JNP
+                arm — isolates the flat-bucketing win from the kernel)
+  fused_pallas  the pallas kernel (compiled on TPU; INTERPRET mode on CPU,
+                where its absolute time is meaningless — the CPU-visible
+                signal is fused_jnp vs plain + the parity fields)
+
+The workload is the fused kernel's target case: MANY small leaves (48
+layers), where the per-leaf path pays per-op dispatch and HBM round
+trips per leaf.  Parity against the plain arm is measured two ways,
+matching how FMA-contraction jitter actually propagates:
+
+  * moments (m, v): max ULP distance — one contractible FMA each, so
+    the honest bound is tight (<= 1 ulp; measured 0 at this size);
+  * params: max ABSOLUTE difference — the step's few-ulp relative
+    jitter becomes a ~1e-9 absolute wobble at lr=1e-3 scale, and where
+    ``p - step`` cancels to ~1e-7 that same wobble is hundreds of ulp
+    of the tiny result, so a ulp gate on the subtracted output would
+    reject bit-level-equivalent math.
+
+Prints one JSON line; --quick shrinks sizes for CPU/BENCH_QUICK runs.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from deeplearning4j_tpu.nn.updaters import Adam, Updater  # noqa: E402
+from deeplearning4j_tpu.ops import update_kernel  # noqa: E402
+
+ap = argparse.ArgumentParser()
+ap.add_argument("--quick", action="store_true")
+args = ap.parse_args()
+
+QUICK = args.quick or os.environ.get("PROBE_QUICK", "0") == "1"
+WARMUP, WINDOWS, PER = (3, 2, 8) if QUICK else (10, 3, 33)
+LAYERS, DIM = (12, 128) if QUICK else (48, 256)
+
+
+def max_ulp(a_tree, b_tree):
+    worst = 0
+    for a, b in zip(jax.tree_util.tree_leaves(a_tree),
+                    jax.tree_util.tree_leaves(b_tree)):
+        ib = {2: np.int16, 4: np.int32, 8: np.int64}[np.dtype(a.dtype).itemsize]
+        xi = np.asarray(a).view(ib).astype(np.int64)
+        yi = np.asarray(b).view(ib).astype(np.int64)
+        xi = np.where(xi < 0, np.int64(-(2 ** 62)) - xi, xi)
+        yi = np.where(yi < 0, np.int64(-(2 ** 62)) - yi, yi)
+        worst = max(worst, int(np.abs(xi - yi).max()) if xi.size else 0)
+    return worst
+
+
+rng = np.random.default_rng(0)
+params = {f"l{i}": {"W": jnp.asarray(rng.normal(size=(DIM, DIM)), jnp.float32),
+                    "b": jnp.asarray(rng.normal(size=(DIM,)), jnp.float32)}
+          for i in range(LAYERS)}
+grads = jax.tree_util.tree_map(lambda p: p * 0.01, params)
+upd = Adam(lr=1e-3)
+state = {"m": jax.tree_util.tree_map(lambda p: p * 0.03, params),
+         "v": jax.tree_util.tree_map(lambda p: p * p * 0.01, params)}
+it = jnp.asarray(3.0, jnp.float32)
+n_params = sum(l.size for l in jax.tree_util.tree_leaves(params))
+
+# trace each arm's program while its module flags are set (the flags are
+# read at TRACE time; each closure is traced exactly once, right here)
+plain_fn = jax.jit(lambda p, g, s, i: Updater.apply(upd, p, g, s, i))
+ref = plain_fn(params, grads, state, it)
+
+update_kernel.ENABLED = True
+update_kernel.FORCE_JNP = True
+jnp_fn = jax.jit(
+    lambda p, g, s, i: update_kernel.fused_apply("adam", upd, p, g, s, i))
+out_jnp = jnp_fn(params, grads, state, it)
+
+update_kernel.FORCE_JNP = False
+pallas_fn = jax.jit(
+    lambda p, g, s, i: update_kernel.fused_apply("adam", upd, p, g, s, i))
+out_pl = pallas_fn(params, grads, state, it)
+
+def max_abs(a_tree, b_tree):
+    worst = 0.0
+    for a, b in zip(jax.tree_util.tree_leaves(a_tree),
+                    jax.tree_util.tree_leaves(b_tree)):
+        d = np.abs(np.asarray(a, np.float64) - np.asarray(b, np.float64))
+        worst = max(worst, float(d.max()) if d.size else 0.0)
+    return worst
+
+
+parity = {
+    "parity_moments_max_ulp_jnp": max_ulp(ref[1], out_jnp[1]),
+    "parity_moments_max_ulp_pallas": max_ulp(ref[1], out_pl[1]),
+    "parity_params_max_abs_jnp": max_abs(ref[0], out_jnp[0]),
+    "parity_params_max_abs_pallas": max_abs(ref[0], out_pl[0]),
+}
+
+ARMS = {"plain": plain_fn, "fused_jnp": jnp_fn, "fused_pallas": pallas_fn}
+
+
+def sync(out):
+    float(jnp.sum(jax.tree_util.tree_leaves(out[0])[0]))
+
+
+best = {name: float("inf") for name in ARMS}
+for name, fn in ARMS.items():
+    st = (params, state)
+    for _ in range(WARMUP):
+        st = fn(st[0], grads, st[1], it)
+    sync(st)
+for _ in range(WINDOWS):
+    for name, fn in ARMS.items():        # interleaved: every window hits
+        st = (params, state)             # every arm under the same tenancy
+        t0 = time.perf_counter()
+        for _ in range(PER):
+            st = fn(st[0], grads, st[1], it)
+        sync(st)
+        best[name] = min(best[name], (time.perf_counter() - t0) / PER)
+
+out = {"config": "fused_update_ab", "n_params": n_params, "layers": LAYERS,
+       "plain_ms": round(best["plain"] * 1e3, 4),
+       "fused_jnp_ms": round(best["fused_jnp"] * 1e3, 4),
+       "fused_pallas_ms": round(best["fused_pallas"] * 1e3, 4),
+       "speedup_fused_jnp": round(best["plain"] / best["fused_jnp"], 3),
+       "speedup_fused_pallas": round(best["plain"] / best["fused_pallas"], 3),
+       **parity,
+       "platform": jax.devices()[0].platform, "t": round(time.time(), 1)}
+print(json.dumps(out), flush=True)

@@ -1,0 +1,102 @@
+"""Interleaved A/B for the int8 quantized serving matmul (ops/quantize.py).
+
+Arms (alternating windows, identical protocol):
+
+  f32    the jitted f32 serving forward (serving/engine.py's fwd program)
+  int8   the same forward over int8-quantized params — per-channel
+         symmetric weights, calibrated per-tensor activation scales,
+         int32 accumulation
+
+Measures the raw jitted forward (not the threaded engine: thread
+scheduling noise would swamp a matmul-level A/B; the engine contract —
+zero serve-time compiles under int8 warmup — is tested in
+tests/test_quantize.py).  Also reports the numerics envelope the bench
+gate enforces: top-1 agreement and max relative logit divergence between
+the arms on the SAME inputs.  Prints one JSON line; --quick shrinks the
+model for CPU/BENCH_QUICK runs.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+ap = argparse.ArgumentParser()
+ap.add_argument("--quick", action="store_true")
+args = ap.parse_args()
+
+QUICK = args.quick or os.environ.get("PROBE_QUICK", "0") == "1"
+WARMUP, WINDOWS, PER = (3, 2, 8) if QUICK else (10, 3, 33)
+BATCH, HIDDEN, DEPTH = (32, 256, 2) if QUICK else (64, 1024, 4)
+N_IN, N_OUT = 784, 10
+
+from deeplearning4j_tpu.datasets import DataSet  # noqa: E402
+from deeplearning4j_tpu.nn.conf.inputs import InputType  # noqa: E402
+from deeplearning4j_tpu.nn.layers import Dense, OutputLayer  # noqa: E402
+from deeplearning4j_tpu.nn.multilayer import (  # noqa: E402
+    MultiLayerNetwork, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.updaters import Adam  # noqa: E402
+from deeplearning4j_tpu.ops import quantize as qz  # noqa: E402
+
+rng = np.random.default_rng(0)
+b = NeuralNetConfiguration.builder().seed(0).updater(Adam(lr=1e-3))
+for _ in range(DEPTH):
+    b = b.layer(Dense(n_out=HIDDEN, activation="relu"))
+conf = (b.layer(OutputLayer(n_out=N_OUT, activation="softmax", loss="mcxent"))
+        .set_input_type(InputType.feed_forward(N_IN)).build())
+net = MultiLayerNetwork(conf)
+net.init()
+# a few steps so the weights are not raw init noise
+x_tr = rng.normal(size=(BATCH, N_IN)).astype(np.float32)
+y_tr = np.eye(N_OUT, dtype=np.float32)[rng.integers(0, N_OUT, BATCH)]
+for _ in range(5):
+    net.fit_batch(DataSet(x_tr, y_tr))
+
+x = jnp.asarray(rng.normal(size=(BATCH, N_IN)).astype(np.float32))
+qm = qz.quantize_model(net, np.asarray(x))
+
+
+def fwd_of(params, state):
+    return jax.jit(lambda xx: net._apply_layers(
+        params, state, xx, train=False, rng=None, mask=None)[0])
+
+
+ARMS = {"f32": fwd_of(net.params, net.state),
+        "int8": fwd_of(qm.params, qm.state)}
+
+ref = np.asarray(ARMS["f32"](x))
+got = np.asarray(ARMS["int8"](x))
+top1 = float((ref.argmax(1) == got.argmax(1)).mean())
+rel = float(np.abs(ref - got).max() / max(np.abs(ref).max(), 1e-6))
+
+best = {name: float("inf") for name in ARMS}
+for name, fn in ARMS.items():
+    for _ in range(WARMUP):
+        y = fn(x)
+    float(jnp.sum(y))
+for _ in range(WINDOWS):
+    for name, fn in ARMS.items():        # interleaved
+        t0 = time.perf_counter()
+        for _ in range(PER):
+            y = fn(x)
+        float(jnp.sum(y))
+        best[name] = min(best[name], (time.perf_counter() - t0) / PER)
+
+out = {"config": "quantized_serving_ab", "batch": BATCH, "hidden": HIDDEN,
+       "depth": DEPTH,
+       "f32_ms": round(best["f32"] * 1e3, 4),
+       "int8_ms": round(best["int8"] * 1e3, 4),
+       "speedup_int8": round(best["f32"] / best["int8"], 3),
+       "f32_qps": round(BATCH / best["f32"], 1),
+       "int8_qps": round(BATCH / best["int8"], 1),
+       "top1_agree": round(top1, 4),
+       "max_rel_logit_diff": round(rel, 5),
+       "platform": jax.devices()[0].platform, "t": round(time.time(), 1)}
+print(json.dumps(out), flush=True)

@@ -1,0 +1,195 @@
+"""Int8 quantized serving: per-channel scale round-trips, degenerate
+channels, calibration determinism, the zoo logit-divergence envelope, and
+the engine's zero-serve-time-compiles contract under int8 warmup."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops import quantize as qz
+
+
+def mlp(seed=0, n_in=12, n_out=4, steps=20):
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import (MultiLayerNetwork,
+                                                  NeuralNetConfiguration)
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(64, n_in)).astype(np.float32)
+    y = np.eye(n_out, dtype=np.float32)[rng.integers(0, n_out, 64)]
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(lr=1e-2))
+            .layer(Dense(n_out=32, activation="relu"))
+            .layer(OutputLayer(n_out=n_out, activation="softmax",
+                               loss="mcxent"))
+            .set_input_type(InputType.feed_forward(n_in)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    for _ in range(steps):
+        net.fit_batch(DataSet(x, y))
+    return net, x
+
+
+class TestWeightQuantization:
+    def test_per_channel_round_trip(self):
+        rng = np.random.default_rng(0)
+        # channels at wildly different magnitudes: per-channel scales
+        # must bound the round-trip error per channel, not globally
+        w = rng.normal(size=(64, 8)).astype(np.float32)
+        w *= np.logspace(-3, 2, 8, dtype=np.float32)[None, :]
+        q = qz.quantize_weight(jnp.asarray(w), act_amax=1.0)
+        assert q.values.dtype == jnp.int8
+        back = np.asarray(q.dequantize())
+        amax = np.abs(w).max(axis=0)
+        # symmetric int8: error <= scale/2 = amax/254 per channel
+        assert (np.abs(back - w) <= amax / 254 + 1e-9).all()
+
+    def test_all_zero_channel(self):
+        w = np.zeros((16, 3), np.float32)
+        w[:, 1] = np.linspace(-1, 1, 16)
+        q = qz.quantize_weight(jnp.asarray(w), act_amax=1.0)
+        back = np.asarray(q.dequantize())
+        assert not back[:, 0].any() and not back[:, 2].any()
+        assert np.abs(back[:, 1] - w[:, 1]).max() <= 1 / 254 + 1e-9
+
+    def test_outlier_channel_does_not_poison_others(self):
+        rng = np.random.default_rng(1)
+        w = rng.normal(size=(32, 4)).astype(np.float32)
+        w[0, 3] = 1e4                       # one huge outlier channel
+        q = qz.quantize_weight(jnp.asarray(w), act_amax=1.0)
+        back = np.asarray(q.dequantize())
+        # the outlier only degrades ITS channel; per-tensor quantization
+        # would flatten the small channels to zero
+        assert np.abs(back[:, :3] - w[:, :3]).max() <= np.abs(
+            w[:, :3]).max() / 127 + 1e-9
+
+    def test_int8_matmul_int32_accumulation(self):
+        # values big enough that an int8/int16 accumulator would overflow
+        w = jnp.ones((256, 2), jnp.float32)
+        q = qz.quantize_weight(w, act_amax=1.0)
+        x = jnp.ones((1, 256), jnp.float32)
+        y = np.asarray(x @ q)
+        np.testing.assert_allclose(y, 256.0, rtol=0.02)
+
+    def test_astype_is_identity(self):
+        q = qz.quantize_weight(jnp.ones((8, 2)), act_amax=1.0)
+        assert q.astype(jnp.bfloat16) is q
+        assert q.shape == (8, 2) and q.ndim == 2
+
+
+class TestCalibration:
+    def test_deterministic_under_fixed_inputs(self):
+        net, x = mlp(seed=3)
+        s1 = qz.calibrate(net, x)
+        s2 = qz.calibrate(net, x)
+        assert s1 == s2 and len(s1) == 2
+
+    def test_sweeps_take_running_max(self):
+        net, x = mlp(seed=4)
+        small = qz.calibrate(net, x * 0.1)
+        both = qz.calibrate(net, [x * 0.1, x])
+        assert all(both[k] >= small[k] for k in small)
+
+    def test_unexercised_weight_stays_f32(self):
+        net, x = mlp(seed=5)
+        stats = qz.calibrate(net, x)
+        missing = dict(list(stats.items())[:1])   # drop one layer's stats
+        qp = qz.quantize_params(net.params, missing)
+        kinds = [type(l) for l in jax.tree_util.tree_leaves(
+            qp, is_leaf=lambda l: isinstance(l, qz.Int8Weight))
+            if isinstance(l, qz.Int8Weight)]
+        assert len(kinds) == 1
+
+    def test_quantize_model_requires_candidates(self):
+        class NoDense:
+            params = {"foo": jnp.ones((3,))}
+            state = {}
+
+            def _apply_layers(self, params, state, x, **kw):
+                return (x, state, None)
+
+        with pytest.raises(ValueError, match="no 2-D 'W'"):
+            qz.quantize_model(NoDense(), np.ones((4, 3), np.float32))
+
+
+class TestLogitEnvelope:
+    def test_mlp_envelope(self):
+        net, x = mlp(seed=6)
+        qm = qz.quantize_model(net, x)
+        ref = np.asarray(net.output(x))
+        got = qm.output(x)
+        denom = max(np.abs(ref).max(), 1e-6)
+        assert np.abs(ref - got).max() / denom < 0.05
+        assert (ref.argmax(1) == got.argmax(1)).mean() >= 0.95
+
+    def test_zoo_iris_mlp_envelope(self, tmp_path):
+        from deeplearning4j_tpu.datasets.fetchers import load_iris
+        from deeplearning4j_tpu.models import (PretrainedType,
+                                               init_pretrained,
+                                               init_pretrained_int8)
+
+        xs, ys = load_iris()
+        xs = xs.astype(np.float32)
+        net = init_pretrained("iris_mlp", PretrainedType.IRIS,
+                              cache_dir=str(tmp_path))
+        qm = init_pretrained_int8("iris_mlp", PretrainedType.IRIS,
+                                  calibration_inputs=xs,
+                                  cache_dir=str(tmp_path))
+        ref = np.asarray(net.output(xs))
+        got = qm.output(xs)
+        # the shipped artifact's accuracy must survive quantization
+        assert (got.argmax(1) == ys).mean() > 0.97
+        assert (ref.argmax(1) == got.argmax(1)).mean() >= 0.99
+
+    def test_zoo_int8_requires_calibration_inputs(self):
+        from deeplearning4j_tpu.models import init_pretrained_int8
+        with pytest.raises(ValueError, match="calibration_inputs"):
+            init_pretrained_int8("iris_mlp", "iris")
+
+
+class TestEngineInt8:
+    def _engine(self, net, **kw):
+        from deeplearning4j_tpu.serving.engine import Engine
+        return Engine(net, max_batch=8, slo_ms=200.0, bucket_sizes=(4, 8),
+                      replicas=1, **kw)
+
+    def test_zero_serve_time_compiles_with_int8_warmup(self):
+        net, x = mlp(seed=7)
+        eng = self._engine(net)
+        try:
+            eng.load(input_shape=(12,), quantize="int8",
+                     calibration_inputs=x)
+            n0 = eng.compile_cache_size()
+            assert n0 is not None and n0 >= 2   # one per bucket
+            for b in (3, 4, 8):
+                out = eng.output(x[:b])
+                assert out.shape == (b, 4)
+            assert eng.compile_cache_size() == n0
+        finally:
+            eng.shutdown()
+
+    def test_int8_serving_matches_direct_quantized_forward(self):
+        net, x = mlp(seed=8)
+        qm = qz.quantize_model(net, x)
+        eng = self._engine(net)
+        try:
+            eng.load(input_shape=(12,), quantize="int8",
+                     calibration_inputs=x)
+            served = eng.output(x[:4])
+            np.testing.assert_allclose(served, qm.output(x[:4]),
+                                       rtol=1e-5, atol=1e-6)
+        finally:
+            eng.shutdown()
+
+    def test_bad_mode_rejected(self):
+        net, _ = mlp(seed=9, steps=1)
+        eng = self._engine(net)
+        try:
+            with pytest.raises(ValueError, match="quantize"):
+                eng.load(input_shape=(12,), quantize="int4")
+        finally:
+            eng.shutdown()

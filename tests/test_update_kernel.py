@@ -1,0 +1,259 @@
+"""Fused Adam/Nadam one-pass update: bit-identity vs the per-leaf plain
+path (pallas-interpret, flat-jnp, and fallback modes) plus integration
+through MultiLayerNetwork training."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.updaters import (AMSGrad, Adam, AdaMax, Nadam,
+                                            Updater)
+from deeplearning4j_tpu.ops import update_kernel
+
+
+@pytest.fixture(autouse=True)
+def enable_kernel(monkeypatch):
+    """The fused path is opt-in (DL4J_TPU_FUSED_UPDATE=1); tests exercise
+    it explicitly."""
+    monkeypatch.setattr(update_kernel, "ENABLED", True)
+
+
+def tree(shapes, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    return {f"l{i}": {"W": jnp.asarray(rng.normal(size=s), dtype),
+                      "b": jnp.asarray(rng.normal(size=s[-1:]), dtype)}
+            for i, s in enumerate(shapes)}
+
+
+def nonzero_state(upd, params):
+    """Adam state with NONZERO moments — zero moments hide FMA-ordering
+    and beta-scaling differences in the m/v EMAs."""
+    return {"m": jax.tree_util.tree_map(lambda p: p * 0.03, params),
+            "v": jax.tree_util.tree_map(lambda p: p * p * 0.01, params)}
+
+
+def _ulp_distance(x, y):
+    """Elementwise distance in ulps via the monotone int mapping of the
+    float bit patterns (works for f32/bf16/f64)."""
+    ibits = {2: np.int16, 4: np.int32, 8: np.int64}[x.dtype.itemsize]
+    xi = np.asarray(x).view(ibits).astype(np.int64)
+    yi = np.asarray(y).view(ibits).astype(np.int64)
+    # map sign-magnitude float ordering onto monotone integers
+    xi = np.where(xi < 0, np.int64(-(2 ** 62)) - xi, xi)
+    yi = np.where(yi < 0, np.int64(-(2 ** 62)) - yi, yi)
+    return np.abs(xi - yi)
+
+
+def assert_trees_bitwise(a, b, max_ulp=0):
+    """max_ulp=0 -> strict bit identity.  max_ulp=1 tolerates XLA:CPU's
+    layout-dependent FMA contraction (LLVM may or may not contract
+    ``a*x + b*y`` depending on vector-lane boundaries, so the flat
+    buffer and the per-leaf buffers can round one multiply-add
+    differently); the math itself is the same chain either way."""
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        assert x.dtype == y.dtype
+        assert x.shape == y.shape
+        x, y = np.asarray(x), np.asarray(y)
+        if max_ulp == 0:
+            np.testing.assert_array_equal(x, y)
+        else:
+            d = _ulp_distance(x, y)
+            assert d.max() <= max_ulp, (
+                f"max ulp diff {d.max()} at {int(d.argmax())} "
+                f"({x.ravel()[d.argmax()]} vs {y.ravel()[d.argmax()]})")
+
+
+def run_both(upd, kind, params, grads, state, it):
+    """Plain per-leaf path and fused path, BOTH through jit (how they run
+    inside a train step — the bit-comparability contract is jit-vs-jit;
+    eager references differ by FMA contraction on sum-of-products)."""
+    plain = jax.jit(lambda p, g, s, i: Updater.apply(upd, p, g, s, i))
+    fused = jax.jit(
+        lambda p, g, s, i: update_kernel.fused_apply(kind, upd, p, g, s, i))
+    return plain(params, grads, state, it), fused(params, grads, state, it)
+
+
+class TestBitIdentity:
+    @pytest.mark.parametrize("upd,kind", [
+        (Adam(lr=1e-3), "adam"),
+        (Nadam(lr=2e-3), "nadam"),
+    ])
+    def test_pallas_matches_plain(self, upd, kind):
+        # 37x61 = 2257 > one (8,128) tile -> pallas path, with padding
+        params = tree([(37, 61), (61, 13)])
+        grads = jax.tree_util.tree_map(lambda p: p * 0.1, params)
+        state = nonzero_state(upd, params)
+        it = jnp.asarray(3.0, jnp.float32)
+        (rp, rs), (fp, fs) = run_both(upd, kind, params, grads, state, it)
+        assert_trees_bitwise(rp, fp)
+        assert_trees_bitwise(rs, fs)
+
+    def test_flat_jnp_matches_plain(self, monkeypatch):
+        monkeypatch.setattr(update_kernel, "FORCE_JNP", True)
+        upd = Adam(lr=1e-3)
+        params = tree([(37, 61)])
+        grads = jax.tree_util.tree_map(lambda p: p * 0.1, params)
+        state = nonzero_state(upd, params)
+        it = jnp.asarray(0.0, jnp.float32)
+        (rp, rs), (fp, fs) = run_both(upd, "adam", params, grads, state, it)
+        assert_trees_bitwise(rp, fp)
+        assert_trees_bitwise(rs, fs)
+
+    def test_small_n_flat_jnp_matches_plain(self):
+        # below one (8,128) tile the pallas path is skipped
+        upd = Nadam(lr=1e-3)
+        params = tree([(7, 11)])
+        grads = jax.tree_util.tree_map(lambda p: p * 0.1, params)
+        state = nonzero_state(upd, params)
+        it = jnp.asarray(5.0, jnp.float32)
+        (rp, rs), (fp, fs) = run_both(upd, "nadam", params, grads, state, it)
+        assert_trees_bitwise(rp, fp, max_ulp=1)
+        assert_trees_bitwise(rs, fs, max_ulp=1)
+
+    def test_bf16_moments_match_plain(self):
+        upd = Adam(lr=1e-3, moment_dtype="bfloat16")
+        params = tree([(37, 61)])
+        grads = jax.tree_util.tree_map(lambda p: p * 0.1, params)
+        state = upd.init_state(params)
+        assert jax.tree_util.tree_leaves(state["m"])[0].dtype == jnp.bfloat16
+        it = jnp.asarray(2.0, jnp.float32)
+        (rp, rs), (fp, fs) = run_both(upd, "adam", params, grads, state, it)
+        assert_trees_bitwise(rp, fp)
+        assert_trees_bitwise(rs, fs)
+        assert jax.tree_util.tree_leaves(fs["m"])[0].dtype == jnp.bfloat16
+
+    def test_bf16_params_match_plain(self):
+        upd = Adam(lr=1e-3)
+        params = tree([(37, 61)], dtype=jnp.bfloat16)
+        grads = jax.tree_util.tree_map(lambda p: p * 0.1, params)
+        state = nonzero_state(upd, params)
+        it = jnp.asarray(1.0, jnp.float32)
+        (rp, rs), (fp, fs) = run_both(upd, "adam", params, grads, state, it)
+        assert_trees_bitwise(rp, fp)
+        assert jax.tree_util.tree_leaves(fp)[0].dtype == jnp.bfloat16
+
+
+class TestFallbacks:
+    def test_disabled_returns_none(self, monkeypatch):
+        monkeypatch.setattr(update_kernel, "ENABLED", False)
+        upd = Adam()
+        params = tree([(8, 8)])
+        state = upd.init_state(params)
+        out = update_kernel.fused_apply("adam", upd, params, params, state,
+                                        jnp.asarray(0.0, jnp.float32))
+        assert out is None
+
+    def test_f64_returns_none(self):
+        jax.config.update("jax_enable_x64", True)
+        try:
+            upd = Adam()
+            params = {"W": jnp.ones((8, 8), jnp.float64)}
+            state = upd.init_state(params)
+            out = update_kernel.fused_apply(
+                "adam", upd, params, params, state,
+                jnp.asarray(0.0, jnp.float32))
+            assert out is None
+            # ...and .apply still works via the plain path
+            p2, s2 = upd.apply(params, params, state,
+                               jnp.asarray(0.0, jnp.float32))
+            assert p2["W"].dtype == jnp.float64
+        finally:
+            jax.config.update("jax_enable_x64", False)
+
+    def test_empty_tree_returns_none(self):
+        upd = Adam()
+        out = update_kernel.fused_apply("adam", upd, {}, {}, {"m": {}, "v": {}},
+                                        jnp.asarray(0.0, jnp.float32))
+        assert out is None
+
+    def test_kind_of_exact_types_only(self):
+        assert update_kernel.kind_of(Adam()) == "adam"
+        assert update_kernel.kind_of(Nadam()) == "nadam"
+        # subclasses carry DIFFERENT math: must not take the Adam kernel
+        assert update_kernel.kind_of(AdaMax()) is None
+        assert update_kernel.kind_of(AMSGrad()) is None
+
+    def test_amsgrad_apply_takes_plain_path(self):
+        # AMSGrad inherits Adam.apply; kind_of(None) must route it to the
+        # base per-leaf path without touching the kernel
+        upd = AMSGrad(lr=1e-3)
+        params = tree([(16, 16)])
+        grads = jax.tree_util.tree_map(lambda p: p * 0.1, params)
+        state = upd.init_state(params)
+        it = jnp.asarray(0.0, jnp.float32)
+        p2, s2 = upd.apply(params, grads, state, it)
+        upds, s3 = upd.update(grads, state, it)
+        ref = jax.tree_util.tree_map(
+            lambda pp, uu: (pp.astype(jnp.float32) - uu).astype(pp.dtype),
+            params, upds)
+        assert_trees_bitwise(p2, ref)
+
+
+class TestIntegration:
+    def _fit(self, steps=3):
+        from deeplearning4j_tpu.datasets import DataSet
+        from deeplearning4j_tpu.nn.conf.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+        from deeplearning4j_tpu.nn.multilayer import (MultiLayerNetwork,
+                                                      NeuralNetConfiguration)
+
+        rng = np.random.default_rng(7)
+        x = rng.normal(size=(32, 20)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .updater(Adam(lr=1e-2))
+                .layer(Dense(n_out=48, activation="relu"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(20)).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        ds = DataSet(x, y)
+        for _ in range(steps):
+            net.fit_batch(ds)
+        return net.params
+
+    def test_one_network_step_matches_plain(self, monkeypatch):
+        # inside the full jitted train step the surrounding program
+        # changes XLA:CPU's fusion/FMA choices -> 1-ulp tolerance per
+        # application (per-step divergence compounds over iterations)
+        p_fused = self._fit(steps=1)
+        monkeypatch.setattr(update_kernel, "ENABLED", False)
+        p_plain = self._fit(steps=1)
+        assert_trees_bitwise(p_fused, p_plain, max_ulp=1)
+
+    def test_network_training_matches_plain(self, monkeypatch):
+        p_fused = self._fit(steps=5)
+        monkeypatch.setattr(update_kernel, "ENABLED", False)
+        p_plain = self._fit(steps=5)
+        for a, b in zip(jax.tree_util.tree_leaves(p_fused),
+                        jax.tree_util.tree_leaves(p_plain)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-7)
+
+    def test_jit_apply_emits_train_update_span(self, tmp_path):
+        from deeplearning4j_tpu.obs import trace as obs_trace
+
+        upd = Adam(lr=1e-3)
+        params = tree([(16, 16)])
+        state = upd.init_state(params)
+        run = update_kernel.jit_apply(upd)
+        it = jnp.asarray(0.0, jnp.float32)
+        path = str(tmp_path / "upd_trace.json")
+        obs_trace.enable_tracing(path=path)
+        try:
+            p, s = run(params, params, state, it)
+            jax.block_until_ready(jax.tree_util.tree_leaves(p))
+            obs_trace.flush(path)
+        finally:
+            obs_trace.disable_tracing()
+        import json
+        with open(path) as f:
+            ev = json.load(f)["traceEvents"]
+        names = {e["name"] for e in ev if e.get("ph") == "X"}
+        assert "train/update" in names
