@@ -71,6 +71,15 @@ stderr, including:
     compiles across respawns, canary auto-rollback on exactly the
     regressed version, and chaos-off bit-identity with the pre-PR
     engine configuration (docs/SERVING.md "Failure model")
+  - decode_tokens_per_sec: the autoregressive-decode A/B gate
+    (scripts/decode_ab.py) — static-batch full-re-encode decoding vs
+    serving.DecodeEngine (paged KV-cache, bucketed prefill/decode split,
+    iteration-level continuous batching) on the same open-loop prompt
+    schedule; hard-gated everywhere on temperature-0 BITWISE logit
+    identity with re-encode, greedy token parity, zero serve-time
+    compiles, and zero stranded futures under a decode-batch crash;
+    speed gates (tokens/sec >= baseline, p99 TTFT <= baseline) bind on
+    TPU only (docs/SERVING.md "Autoregressive decode")
   - telemetry_overhead: the observability-layer gate
     (scripts/trace_overhead_ab.py) — span tracing OFF vs ON on
     adjacent-step pairs, hard-gated on median paired overhead <= 3%,
@@ -1491,6 +1500,65 @@ def bench_quantized_serving_ab():
             "platform": ab["platform"]}
 
 
+def bench_continuous_batching():
+    """Config 21: autoregressive decode A/B (scripts/decode_ab.py; CPU
+    subprocess — the continuous-batching logic under test is host-side).
+    Static-batch full-re-encode decoding vs serving.DecodeEngine (paged
+    KV-cache + bucketed prefill + iteration-level joins) on the SAME
+    open-loop prompt schedule.  HARD gates on EVERY platform — the
+    correctness contract that makes the cache safe to offer at all:
+    temperature-0 per-token logits BITWISE identical to re-encoding,
+    greedy tokens identical across arms, zero serve-time compiles, and
+    zero stranded futures when a mid-flight decode batch crashes.  The
+    SPEED gates (tokens/sec >= baseline, p99 TTFT <= baseline) bind on
+    TPU only, where device time dominates; they are reported here too."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    script = os.path.join(_REPO, "scripts", "decode_ab.py")
+    cmd = [sys.executable, script] + (["--quick"] if QUICK else [])
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=1800, cwd=_REPO)
+    if p.returncode != 0:
+        raise RuntimeError(f"decode_ab failed (rc={p.returncode}): "
+                           f"{p.stderr[-1500:]}")
+    ab = json.loads(p.stdout.strip().splitlines()[-1])
+    if not ab.get("bit_identical"):
+        raise RuntimeError("decode bit-identity gate FAILED (paged-cache "
+                           f"logits must match re-encode bitwise): {ab}")
+    if not ab.get("tokens_match"):
+        raise RuntimeError("decode token-parity gate FAILED (greedy tokens "
+                           f"must agree across arms): {ab}")
+    if not ab.get("zero_compiles"):
+        raise RuntimeError("decode AOT gate FAILED (a request paid a "
+                           f"serve-time compile): {ab}")
+    if ab.get("stranded"):
+        raise RuntimeError("decode resilience gate FAILED (futures stranded "
+                           f"after a decode-batch crash): {ab}")
+    if ab.get("speed_gated"):
+        if not ab.get("tokens_ok"):
+            raise RuntimeError("decode throughput gate FAILED (engine must "
+                               f"be >= 1.0x static baseline on TPU): {ab}")
+        if not ab.get("ttft_ok"):
+            raise RuntimeError("decode TTFT gate FAILED (engine p99 TTFT "
+                               f"must be <= baseline on TPU): {ab}")
+    return {"metric": "decode_tokens_per_sec",
+            "value": ab["engine"]["tokens_per_sec"],
+            "unit": "tokens/sec (cpu)" if ab["platform"] != "tpu"
+            else "tokens/sec",
+            "platform": ab["platform"], "n_requests": ab["n_requests"],
+            "tokens_ratio_engine_vs_baseline":
+                ab["tokens_ratio_engine_vs_baseline"],
+            "ttft_p99_ms": {"baseline": ab["baseline"]["ttft_p99_ms"],
+                            "engine": ab["engine"]["ttft_p99_ms"]},
+            "bit_identical": True, "tokens_match": True,
+            "zero_compiles": True, "stranded": 0,
+            "crash_retries": ab["crash_retries"],
+            "speed_gated": ab["speed_gated"]}
+
+
 def main() -> None:
     import jax
 
@@ -1518,7 +1586,8 @@ def main() -> None:
                      ("telemetry_overhead", bench_telemetry_overhead),
                      ("static_analysis_clean", bench_static_analysis),
                      ("fused_update_ab", bench_fused_update_ab),
-                     ("quantized_serving_ab", bench_quantized_serving_ab)]:
+                     ("quantized_serving_ab", bench_quantized_serving_ab),
+                     ("continuous_batching_ab", bench_continuous_batching)]:
         try:
             t0 = time.perf_counter()
             out = fn()

@@ -14,6 +14,8 @@ configs).  Usage:
         --output preds.npz
     python -m deeplearning4j_tpu serve --model model.zip --max-batch 32 \\
         --slo-ms 50 --replicas -1 --admission shed --port 9000
+    python -m deeplearning4j_tpu generate --model lm.zip \\
+        --prompt "the " --max-tokens 64 --temperature 0.8 --seed 7
     python -m deeplearning4j_tpu launch --nprocs 2 --devices-per-proc 4 \\
         -- train --zoo lenet --data mnist --elastic-dir ckpts
     python -m deeplearning4j_tpu summary --model model.zip
@@ -572,6 +574,99 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def _sample_probs(probs: np.ndarray, temperature: float, top_k: int,
+                  top_p: float, rng: np.random.Generator) -> int:
+    """Host-side sampling from a probability row (the char-RNN path —
+    its output layer already applied softmax).  Same knob semantics as
+    the decode engine: temperature<=0 greedy, top_k==0 / top_p>=1 off."""
+    if temperature <= 0.0:
+        return int(np.argmax(probs))
+    p = np.asarray(probs, np.float64) ** (1.0 / max(temperature, 1e-6))
+    if top_k and top_k < p.shape[0]:
+        p[np.argsort(p)[:-top_k]] = 0.0
+    if top_p < 1.0:
+        order = np.argsort(p)[::-1]
+        cum = np.cumsum(p[order]) / max(p.sum(), 1e-30)
+        p[order[1:][cum[:-1] >= top_p]] = 0.0   # keep top-1 always
+    p /= p.sum()
+    return int(rng.choice(p.shape[0], p=p))
+
+
+def cmd_generate(args) -> int:
+    """Autoregressive text generation (docs/SERVING.md "Autoregressive
+    decode").  Two model families, one CLI:
+
+      transformer LM  — served through serving.DecodeEngine (paged
+                        KV-cache, bucketed prefill, continuous
+                        batching), models.TransformerDecodeAdapter
+      recurrent nets  — the reference rnnTimeStep() streaming loop
+                        (stateful hidden carry, one step per token)
+
+    Text <-> token ids is byte-valued (ord/chr clamped to the model's
+    vocab) — the char-LM convention of examples/10_textgen_decode.py.
+    """
+    net = _load_model(args.model)
+    from .models.transformer import TransformerBlock
+
+    is_transformer = any(isinstance(l, TransformerBlock)
+                         for l in net.conf.layers)
+    if is_transformer:
+        from .models.transformer import TransformerDecodeAdapter
+        from .serving import DecodeEngine
+
+        adapter = TransformerDecodeAdapter(net)
+        vocab = adapter.vocab_size
+        pos_rows = int(adapter.params["pos"]["P"].shape[0])
+        page = args.page_size
+        while page > 1 and page > pos_rows // 2:
+            page //= 2
+        prompt_ids = [min(ord(c), vocab - 1) for c in args.prompt]
+        if not prompt_ids:
+            raise SystemExit("--prompt must be non-empty")
+        eng = DecodeEngine(adapter, max_slots=1, page_size=page,
+                           default_max_new=args.max_tokens).load()
+        try:
+            if len(prompt_ids) > eng.max_prompt:
+                raise SystemExit(f"prompt longer than the warmed buckets "
+                                 f"(max {eng.max_prompt} tokens)")
+            res = eng.generate(prompt_ids, max_new_tokens=args.max_tokens,
+                               temperature=args.temperature,
+                               top_k=args.top_k, top_p=args.top_p,
+                               seed=args.seed)
+            text = "".join(chr(t) if t < 0x110000 else "?"
+                           for t in res.tokens)
+            print(f"[decode engine: {len(res.tokens)} tokens, "
+                  f"finish={res.finish_reason}, ttft={res.ttft_ms}ms, "
+                  f"tpot={res.tpot_ms}ms]", file=sys.stderr)
+            print(args.prompt + text)
+        finally:
+            eng.shutdown()
+        return 0
+
+    # recurrent path: reference rnnTimeStep() streaming
+    out_layer = net.conf.layers[-1]
+    vocab = int(getattr(out_layer, "n_out", 256) or 256)
+    prompt_ids = [min(ord(c), vocab - 1) for c in args.prompt]
+    if not prompt_ids:
+        raise SystemExit("--prompt must be non-empty")
+    rng = np.random.default_rng(args.seed)
+    net.rnn_clear_previous_state()
+    probs = net.rnn_time_step(np.asarray([prompt_ids], np.int32))
+    dist = probs[0, -1] if probs.ndim == 3 else probs[0]
+    toks = []
+    for _ in range(args.max_tokens):
+        tok = _sample_probs(dist, args.temperature, args.top_k, args.top_p,
+                            rng)
+        toks.append(tok)
+        probs = net.rnn_time_step(np.asarray([tok], np.int32))
+        dist = probs[0]
+    net.rnn_clear_previous_state()
+    print(f"[rnn_time_step: {len(toks)} tokens]", file=sys.stderr)
+    print(args.prompt + "".join(chr(t) if t < 0x110000 else "?"
+                                for t in toks))
+    return 0
+
+
 def _parse_chaos_worker(specs):
     """['1:proc_kill@10', ...] → {worker: chaos spec}, validating both the
     worker index syntax and the embedded chaos spec (clean CLI errors)."""
@@ -919,6 +1014,30 @@ def build_parser() -> argparse.ArgumentParser:
                    "buffer is served live on GET /trace and written to "
                    "PATH on shutdown (docs/OBSERVABILITY.md)")
     v.set_defaults(fn=cmd_serve)
+
+    g = sub.add_parser(
+        "generate", help="autoregressive text generation (docs/SERVING.md "
+        "\"Autoregressive decode\"): transformer LMs run through the "
+        "paged-KV-cache decode engine, recurrent nets through "
+        "rnnTimeStep streaming")
+    g.add_argument("--model", required=True, help="checkpoint zip")
+    g.add_argument("--prompt", required=True,
+                   help="prompt text (byte-valued char vocab)")
+    g.add_argument("--max-tokens", type=int, default=64,
+                   help="tokens to generate (default 64)")
+    g.add_argument("--temperature", type=float, default=0.0,
+                   help="sampling temperature; 0 = greedy (default)")
+    g.add_argument("--top-k", type=int, default=0,
+                   help="keep only the k highest-probability tokens "
+                   "(0 = off)")
+    g.add_argument("--top-p", type=float, default=1.0,
+                   help="nucleus sampling mass (1.0 = off)")
+    g.add_argument("--seed", type=int, default=0,
+                   help="sampling seed — same seed, same text")
+    g.add_argument("--page-size", type=int, default=16,
+                   help="KV-cache page size in tokens (transformer path; "
+                   "auto-shrunk for short position tables)")
+    g.set_defaults(fn=cmd_generate)
 
     s = sub.add_parser("summary", help="model + memory summary")
     s.add_argument("--model", required=True)

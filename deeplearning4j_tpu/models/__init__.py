@@ -15,7 +15,8 @@ from .resnet50 import ResNet50
 from .darknet19 import Darknet19
 from .tinyyolo import TinyYOLO
 from .textgen_lstm import TextGenerationLSTM
-from .transformer import TransformerLM, TransformerBlock, PositionalEmbedding
+from .transformer import (TransformerLM, TransformerBlock,
+                          PositionalEmbedding, TransformerDecodeAdapter)
 from .googlenet import GoogLeNet
 from .inception_resnet_v1 import InceptionResNetV1
 from .facenet_nn4 import FaceNetNN4Small2

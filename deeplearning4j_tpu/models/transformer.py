@@ -81,6 +81,30 @@ def block_apply(p: Dict[str, Array], h: Array, n_heads: int, *,
     return h
 
 
+def block_kv_project(p: Dict[str, Array], h: Array,
+                     n_heads: int) -> tuple:
+    """First half of the pre-LN block, split out for the decode path
+    (serving/decode.py): q/k/v head projections of LN(h), so the caller
+    can write k/v into the paged cache BEFORE attention runs against the
+    gathered full-length view (ops/kv_cache.py).  Returns (q, k, v) as
+    [B, H, T, d_head]."""
+    u = layer_norm(h, p["ln1_g"], p["ln1_b"])
+    return (split_heads(u @ p["Wq"], n_heads),
+            split_heads(u @ p["Wk"], n_heads),
+            split_heads(u @ p["Wv"], n_heads))
+
+
+def block_finish(p: Dict[str, Array], h: Array, att_heads: Array) -> Array:
+    """Second half of the pre-LN block: output projection + residual +
+    FFN.  Same math as the tail of ``block_apply`` (psum-free single-
+    device form); the decode prefill/step/re-encode paths all share it
+    so their per-position bits agree by construction."""
+    h = h + (merge_heads(att_heads) @ p["Wo"] + p["bo"])
+    u = layer_norm(h, p["ln2_g"], p["ln2_b"])
+    f = jax.nn.gelu(u @ p["W1"] + p["b1"])
+    return h + f @ p["W2"] + p["b2"]
+
+
 @register_layer
 @dataclasses.dataclass
 class TransformerBlock(Layer):
@@ -170,3 +194,135 @@ def TransformerLM(vocab_size: int = 256, n_layers: int = 4, d_model: int = 256,
     net = MultiLayerNetwork(b.build())
     net.init()
     return net
+
+
+class TransformerDecodeAdapter:
+    """Serve a single-chip ``TransformerLM`` MultiLayerNetwork through
+    ``serving.DecodeEngine``: the same ``params`` + ``decode_program()``
+    surface ShardedTransformerLM exposes, built from the MLN layer stack
+    (EmbeddingSequence, PositionalEmbedding, TransformerBlock × N,
+    RnnOutputLayer).  The program's three closures (prefill / step /
+    re-encode) share every per-position op — embedding lookup, position
+    add, block_kv_project/block_finish, the pre-softmax head — and
+    ops/kv_cache.det_attention, so incremental logits are BIT-identical
+    to re-encoding the same tokens.  The wrapped network itself is
+    untouched: its one-shot ``output``/``predict`` path keeps its own
+    jit programs (the no-behavior-change regression in
+    tests/test_decode.py)."""
+
+    def __init__(self, net: MultiLayerNetwork):
+        layers = net.conf.layers
+        ok = (len(layers) >= 4
+              and isinstance(layers[0], EmbeddingSequence)
+              and isinstance(layers[1], PositionalEmbedding)
+              and all(isinstance(l, TransformerBlock) for l in layers[2:-1])
+              and isinstance(layers[-1], RnnOutputLayer))
+        if not ok:
+            raise ValueError(
+                "TransformerDecodeAdapter needs the TransformerLM stack "
+                "(EmbeddingSequence, PositionalEmbedding, TransformerBlock "
+                "x N, RnnOutputLayer); got "
+                + ", ".join(type(l).__name__ for l in layers))
+        cd = getattr(net.conf, "compute_dtype", None)
+        if cd is not None and jnp.dtype(cd) != jnp.float32:
+            raise NotImplementedError(
+                "decode serves the f32 params path; compute_dtype "
+                f"{cd!r} would break the re-encode bit-identity contract")
+        self.net = net
+        self._embed_lay = layers[0]
+        self._out_lay = layers[-1]
+        self._n_blocks = len(layers) - 3
+        self.n_heads = int(layers[2].n_heads)
+        self.vocab_size = int(self._out_lay.n_out)
+        self.params = {
+            "embed": net.params[0], "pos": net.params[1],
+            "blocks": [net.params[2 + i] for i in range(self._n_blocks)],
+            "head": net.params[len(layers) - 1],
+        }
+
+    def decode_program(self, page_size: int = 16,
+                       max_len: Optional[int] = None):
+        from ..ops.kv_cache import (
+            NEG_INF, DecodeProgram, det_attention, gather_layer,
+            write_prefill, write_step,
+        )
+
+        pos_rows = int(self.params["pos"]["P"].shape[0])
+        if max_len is None:
+            max_len = (pos_rows // page_size) * page_size
+        if max_len % page_size or not (0 < max_len <= pos_rows):
+            raise ValueError(
+                f"max_len {max_len} must be a positive multiple of "
+                f"page_size {page_size} and <= the position table "
+                f"({pos_rows})")
+        L = int(max_len)
+        n_heads = self.n_heads
+        n_layers = self._n_blocks
+        embed_lay, out_lay = self._embed_lay, self._out_lay
+        d_model = int(self.params["embed"]["W"].shape[1])
+
+        def tok_embed(params, idx):
+            y = params["embed"]["W"][idx]
+            if embed_lay.has_bias:
+                y = y + params["embed"]["b"]
+            return embed_lay._act(y)
+
+        def head(params, h):
+            y = h @ params["head"]["W"]
+            if out_lay.has_bias:
+                y = y + params["head"]["b"]
+            return y          # pre-softmax logits (RnnOutputLayer._pre)
+
+        def prefill(params, k_pages, v_pages, page_table_row, tokens, n_real):
+            tb = tokens.shape[0]
+            h = (tok_embed(params, tokens) + params["pos"]["P"][:tb])[None]
+            bias = jnp.where(
+                jnp.arange(L, dtype=jnp.int32)[None, :]
+                <= jnp.arange(tb, dtype=jnp.int32)[:, None],
+                0.0, NEG_INF)[None, None]
+            pt = page_table_row[None]
+            for i, bp in enumerate(params["blocks"]):
+                q, k, v = block_kv_project(bp, h, n_heads)
+                k_pages = write_prefill(k_pages, i, page_table_row,
+                                        k.transpose(0, 2, 1, 3)[0])
+                v_pages = write_prefill(v_pages, i, page_table_row,
+                                        v.transpose(0, 2, 1, 3)[0])
+                k_all = gather_layer(k_pages, i, pt).transpose(0, 2, 1, 3)
+                v_all = gather_layer(v_pages, i, pt).transpose(0, 2, 1, 3)
+                h = block_finish(bp, h, det_attention(q, k_all, v_all, bias))
+            return k_pages, v_pages, head(params, h)[0, n_real - 1]
+
+        def step(params, k_pages, v_pages, page_table, tokens, positions,
+                 active):
+            h = (tok_embed(params, tokens)
+                 + params["pos"]["P"][positions])[:, None]
+            bias = jnp.where(
+                jnp.arange(L, dtype=jnp.int32)[None, :]
+                <= positions[:, None], 0.0, NEG_INF)[:, None, None, :]
+            pt = jnp.where(active[:, None], page_table, 0)
+            for i, bp in enumerate(params["blocks"]):
+                q, k, v = block_kv_project(bp, h, n_heads)
+                k_pages = write_step(k_pages, i, pt, positions, k[:, :, 0])
+                v_pages = write_step(v_pages, i, pt, positions, v[:, :, 0])
+                k_all = gather_layer(k_pages, i, pt).transpose(0, 2, 1, 3)
+                v_all = gather_layer(v_pages, i, pt).transpose(0, 2, 1, 3)
+                h = block_finish(bp, h, det_attention(q, k_all, v_all, bias))
+            return k_pages, v_pages, head(params, h)[:, 0]
+
+        def reencode(params, tokens):
+            b, t = tokens.shape
+            h = tok_embed(params, tokens) + params["pos"]["P"][:t]
+            bias = jnp.where(
+                jnp.arange(t, dtype=jnp.int32)[None, :]
+                <= jnp.arange(t, dtype=jnp.int32)[:, None],
+                0.0, NEG_INF)[None, None]
+            for bp in params["blocks"]:
+                q, k, v = block_kv_project(bp, h, n_heads)
+                h = block_finish(bp, h, det_attention(q, k, v, bias))
+            return head(params, h)
+
+        return DecodeProgram(
+            prefill=prefill, step=step, reencode=reencode,
+            n_layers=n_layers, n_heads=n_heads, d_head=d_model // n_heads,
+            vocab_size=self.vocab_size, max_len=L, page_size=page_size,
+            pages_per_slot=L // page_size)

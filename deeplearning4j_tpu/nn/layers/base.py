@@ -75,6 +75,8 @@ def config_to_dict(obj: Any) -> Any:
 #: on first deserialization so saved models load in fresh processes
 _LAZY_CONFIG_PROVIDERS = {
     "MoE": "deeplearning4j_tpu.parallel.moe",
+    "TransformerBlock": "deeplearning4j_tpu.models.transformer",
+    "PositionalEmbedding": "deeplearning4j_tpu.models.transformer",
 }
 
 

@@ -1,0 +1,171 @@
+"""Paged KV-cache + deterministic attention for autoregressive decode.
+
+The decode engine (serving/decode.py) keeps per-request K/V as explicit
+JAX carry state in a **page pool**: one global buffer per layer holding
+``n_pages`` fixed-size pages, with a per-slot **page table** of pool
+indices.  A request's cache is the pages its table row points at —
+allocation/free is host-side free-list bookkeeping (serving/decode.py),
+never a device reshape.  A ring buffer is the degenerate case
+(``pages_per_slot * page_size`` contiguous pages per slot, never freed
+early); the paged layout additionally lets a pool smaller than
+``max_slots * pages_per_slot`` oversubscribe slots when request lengths
+vary, returning a finished request's pages to the free list the moment
+it stops (EOS / max-tokens / deadline).
+
+Page id 0 is the **scratch page** by convention: inactive slots' page-
+table rows are all-zero, so the fixed-shape decode step can write every
+slot unconditionally (no dynamic shapes, zero recompiles) while masked
+slots' writes land in scratch and are never read unmasked.
+
+Why a dedicated attention formulation instead of ops/attention.mha:
+the A/B contract (bench ``continuous_batching_ab``) requires per-token
+logits **bit-identical** between the incremental decode path (one query
+row against the cache) and a full re-encode (all rows at once).  On
+XLA, ``X @ W`` against a shared 2D weight is bitwise independent of the
+number of rows — but dot-general attention scores are NOT: lowering
+changes with the query count, so row k of a [T,L] score matrix differs
+in final ulps from the same row computed alone.  ``det_attention``
+therefore computes scores and the weighted sum as broadcast-multiply +
+reduce over a trailing axis, whose per-element reduction is independent
+of the leading (query) shape, and always attends over the same fixed
+key length ``L`` (the slot capacity) with additive ``NEG_INF`` masking
+— exp underflows to exact 0.0 for masked keys, and ``0.0 * v`` terms
+cannot perturb the sum.  Both the decode path and the re-encode
+reference use these functions, so bit-identity is structural.  The
+price is an O(T·L·d) materialized product instead of an MXU dot — the
+right trade for correctness-gated decode; the training path keeps the
+flash/mha kernels.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .attention import _NEG_INF
+
+Array = jax.Array
+
+NEG_INF = _NEG_INF  # shared masking convention with ops/attention.py
+
+SCRATCH_PAGE = 0    # pool page 0: write target for masked-out slots
+
+
+class KVCache(NamedTuple):
+    """Device carry state: the page pools for K and V.
+
+    ``k_pages`` / ``v_pages``: [n_layers, n_pages, page_size, n_heads,
+    d_head].  Page tables and sequence positions live host-side in the
+    decode engine (tiny int arrays passed per call).
+    """
+
+    k_pages: Array
+    v_pages: Array
+
+
+def alloc_cache(n_layers: int, n_pages: int, page_size: int, n_heads: int,
+                d_head: int, dtype=jnp.float32) -> KVCache:
+    """Zero-filled pool.  ``n_pages`` INCLUDES the scratch page 0."""
+    shape = (n_layers, n_pages, page_size, n_heads, d_head)
+    return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def pages_for(n_tokens: int, page_size: int) -> int:
+    """Pages a request of ``n_tokens`` total (prompt + generated) needs."""
+    return max(1, math.ceil(n_tokens / page_size))
+
+
+# -- pool read/write (pure; all shapes static) ----------------------------
+
+
+def write_prefill(pages: Array, layer: int, page_table_row: Array,
+                  kv: Array) -> Array:
+    """Scatter a prompt's projected rows into one slot's pages.
+
+    ``page_table_row`` [pages_per_slot] int32, ``kv`` [T, H, d] written
+    at positions 0..T-1.  Positions beyond the prompt's real length are
+    garbage-but-finite and masked by the step bias until overwritten by
+    the decode steps that reach them.
+    """
+    t = kv.shape[0]
+    page_size = pages.shape[2]
+    pos = jnp.arange(t, dtype=jnp.int32)
+    page_idx = page_table_row[pos // page_size]
+    return pages.at[layer, page_idx, pos % page_size].set(kv)
+
+
+def write_step(pages: Array, layer: int, page_table: Array, positions: Array,
+               kv: Array) -> Array:
+    """Scatter one token per slot: ``page_table`` [S, pages_per_slot],
+    ``positions`` [S], ``kv`` [S, H, d].  Masked slots are routed to the
+    scratch page by the caller (their table rows are zeroed)."""
+    page_size = pages.shape[2]
+    s = jnp.arange(page_table.shape[0], dtype=jnp.int32)
+    page_idx = page_table[s, positions // page_size]
+    return pages.at[layer, page_idx, positions % page_size].set(kv)
+
+
+def gather_layer(pages: Array, layer: int, page_table: Array) -> Array:
+    """[S, pages_per_slot] table -> [S, L, H, d] contiguous view of one
+    layer's cached rows (L = pages_per_slot * page_size)."""
+    g = pages[layer][page_table]          # [S, pps, page, H, d]
+    s, pps, page, h, d = g.shape
+    return g.reshape(s, pps * page, h, d)
+
+
+# -- deterministic attention ----------------------------------------------
+
+
+def det_scores(q: Array, k: Array) -> Array:
+    """[B,H,Tq,d] x [B,H,L,d] -> [B,H,Tq,L] via broadcast-multiply +
+    trailing-axis reduce: per-element bits independent of Tq (a
+    dot-general's are not — see module docstring)."""
+    return jnp.sum(q[:, :, :, None, :] * k[:, :, None, :, :], axis=-1)
+
+
+def det_weighted_sum(p: Array, v: Array) -> Array:
+    """[B,H,Tq,L] x [B,H,L,d] -> [B,H,Tq,d]; exact-zero weights (masked
+    keys) contribute exact zeros regardless of the garbage in v."""
+    return jnp.sum(p[:, :, :, :, None] * v[:, :, None, :, :], axis=-2)
+
+
+def det_attention(q: Array, k: Array, v: Array, bias: Array) -> Array:
+    """Row-bitwise-deterministic attention over a FIXED key length.
+
+    ``q`` [B,H,Tq,d]; ``k``/``v`` [B,H,L,d]; ``bias`` broadcastable to
+    [B,H,Tq,L] with 0 on visible keys and ``NEG_INF`` elsewhere.  Every
+    caller (prefill / decode step / re-encode reference) must use the
+    same L so the softmax reduces over identical row lengths.
+    """
+    scale = 1.0 / math.sqrt(q.shape[-1])
+    s = det_scores(q, k) * scale + bias
+    p = jax.nn.softmax(s, axis=-1)
+    return det_weighted_sum(p, v)
+
+
+class DecodeProgram(NamedTuple):
+    """The pure functions + static config a model hands the decode
+    engine (``ShardedTransformerLM.decode_program()``).  All fns are
+    shape-polymorphic; the engine fixes shapes at AOT-warmup time.
+
+      prefill(params, k_pages, v_pages, page_table_row, tokens, n_real)
+          -> (k_pages, v_pages, logits [V])   one slot, bucketed length
+      step(params, k_pages, v_pages, page_table, tokens, positions,
+           active) -> (k_pages, v_pages, logits [S, V])   all slots
+      reencode(params, tokens [B, L]) -> logits [B, L, V]
+          the full-forward reference the bit-identity gate compares to
+    """
+
+    prefill: Callable[..., Any]
+    step: Callable[..., Any]
+    reencode: Callable[..., Any]
+    n_layers: int
+    n_heads: int
+    d_head: int
+    vocab_size: int
+    max_len: int            # L: fixed key length = pages_per_slot * page_size
+    page_size: int
+    pages_per_slot: int

@@ -297,3 +297,122 @@ class ShardedTransformerLM:
         tokens = jax.device_put(jnp.asarray(tokens, jnp.int32), self.token_sharding)
         with set_mesh(self.mesh):
             return self._jit_logits(self.params, tokens)
+
+    # -- autoregressive decode (serving/decode.py) -------------------------
+
+    def decode_program(self, page_size: int = 16,
+                       max_len: Optional[int] = None):
+        """Pure prefill / decode-step / re-encode functions over the
+        paged KV-cache (ops/kv_cache.py) for the serving decode engine.
+
+        The decode path is a different execution mode from training —
+        stateful, one query row per step — but shares the block weights
+        and the block math split (models/transformer.block_kv_project /
+        block_finish), and uses ops/kv_cache.det_attention so the
+        incremental logits are BIT-identical to ``reencode`` of the same
+        tokens (the ``continuous_batching_ab`` gate).  Single-program
+        serving only: requires an unsharded mesh (multi-chip decode —
+        sharded pages + collective attention — is the ROADMAP stretch).
+        """
+        from ..models.transformer import block_finish, block_kv_project
+        from ..nn.layers.normalization import layer_norm
+        from ..ops.kv_cache import (
+            NEG_INF, DecodeProgram, det_attention, gather_layer,
+            write_prefill, write_step,
+        )
+
+        if int(np.prod(list(self.mesh.shape.values()))) != 1:
+            raise NotImplementedError(
+                "decode_program requires an unsharded (single-device) "
+                f"mesh; got {dict(self.mesh.shape)}")
+        if self.compute_dtype is not None:
+            raise NotImplementedError(
+                "decode_program serves the f32 params path; compute_dtype "
+                "casting would break the re-encode bit-identity contract")
+        pos_rows = int(self.params["pos"].shape[0])
+        if max_len is None:
+            max_len = (pos_rows // page_size) * page_size
+        if max_len % page_size or not (0 < max_len <= pos_rows):
+            raise ValueError(
+                f"max_len {max_len} must be a positive multiple of "
+                f"page_size {page_size} and <= the position table "
+                f"({pos_rows})")
+        L = int(max_len)
+        n_heads = self.n_heads
+        n_layers = int(jax.tree_util.tree_leaves(
+            self.params["blocks"])[0].shape[0])
+        d_model = int(self.params["embed"].shape[1])
+
+        def _blocks(params):
+            return [jax.tree_util.tree_map(lambda a: a[i], params["blocks"])
+                    for i in range(n_layers)]
+
+        def prefill(params, k_pages, v_pages, page_table_row, tokens, n_real):
+            """One slot's prompt (bucket length Tb) -> cache writes for
+            positions 0..Tb-1 plus the last REAL position's logits.
+            Pad-position K/V rows are garbage-but-finite; the step bias
+            masks them until a decode step overwrites each one."""
+            tb = tokens.shape[0]
+            h = (params["embed"][tokens] + params["pos"][:tb])[None]
+            bias = jnp.where(
+                jnp.arange(L, dtype=jnp.int32)[None, :]
+                <= jnp.arange(tb, dtype=jnp.int32)[:, None],
+                0.0, NEG_INF)[None, None]              # [1,1,Tb,L]
+            pt = page_table_row[None]
+            for i, bp in enumerate(_blocks(params)):
+                q, k, v = block_kv_project(bp, h, n_heads)  # [1,H,Tb,dh]
+                k_pages = write_prefill(k_pages, i, page_table_row,
+                                        k.transpose(0, 2, 1, 3)[0])
+                v_pages = write_prefill(v_pages, i, page_table_row,
+                                        v.transpose(0, 2, 1, 3)[0])
+                k_all = gather_layer(k_pages, i, pt).transpose(0, 2, 1, 3)
+                v_all = gather_layer(v_pages, i, pt).transpose(0, 2, 1, 3)
+                h = block_finish(bp, h, det_attention(q, k_all, v_all, bias))
+            h = layer_norm(h, params["lnf_g"], params["lnf_b"])
+            return k_pages, v_pages, (h @ params["head"])[0, n_real - 1]
+
+        def step(params, k_pages, v_pages, page_table, tokens, positions,
+                 active):
+            """One fixed-shape decode step over ALL slots ([S] inputs):
+            masked slots' writes are routed to the scratch page (their
+            table rows are zeroed here), so one compiled program serves
+            any active subset — the zero-recompile contract continuous
+            batching rides on."""
+            h = (params["embed"][tokens]
+                 + params["pos"][positions])[:, None]   # [S,1,D]
+            bias = jnp.where(
+                jnp.arange(L, dtype=jnp.int32)[None, :]
+                <= positions[:, None], 0.0, NEG_INF)[:, None, None, :]
+            pt = jnp.where(active[:, None], page_table, 0)
+            for i, bp in enumerate(_blocks(params)):
+                q, k, v = block_kv_project(bp, h, n_heads)  # [S,H,1,dh]
+                k_pages = write_step(k_pages, i, pt, positions, k[:, :, 0])
+                v_pages = write_step(v_pages, i, pt, positions, v[:, :, 0])
+                k_all = gather_layer(k_pages, i, pt).transpose(0, 2, 1, 3)
+                v_all = gather_layer(v_pages, i, pt).transpose(0, 2, 1, 3)
+                h = block_finish(bp, h, det_attention(q, k_all, v_all, bias))
+            h = layer_norm(h, params["lnf_g"], params["lnf_b"])
+            return k_pages, v_pages, (h @ params["head"])[:, 0]
+
+        def reencode(params, tokens):
+            """Full forward at the SAME fixed length L with the SAME
+            deterministic attention — the naive-baseline arm and the
+            bit-identity oracle.  ``tokens`` [B, L]; row p of the output
+            is the next-token logits after position p."""
+            b, t = tokens.shape
+            h = params["embed"][tokens] + params["pos"][:t]
+            bias = jnp.where(
+                jnp.arange(t, dtype=jnp.int32)[None, :]
+                <= jnp.arange(t, dtype=jnp.int32)[:, None],
+                0.0, NEG_INF)[None, None]
+            for bp in _blocks(params):
+                q, k, v = block_kv_project(bp, h, n_heads)
+                h = block_finish(bp, h, det_attention(q, k, v, bias))
+            h = layer_norm(h, params["lnf_g"], params["lnf_b"])
+            return h @ params["head"]
+
+        return DecodeProgram(
+            prefill=prefill, step=step, reencode=reencode,
+            n_layers=n_layers, n_heads=n_heads, d_head=d_model // n_heads,
+            vocab_size=self.vocab_size, max_len=L, page_size=page_size,
+            pages_per_slot=L // page_size)

@@ -19,6 +19,12 @@ remains as a thin back-compat shim over this engine).  Pieces:
   metrics.py   fixed-bucket latency histograms + counters (incl. retry/
                respawn/circuit/canary/poison), exported on ui/server.py's
                /metrics endpoint (health on /healthz)
+  decode.py    autoregressive decode engine for the transformer LM:
+               paged KV-cache (ops/kv_cache.py), bucketed prefill/decode
+               split, iteration-level continuous batching, seeded
+               deterministic sampling, per-request stop conditions,
+               crash-retry/poison-isolation/hot-swap decode-shaped;
+               TTFT + time-per-output-token first-class (DecodeMetrics)
 
 Reference lineage: DL4J's ParallelInference BATCHED mode + the model-
 server role; design cf. the serving sections of "TensorFlow: A system
@@ -27,19 +33,22 @@ See docs/SERVING.md.
 """
 
 from .batcher import (
-    ADMISSION_POLICIES, DeadlineExceededError, DynamicBatcher,
-    OverloadedError, pow2_buckets,
+    ADMISSION_POLICIES, ContinuousBatcher, DeadlineExceededError,
+    DynamicBatcher, OverloadedError, pow2_buckets,
 )
+from .decode import DecodeEngine, GenerationResult
 from .engine import (
     Engine, PoisonInputError, ReplicaCrashError, ReplicaHungError,
     ServingUnavailableError,
 )
-from .metrics import LatencyHistogram, ServingMetrics
+from .metrics import DecodeMetrics, LatencyHistogram, ServingMetrics
 from .registry import ModelRegistry
 
 __all__ = [
-    "ADMISSION_POLICIES", "DeadlineExceededError", "DynamicBatcher",
-    "Engine", "LatencyHistogram", "ModelRegistry", "OverloadedError",
-    "PoisonInputError", "ReplicaCrashError", "ReplicaHungError",
-    "ServingMetrics", "ServingUnavailableError", "pow2_buckets",
+    "ADMISSION_POLICIES", "ContinuousBatcher", "DeadlineExceededError",
+    "DecodeEngine", "DecodeMetrics", "DynamicBatcher", "Engine",
+    "GenerationResult", "LatencyHistogram", "ModelRegistry",
+    "OverloadedError", "PoisonInputError", "ReplicaCrashError",
+    "ReplicaHungError", "ServingMetrics", "ServingUnavailableError",
+    "pow2_buckets",
 ]

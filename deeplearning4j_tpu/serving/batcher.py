@@ -50,7 +50,7 @@ class OverloadedError(RuntimeError):
 
 class _Request:
     __slots__ = ("x", "rows", "future", "t_submit", "deadline",
-                 "retries", "tried")
+                 "retries", "tried", "payload")
 
     def __init__(self, x: np.ndarray, future: Future, t_submit: float,
                  deadline: float):
@@ -61,6 +61,7 @@ class _Request:
         self.deadline = deadline
         self.retries = 0          # failure-isolation retries consumed
         self.tried = set()        # replica indices that failed this request
+        self.payload = None       # decode-path request spec (ContinuousBatcher)
 
 
 def pow2_buckets(max_batch: int) -> List[int]:
@@ -273,3 +274,88 @@ class DynamicBatcher:
                             RuntimeError("serving engine is shut down"))
             self._nonempty.notify_all()
             self._space.notify_all()
+
+
+class ContinuousBatcher(DynamicBatcher):
+    """Iteration-level admission for the decode engine (serving/decode.py).
+
+    The one-shot ``DynamicBatcher`` forms a batch and hands it over
+    whole; a decode batch instead runs for many steps, and NEW requests
+    must join it at the next step boundary rather than waiting for the
+    running batch to drain.  So instead of ``next_batch()`` this front
+    door exposes ``admit(limit)`` — a non-blocking pop of up to
+    ``limit`` requests, called by the decode loop between steps —
+    while keeping the parent's admission control (bounded queue,
+    block/shed overload policy), queued-deadline fail-fast, and
+    injectable clock.  Requests carry an opaque ``payload`` (the
+    generation spec) instead of an input array.
+    """
+
+    def submit_request(self, payload, slo_ms: Optional[float] = None,
+                       deadline: Optional[float] = None) -> Future:
+        """Enqueue one decode request; same admission semantics as
+        ``DynamicBatcher.submit`` (shed raises ``OverloadedError``
+        synchronously, closed fails the future deterministically)."""
+        fut: Future = Future()
+        now = self.clock()
+        dl = deadline if deadline is not None else now + (
+            slo_ms if slo_ms is not None else self.slo_ms) / 1000.0
+        with self._lock:
+            if self._closed:
+                fut.set_exception(RuntimeError("serving engine is shut down"))
+                return fut
+            if len(self._pending) >= self.max_queue:
+                if self.admission == "shed":
+                    if self.metrics:
+                        self.metrics.inc("shed")
+                    raise OverloadedError(
+                        f"admission queue full ({self.max_queue} requests); "
+                        "policy=shed")
+                while len(self._pending) >= self.max_queue and not self._closed:
+                    self._space.wait(timeout=0.1)
+                if self._closed:
+                    fut.set_exception(
+                        RuntimeError("serving engine is shut down"))
+                    return fut
+            r = _Request(np.empty((1, 0), np.float32), fut, now, dl)
+            r.payload = payload
+            self._pending.append(r)
+            self._nonempty.notify()
+        return fut
+
+    def admit(self, limit: int) -> List[_Request]:
+        """Pop up to ``limit`` queued requests (0 when idle) — called at
+        every decode-step boundary.  Expired requests fail fast first,
+        exactly as in the one-shot path."""
+        if limit <= 0:
+            return []
+        with self._lock:
+            self._expire_locked(self.clock())
+            out: List[_Request] = []
+            while self._pending and len(out) < limit:
+                out.append(self._pending.popleft())
+            if out:
+                self._space.notify_all()
+            return out
+
+    def requeue_front(self, r: _Request) -> None:
+        """Put a request back at the head of the queue — admission
+        raced ahead of capacity (no free pages/slot) or its replica
+        crashed mid-decode and it has retry budget left."""
+        with self._lock:
+            if self._closed:
+                if not r.future.done():
+                    r.future.set_exception(
+                        RuntimeError("serving engine is shut down"))
+                return
+            self._pending.appendleft(r)
+            self._nonempty.notify()
+
+    def wait_for_work(self, timeout: float = 0.05) -> bool:
+        """Park the decode loop until a request is queued (or timeout /
+        close).  Returns True when work is pending."""
+        with self._lock:
+            if self._pending or self._closed:
+                return bool(self._pending)
+            self._nonempty.wait(timeout=timeout)
+            return bool(self._pending)

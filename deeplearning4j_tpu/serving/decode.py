@@ -1,0 +1,760 @@
+"""Autoregressive decode engine: paged KV-cache + continuous batching.
+
+A genuinely different execution mode from the one-shot ``Engine``:
+stateful (the KV-cache carries across steps), multi-step (one request
+spans many device dispatches), and shape-bucketed in TWO dimensions
+(prompt length at prefill, slot count at decode).  The design:
+
+  prefill/decode split
+      A request's prompt runs ONCE through a bucketed prefill program
+      (one AOT-compiled executable per prompt bucket) that writes K/V
+      for every prompt position into the request's cache pages and
+      samples the first token — so TTFT is one prefill dispatch, not
+      ``n_prompt`` decode steps.  After that, every token costs one
+      fixed-shape decode step.
+
+  iteration-level continuous batching
+      The decode step always runs over ALL ``max_slots`` slots with an
+      active mask (masked slots write to the scratch page — see
+      ops/kv_cache.py), so its compiled shape never changes and a new
+      request can join the running batch at the NEXT step boundary
+      (``ContinuousBatcher.admit``) instead of waiting for the batch to
+      drain.  Zero serve-time compiles is therefore structural: the
+      serve path only ever calls executables built at ``load()``
+      (``compile_cache_size()`` is the witness, same contract as the
+      one-shot engine).
+
+  per-request stop conditions
+      EOS / max-tokens / deadline are checked after every sampled
+      token; a stopped request resolves immediately and its cache pages
+      go back to the free list the same step — the pool oversubscribes
+      slots when request lengths vary.
+
+  resilience (the PR-7 supervisor patterns, decode-shaped)
+      A crash anywhere in the decode loop fails or RETRIES every
+      in-flight request (sampling is seeded + counter-based, so a retry
+      regenerates the identical sequence), resets the pool, and keeps
+      serving; a supervisor thread respawns the loop if it dies
+      outright.  Poison isolation is per-slot: non-finite logits fail
+      only that slot's request (its pages are scrubbed — a NaN left in
+      a freed page would contaminate the next tenant), co-batched slots
+      never notice.  Every future resolves on every path.
+
+  hot-swap without version mixing
+      ``swap_model`` flips the tag NEW admissions use; in-flight slots
+      keep decoding under the version that prefilled them (the decode
+      step runs once per distinct active tag — same executable,
+      different params), so no request ever mixes versions and a swap
+      never stalls the batch.  ``attach_registry`` wires this to
+      ``ModelRegistry.set_alias``.
+
+Sampling is greedy / temperature / top-k / top-p, seeded and
+deterministic: the PRNG key is ``fold_in(PRNGKey(seed), token_index)``,
+so a sequence is a pure function of (params, prompt, sampling spec) —
+the property the retry path and the A/B bit-identity gate both lean on.
+
+TTFT and time-per-output-token are first-class (``DecodeMetrics``,
+``serve/prefill`` / ``serve/decode_step`` spans — docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..obs import trace as obs_trace
+from .batcher import ContinuousBatcher, pow2_buckets
+from .engine import PoisonInputError, ReplicaCrashError, _fail_safe, _set_safe
+from .metrics import DecodeMetrics
+
+FINISH_REASONS = ("eos", "max_tokens", "deadline")
+
+
+@dataclass
+class GenerationResult:
+    """One finished generation.  ``tokens`` are the GENERATED ids only
+    (prompt excluded; a terminating EOS is included).  ``logits`` is
+    [n_tokens, vocab] float32 when the request asked ``echo_logits``
+    (the bit-identity gate's evidence), else None."""
+
+    tokens: List[int]
+    n_prompt: int
+    finish_reason: str
+    model_tag: str
+    ttft_ms: float
+    tpot_ms: Optional[float]
+    logits: Optional[np.ndarray] = None
+
+
+@dataclass(frozen=True)
+class _GenSpec:
+    """Immutable request payload — a crash-retry re-runs exactly this."""
+
+    prompt: np.ndarray
+    max_new: int
+    temperature: float
+    top_k: int
+    top_p: float
+    seed: int
+    echo_logits: bool
+
+
+class _Slot:
+    """Host-side state of one occupied decode slot."""
+
+    __slots__ = ("req", "spec", "tag", "page_ids", "n_prompt", "pos",
+                 "last_token", "tokens", "n_out", "max_new", "deadline",
+                 "t_first", "t_last", "logits")
+
+    def __init__(self, req, tag: str, page_ids: List[int], max_new: int):
+        self.req = req
+        self.spec = req.payload
+        self.tag = tag
+        self.page_ids = page_ids
+        self.n_prompt = int(self.spec.prompt.shape[0])
+        self.pos = self.n_prompt      # where the NEXT input token lands
+        self.last_token = 0
+        self.tokens: List[int] = []
+        self.n_out = 0
+        self.max_new = max_new
+        self.deadline = req.deadline
+        self.t_first = 0.0
+        self.t_last = 0.0
+        self.logits: Optional[List[np.ndarray]] = \
+            [] if self.spec.echo_logits else None
+
+
+def _make_samplers(vocab_size: int):
+    """(sample_one, sample_batch) pure fns.  Deterministic: the key is
+    ``fold_in(PRNGKey(seed), step)`` — same (seed, step) → same draw.
+    temperature <= 0 is greedy; top_k == 0 and top_p >= 1 disable those
+    filters.  Also returns the all-finite flag the poison check reads.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def sample_one(lg, t, k, p, seed, step):
+        finite = jnp.all(jnp.isfinite(lg))
+        greedy = jnp.argmax(lg).astype(jnp.int32)
+        scaled = lg / jnp.maximum(t, 1e-6)
+        srt = jnp.sort(scaled)[::-1]
+        kk = jnp.clip(jnp.where(k > 0, k, vocab_size), 1, vocab_size)
+        thr_k = srt[kk - 1]
+        probs = jax.nn.softmax(srt)
+        cum_excl = jnp.cumsum(probs) - probs   # mass BEFORE each entry
+        keep = cum_excl < jnp.clip(p, 1e-6, 1.0)  # top-1 always kept
+        thr_p = jnp.min(jnp.where(keep, srt, jnp.inf))
+        thr = jnp.maximum(thr_k, thr_p)
+        masked = jnp.where(scaled >= thr, scaled, -jnp.inf)
+        g = jax.random.gumbel(
+            jax.random.fold_in(jax.random.PRNGKey(seed), step), lg.shape)
+        sampled = jnp.argmax(masked + g).astype(jnp.int32)
+        return jnp.where(t <= 0.0, greedy, sampled), finite
+
+    def sample_batch(lgs, ts, ks, ps, seeds, steps):
+        return jax.vmap(sample_one)(lgs, ts, ks, ps, seeds, steps)
+
+    return sample_one, sample_batch
+
+
+class DecodeEngine:
+    """``DecodeEngine(lm).load()`` then ``generate(prompt_ids, ...)``.
+
+    ``model`` provides ``decode_program()`` (ShardedTransformerLM) — the
+    pure prefill/step/re-encode functions of ops/kv_cache.DecodeProgram.
+    ``clock`` is injectable (monotonic seconds) so deadline/TTFT logic
+    is testable without sleeping.
+    """
+
+    def __init__(self, model, *, max_slots: int = 4, page_size: int = 16,
+                 max_len: Optional[int] = None,
+                 total_pages: Optional[int] = None,
+                 prompt_buckets: Optional[Sequence[int]] = None,
+                 eos_id: Optional[int] = None, slo_ms: float = 30_000.0,
+                 max_queue: int = 256, admission: str = "block",
+                 max_retries: int = 1, default_max_new: int = 32,
+                 clock=time.monotonic, tag: str = "v0",
+                 metrics: Optional[DecodeMetrics] = None):
+        if max_slots < 1:
+            raise ValueError("max_slots must be >= 1")
+        self.program = model.decode_program(page_size=page_size,
+                                            max_len=max_len)
+        prog = self.program
+        self.max_slots = int(max_slots)
+        self.eos_id = eos_id
+        self.max_retries = int(max_retries)
+        self.default_max_new = int(default_max_new)
+        self.clock = clock
+        self.total_pages = int(
+            total_pages if total_pages is not None
+            else 1 + self.max_slots * prog.pages_per_slot)
+        if self.total_pages < 1 + prog.pages_per_slot:
+            raise ValueError(
+                f"total_pages {self.total_pages} cannot hold even one "
+                f"full-length request ({prog.pages_per_slot} pages) plus "
+                "the scratch page")
+        self.metrics = metrics or DecodeMetrics()
+        self.batcher = ContinuousBatcher(
+            max_batch=self.max_slots, slo_ms=slo_ms, max_queue=max_queue,
+            admission=admission, metrics=self.metrics, clock=clock)
+        buckets = sorted(set(int(b) for b in (prompt_buckets
+                                              or pow2_buckets(prog.max_len))))
+        self.prompt_buckets = [b for b in buckets if 0 < b <= prog.max_len]
+        if not self.prompt_buckets:
+            raise ValueError("no prompt bucket <= max_len "
+                             f"{prog.max_len}: {buckets}")
+        self.max_prompt = min(self.prompt_buckets[-1], prog.max_len - 1)
+
+        params = getattr(model, "params", model)
+        self._versions: Dict[str, Any] = {tag: params}
+        self._serve_tag = tag
+        self._slots: List[Optional[_Slot]] = [None] * self.max_slots
+        self._page_table = np.zeros(
+            (self.max_slots, prog.pages_per_slot), np.int32)
+        self._free_pages = deque(range(1, self.total_pages))
+        self._cache = None
+        self._compiled: Dict[tuple, Any] = {}
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._loaded = False
+        self._shutdown = False
+        self._generation = 0
+        self._crash_next = False   # test hook: raise inside the next step
+        self._thread: Optional[threading.Thread] = None
+        self._supervisor: Optional[threading.Thread] = None
+
+    # -- load / warmup -----------------------------------------------------
+
+    def load(self) -> "DecodeEngine":
+        """Allocate the pool and AOT-compile + run every serve-path
+        executable: one prefill per prompt bucket, the decode step, the
+        two samplers, the pool reset, and the page scrub.  After this,
+        ``compile_cache_size()`` must not grow while serving — the
+        zero-serve-time-compiles contract."""
+        import jax
+
+        from ..ops.kv_cache import alloc_cache
+
+        prog = self.program
+        params = self._versions[self._serve_tag]
+        s_n, pps, v_n = self.max_slots, prog.pages_per_slot, prog.vocab_size
+        kp, vp = alloc_cache(prog.n_layers, self.total_pages, prog.page_size,
+                             prog.n_heads, prog.d_head)
+
+        step_c = jax.jit(prog.step, donate_argnums=(1, 2)).lower(
+            params, kp, vp, np.zeros((s_n, pps), np.int32),
+            np.zeros((s_n,), np.int32), np.zeros((s_n,), np.int32),
+            np.zeros((s_n,), bool)).compile()
+        kp, vp, lgs = step_c(
+            params, kp, vp, np.zeros((s_n, pps), np.int32),
+            np.zeros((s_n,), np.int32), np.zeros((s_n,), np.int32),
+            np.zeros((s_n,), bool))
+        self._compiled[("step",)] = step_c
+
+        lg1 = None
+        prefill_jit = jax.jit(prog.prefill, donate_argnums=(1, 2))
+        for b in self.prompt_buckets:
+            pf = prefill_jit.lower(
+                params, kp, vp, np.zeros((pps,), np.int32),
+                np.zeros((b,), np.int32), np.int32(1)).compile()
+            kp, vp, lg1 = pf(params, kp, vp, np.zeros((pps,), np.int32),
+                             np.zeros((b,), np.int32), np.int32(1))
+            self._compiled[("prefill", b)] = pf
+
+        one, batch = _make_samplers(v_n)
+        s1 = jax.jit(one).lower(
+            lg1, np.float32(0), np.int32(0), np.float32(1), np.uint32(0),
+            np.int32(0)).compile()
+        tok, _ = s1(lg1, np.float32(0), np.int32(0), np.float32(1),
+                    np.uint32(0), np.int32(0))
+        np.asarray(tok)
+        self._compiled[("sample1",)] = s1
+        sb = jax.jit(batch).lower(
+            lgs, np.zeros((s_n,), np.float32), np.zeros((s_n,), np.int32),
+            np.ones((s_n,), np.float32), np.zeros((s_n,), np.uint32),
+            np.zeros((s_n,), np.int32)).compile()
+        toks, _ = sb(lgs, np.zeros((s_n,), np.float32),
+                     np.zeros((s_n,), np.int32), np.ones((s_n,), np.float32),
+                     np.zeros((s_n,), np.uint32), np.zeros((s_n,), np.int32))
+        np.asarray(toks)
+        self._compiled[("sample",)] = sb
+
+        def _reset(k, v):
+            import jax.numpy as jnp
+            return jnp.zeros_like(k), jnp.zeros_like(v)
+
+        def _scrub(k, v, ids):
+            # zero the given pages (padded with repeats — idempotent)
+            return k.at[:, ids].set(0.0), v.at[:, ids].set(0.0)
+
+        reset_c = jax.jit(_reset, donate_argnums=(0, 1)).lower(
+            kp, vp).compile()
+        kp, vp = reset_c(kp, vp)
+        self._compiled[("reset",)] = reset_c
+        scrub_c = jax.jit(_scrub, donate_argnums=(0, 1)).lower(
+            kp, vp, np.zeros((pps,), np.int32)).compile()
+        kp, vp = scrub_c(kp, vp, np.zeros((pps,), np.int32))
+        self._compiled[("scrub",)] = scrub_c
+
+        self._cache = (kp, vp)
+        self._loaded = True
+        self._start_loop()
+        self._supervisor = threading.Thread(
+            target=self._supervise, name="decode-supervisor", daemon=True)
+        self._supervisor.start()
+        return self
+
+    def compile_cache_size(self) -> int:
+        """Executables backing the serve path.  Must not grow after
+        ``load()`` while serving — watched by ``continuous_batching_ab``."""
+        return len(self._compiled)
+
+    @property
+    def current_tag(self) -> str:
+        with self._lock:
+            return self._serve_tag
+
+    # -- request path ------------------------------------------------------
+
+    def generate_async(self, prompt_ids, *, max_new_tokens: Optional[int] = None,
+                       temperature: float = 0.0, top_k: int = 0,
+                       top_p: float = 1.0, seed: int = 0,
+                       slo_ms: Optional[float] = None,
+                       deadline: Optional[float] = None,
+                       echo_logits: bool = False) -> Future:
+        """Enqueue one generation; the Future resolves to a
+        ``GenerationResult`` (or a typed serving error).  Joins the
+        running decode batch at the next step boundary."""
+        if not self._loaded:
+            raise RuntimeError("DecodeEngine.load() must run before generate")
+        prog = self.program
+        prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
+        if prompt.shape[0] < 1 or prompt.shape[0] > self.max_prompt:
+            raise ValueError(
+                f"prompt length {prompt.shape[0]} outside [1, "
+                f"{self.max_prompt}] (largest warmed bucket, < max_len "
+                f"{prog.max_len})")
+        if prompt.min() < 0 or prompt.max() >= prog.vocab_size:
+            raise ValueError(f"prompt ids outside [0, {prog.vocab_size})")
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else self.default_max_new)
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        max_new = min(max_new, prog.max_len - int(prompt.shape[0]))
+        if temperature < 0:
+            raise ValueError("temperature must be >= 0 (0 = greedy)")
+        if not (0 <= top_k <= prog.vocab_size):
+            raise ValueError(f"top_k outside [0, {prog.vocab_size}]")
+        if not (0 < top_p <= 1.0):
+            raise ValueError("top_p must be in (0, 1]")
+        spec = _GenSpec(prompt=prompt, max_new=max_new,
+                        temperature=float(temperature), top_k=int(top_k),
+                        top_p=float(top_p), seed=int(seed),
+                        echo_logits=bool(echo_logits))
+        return self.batcher.submit_request(spec, slo_ms=slo_ms,
+                                           deadline=deadline)
+
+    def generate(self, prompt_ids, **kw) -> GenerationResult:
+        """Blocking ``generate_async``."""
+        return self.generate_async(prompt_ids, **kw).result()
+
+    # -- hot-swap ----------------------------------------------------------
+
+    def swap_model(self, model, tag: str) -> None:
+        """Flip the version NEW admissions decode under; in-flight slots
+        finish under the version that prefilled them (the step runs per
+        distinct active tag), so no request mixes versions and nothing
+        drains.  The incoming params must match the loaded shapes/dtypes
+        — the AOT executables are shared across versions."""
+        import jax
+
+        params = getattr(model, "params", model)
+        ref = self._versions[self._serve_tag]
+        try:
+            mismatch = jax.tree_util.tree_map(
+                lambda a, b: (np.shape(a) != np.shape(b)
+                              or np.asarray(a).dtype != np.asarray(b).dtype),
+                ref, params)
+        except ValueError as e:
+            raise ValueError(f"incoming model {tag!r} has a different "
+                             f"parameter tree: {e}") from e
+        if any(jax.tree_util.tree_leaves(mismatch)):
+            raise ValueError(
+                f"incoming model {tag!r} has mismatched parameter "
+                "shapes/dtypes — decode versions must share the compiled "
+                "executables")
+        with self._lock:
+            self._versions[tag] = params
+            self._serve_tag = tag
+        self.metrics.inc("swaps")
+        obs_trace.instant("serve/swap", cat="serve", incoming=tag,
+                          kind="decode")
+
+    def attach_registry(self, registry, name: str,
+                        alias: str = "prod") -> "DecodeEngine":
+        """Serve (name, alias) from a ModelRegistry and follow every
+        ``set_alias`` move with a no-drain ``swap_model``."""
+        version, model = registry.resolve(name, alias)
+        self.swap_model(model, f"{name}:v{version}")
+        registry.subscribe(
+            name, alias,
+            lambda ver, mod: self.swap_model(mod, f"{name}:v{ver}"))
+        return self
+
+    # -- decode loop -------------------------------------------------------
+
+    def _start_loop(self) -> None:
+        with self._lock:
+            self._generation += 1
+            gen = self._generation
+            self._thread = threading.Thread(
+                target=self._loop, args=(gen,),
+                name=f"decode-loop-{gen}", daemon=True)
+            self._thread.start()
+
+    def _supervise(self) -> None:
+        """Respawn the decode loop if it dies outright (a crash its own
+        handler could not absorb) — in-flight requests are retried or
+        failed, never stranded."""
+        while not self._stop.wait(0.05):
+            with self._lock:
+                if self._shutdown:
+                    return
+                t = self._thread
+            if t is not None and not t.is_alive():
+                obs_trace.instant("serve/replica_crash", cat="serve",
+                                  kind="decode_loop_dead")
+                self.metrics.inc("replica_crashes")
+                self._drain_crashed(ReplicaCrashError(
+                    "decode loop thread died"))
+                with self._lock:
+                    if self._shutdown:
+                        return
+                self.metrics.inc("replica_respawns")
+                self._start_loop()
+
+    def _loop(self, gen: int) -> None:
+        while True:
+            with self._lock:
+                if self._shutdown or gen != self._generation:
+                    return
+            try:
+                worked = self._admit_some()
+                worked = self._step_once() or worked
+            except Exception as e:
+                obs_trace.instant("serve/replica_crash", cat="serve",
+                                  kind="decode_step",
+                                  error=type(e).__name__)
+                self.metrics.inc("replica_crashes")
+                self._drain_crashed(e)
+                continue
+            if not worked:
+                self.batcher.wait_for_work(0.05)
+
+    def _admit_some(self) -> bool:
+        """Join queued requests to the running batch: allocate pages +
+        a slot, prefill, sample the first token (TTFT).  Stops at the
+        first request the pool cannot hold yet (FIFO order preserved)."""
+        from ..ops.kv_cache import pages_for
+
+        with self._lock:
+            free = [i for i, s in enumerate(self._slots) if s is None]
+        if not free:
+            return False
+        reqs = self.batcher.admit(len(free))
+        if not reqs:
+            return False
+        prog = self.program
+        leftovers: List[Any] = []
+        worked = False
+        for r in reqs:
+            if leftovers:           # keep FIFO once one request stalls
+                leftovers.append(r)
+                continue
+            spec = r.payload
+            max_total = min(int(spec.prompt.shape[0]) + spec.max_new,
+                            prog.max_len)
+            need = pages_for(max_total, prog.page_size)
+            with self._lock:
+                if not free or len(self._free_pages) < need:
+                    leftovers.append(r)
+                    continue
+                i = free.pop(0)
+                ids = [self._free_pages.popleft() for _ in range(need)]
+                self._page_table[i] = 0
+                self._page_table[i, :need] = ids
+                slot = _Slot(r, self._serve_tag, ids, spec.max_new)
+                self._slots[i] = slot
+                self.metrics.active_slots.set(
+                    sum(1 for s in self._slots if s is not None))
+                self.metrics.pages_in_use.set(
+                    self.total_pages - 1 - len(self._free_pages))
+            self.metrics.inc("requests")
+            self._prefill_slot(i)
+            worked = True
+        for r in reversed(leftovers):
+            self.batcher.requeue_front(r)
+        return worked
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self.prompt_buckets:
+            if n <= b:
+                return b
+        return self.prompt_buckets[-1]
+
+    def _prefill_slot(self, i: int) -> None:
+        s = self._slots[i]
+        spec = s.spec
+        n = s.n_prompt
+        bucket = self._bucket_for(n)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:n] = spec.prompt
+        t0 = self.clock()
+        kp, vp = self._cache
+        kp, vp, lg = self._compiled[("prefill", bucket)](
+            self._versions[s.tag], kp, vp, self._page_table[i], padded,
+            np.int32(n))
+        tok, fin = self._compiled[("sample1",)](
+            lg, np.float32(spec.temperature), np.int32(spec.top_k),
+            np.float32(spec.top_p), np.uint32(spec.seed), np.int32(0))
+        self._cache = (kp, vp)
+        tok_h = int(np.asarray(tok))
+        fin_h = bool(np.asarray(fin))
+        lg_h = np.asarray(lg) if spec.echo_logits else None
+        t1 = self.clock()
+        obs_trace.complete_at("serve/prefill", t0, t1, cat="serve", slot=i,
+                              bucket=bucket, prompt_tokens=n, model=s.tag)
+        self.metrics.inc("prefills")
+        self.metrics.ttft.record((t1 - s.req.t_submit) * 1e3)
+        s.t_first = t1
+        self._record_token(i, tok_h, fin_h, lg_h, t1)
+
+    def _step_once(self) -> bool:
+        """One decode step per distinct active version tag (same
+        executable, that tag's params, that tag's slots active) — the
+        no-version-mixing hot-swap invariant lives here."""
+        s_n = self.max_slots
+        with self._lock:
+            tags: List[str] = []
+            for s in self._slots:
+                if s is not None and s.tag not in tags:
+                    tags.append(s.tag)
+            crash = self._crash_next
+            self._crash_next = False
+        if crash:
+            raise ReplicaCrashError("injected decode-batch crash (test hook)")
+        if not tags:
+            return False
+        for tag in tags:
+            toks_in = np.zeros((s_n,), np.int32)
+            pos = np.zeros((s_n,), np.int32)
+            act = np.zeros((s_n,), bool)
+            temps = np.zeros((s_n,), np.float32)
+            tks = np.zeros((s_n,), np.int32)
+            tps = np.ones((s_n,), np.float32)
+            seeds = np.zeros((s_n,), np.uint32)
+            steps = np.zeros((s_n,), np.int32)
+            group: List[int] = []
+            echo = False
+            with self._lock:
+                params = self._versions.get(tag)
+                if params is None:
+                    continue
+                for i, s in enumerate(self._slots):
+                    if s is None or s.tag != tag:
+                        continue
+                    group.append(i)
+                    toks_in[i] = s.last_token
+                    pos[i] = s.pos
+                    act[i] = True
+                    temps[i] = s.spec.temperature
+                    tks[i] = s.spec.top_k
+                    tps[i] = s.spec.top_p
+                    seeds[i] = s.spec.seed
+                    steps[i] = s.n_out
+                    echo = echo or s.logits is not None
+            if not group:
+                continue
+            t0 = self.clock()
+            kp, vp = self._cache
+            kp, vp, lgs = self._compiled[("step",)](
+                params, kp, vp, self._page_table, toks_in, pos, act)
+            toks, fin = self._compiled[("sample",)](
+                lgs, temps, tks, tps, seeds, steps)
+            self._cache = (kp, vp)
+            toks_h = np.asarray(toks)
+            fin_h = np.asarray(fin)
+            lgs_h = np.asarray(lgs) if echo else None
+            t1 = self.clock()
+            obs_trace.complete_at("serve/decode_step", t0, t1, cat="serve",
+                                  n_active=len(group), model=tag)
+            self.metrics.inc("decode_steps")
+            self.metrics.step_time.record((t1 - t0) * 1e3)
+            for i in group:
+                with self._lock:
+                    s = self._slots[i]
+                if s is not None:
+                    s.pos += 1
+                    self._record_token(
+                        i, int(toks_h[i]), bool(fin_h[i]),
+                        lgs_h[i].copy() if (lgs_h is not None
+                                            and s.logits is not None)
+                        else None, t1)
+        return True
+
+    # -- per-token bookkeeping + stop conditions ---------------------------
+
+    def _record_token(self, i: int, token: int, finite: bool,
+                      logits_row: Optional[np.ndarray], now: float) -> None:
+        s = self._slots[i]
+        if s is None:
+            return
+        if not finite:
+            self.metrics.inc("poison_isolated")
+            self._scrub_pages(s.page_ids)
+            self._finish(i, now, error=PoisonInputError(
+                f"decode produced non-finite logits at token {s.n_out} "
+                f"(slot {i}) — request isolated, co-batched slots "
+                "unaffected"))
+            return
+        s.tokens.append(token)
+        s.n_out += 1
+        s.last_token = token
+        s.t_last = now
+        if s.logits is not None and logits_row is not None:
+            s.logits.append(logits_row)
+        self.metrics.inc("tokens_out")
+        if self.eos_id is not None and token == self.eos_id:
+            self._finish(i, now, reason="eos")
+        elif s.n_out >= s.max_new:
+            self._finish(i, now, reason="max_tokens")
+        elif now > s.deadline:
+            # mid-decode deadline is a STOP condition, not an error: the
+            # caller gets the tokens produced inside the budget
+            self._finish(i, now, reason="deadline")
+
+    def _scrub_pages(self, page_ids: List[int]) -> None:
+        """Zero freed pages that may hold non-finite rows — a NaN left
+        behind would poison the page's next tenant (0 * NaN = NaN)."""
+        pps = self.program.pages_per_slot
+        ids = np.full((pps,), page_ids[0], np.int32)
+        ids[:len(page_ids)] = page_ids
+        kp, vp = self._cache
+        self._cache = self._compiled[("scrub",)](kp, vp, ids)
+
+    def _finish(self, i: int, now: float, reason: Optional[str] = None,
+                error: Optional[BaseException] = None) -> None:
+        with self._lock:
+            s = self._slots[i]
+            if s is None:
+                return
+            self._slots[i] = None
+            self._free_pages.extend(s.page_ids)
+            self._page_table[i] = 0
+            live_tags = {sl.tag for sl in self._slots if sl is not None}
+            live_tags.add(self._serve_tag)
+            for t in [t for t in self._versions if t not in live_tags]:
+                del self._versions[t]
+            self.metrics.active_slots.set(
+                sum(1 for sl in self._slots if sl is not None))
+            self.metrics.pages_in_use.set(
+                self.total_pages - 1 - len(self._free_pages))
+        if error is not None:
+            self.metrics.inc("errors")
+            _fail_safe(s.req.future, error)
+        else:
+            self.metrics.inc({"eos": "eos_stops",
+                              "max_tokens": "max_token_stops",
+                              "deadline": "deadline_stops"}[reason])
+            tpot = ((s.t_last - s.t_first) * 1e3 / (s.n_out - 1)
+                    if s.n_out > 1 else None)
+            if tpot is not None:
+                self.metrics.tpot.record(tpot)
+            _set_safe(s.req.future, GenerationResult(
+                tokens=list(s.tokens), n_prompt=s.n_prompt,
+                finish_reason=reason, model_tag=s.tag,
+                ttft_ms=round((s.t_first - s.req.t_submit) * 1e3, 3),
+                tpot_ms=round(tpot, 3) if tpot is not None else None,
+                logits=np.stack(s.logits) if s.logits else None))
+        obs_trace.complete_at("serve/request", s.req.t_submit, now,
+                              cat="serve", kind="generate", tokens=s.n_out,
+                              finish=reason or "error")
+
+    # -- crash recovery ----------------------------------------------------
+
+    def _drain_crashed(self, exc: BaseException) -> None:
+        """Fail or retry every in-flight request after a decode-batch
+        crash, reset the pool, keep serving.  Retries regenerate the
+        identical sequence (seeded counter-based sampling), so a retry
+        is indistinguishable from a slow first attempt."""
+        with self._lock:
+            in_flight = [s for s in self._slots if s is not None]
+            self._slots = [None] * self.max_slots
+            self._free_pages = deque(range(1, self.total_pages))
+            self._page_table[:] = 0
+            self.metrics.active_slots.set(0)
+            self.metrics.pages_in_use.set(0)
+        # the crash may have left non-finite rows anywhere — zero the pool
+        kp, vp = self._cache
+        self._cache = self._compiled[("reset",)](kp, vp)
+        now = self.clock()
+        for s in in_flight:
+            r = s.req
+            r.retries += 1
+            if r.retries <= self.max_retries and r.deadline > now \
+                    and not r.future.done():
+                self.metrics.inc("retries")
+                obs_trace.instant("serve/retry", cat="serve", kind="decode",
+                                  retries=r.retries)
+                self.batcher.requeue_front(r)
+            else:
+                self.metrics.inc("errors")
+                _fail_safe(r.future, ReplicaCrashError(
+                    f"decode batch crashed ({type(exc).__name__}: {exc}) "
+                    f"after {s.n_out} tokens; retry budget exhausted"))
+
+    # -- observability / shutdown ------------------------------------------
+
+    def metrics_snapshot(self) -> dict:
+        snap = self.metrics.snapshot()
+        with self._lock:
+            snap["model"] = self._serve_tag
+            snap["versions"] = sorted(self._versions)
+            snap["queue_depth"] = self.batcher.qsize()
+        snap["compile_cache_size"] = self.compile_cache_size()
+        snap["prompt_buckets"] = list(self.prompt_buckets)
+        snap["max_slots"] = self.max_slots
+        snap["total_pages"] = self.total_pages
+        return snap
+
+    def health_snapshot(self) -> dict:
+        with self._lock:
+            t = self._thread
+            ready = (self._loaded and not self._shutdown
+                     and t is not None and t.is_alive())
+        return {"status": "ready" if ready else "unready", "ready": ready,
+                "kind": "decode", "model": self.current_tag}
+
+    def shutdown(self) -> None:
+        """Idempotent; every queued AND in-flight future resolves."""
+        with self._lock:
+            if self._shutdown:
+                return
+            self._shutdown = True
+            self._generation += 1
+            in_flight = [s for s in self._slots if s is not None]
+            self._slots = [None] * self.max_slots
+        self._stop.set()
+        self.batcher.close(fail_pending=True)
+        for s in in_flight:
+            _fail_safe(s.req.future,
+                       RuntimeError("serving engine is shut down"))
+        for t in (self._thread, self._supervisor):
+            if t is not None and t is not threading.current_thread():
+                t.join(timeout=5)

@@ -163,3 +163,71 @@ class ServingMetrics:
             "device_time_ms": self.device_time.snapshot(),
             "e2e_ms": self.e2e.snapshot(),
         }
+
+
+# every counter a fresh decode engine reports as zero (docs/SERVING.md
+# decode section: throughput set, then stop conditions, then resilience)
+_DECODE_COUNTER_KEYS = (
+    "requests", "tokens_out", "prefills", "decode_steps",
+    "eos_stops", "max_token_stops", "deadline_stops",
+    "shed", "deadline_missed", "errors", "retries",
+    "poison_isolated", "replica_crashes", "replica_respawns", "swaps",
+)
+
+
+class DecodeMetrics:
+    """Per-decode-engine metric set: TTFT and time-per-output-token are
+    the first-class histograms (the serving numbers that matter for
+    generative inference — PAPERS.md Gemma-on-TPU framing), plus
+    per-step device time, throughput/stop/resilience counters, and
+    pool-occupancy gauges.  Exported like ``ServingMetrics``: a legacy
+    ``snapshot()`` dict, a typed per-engine registry, and a collector on
+    the process-global registry (one ``/metrics`` response carries every
+    live engine — docs/OBSERVABILITY.md)."""
+
+    def __init__(self, buckets_ms: Sequence[float] = DEFAULT_BUCKETS_MS,
+                 registry: MetricsRegistry = None):
+        self.registry = registry or MetricsRegistry()
+        self.ttft = self.registry.register(
+            LatencyHistogram(buckets_ms, name="ttft_ms"))
+        self.tpot = self.registry.register(
+            LatencyHistogram(buckets_ms, name="tpot_ms"))
+        self.step_time = self.registry.register(
+            LatencyHistogram(buckets_ms, name="decode_step_ms"))
+        self._counters = {k: self.registry.counter(k)
+                          for k in _DECODE_COUNTER_KEYS}
+        self._lock = threading.Lock()
+        self.active_slots = self.registry.gauge("active_slots")
+        self.active_slots.set(0)
+        self.pages_in_use = self.registry.gauge("pages_in_use")
+        self.pages_in_use.set(0)
+        self._t0 = time.monotonic()
+        self.global_name = get_registry().register_collector(
+            "decode", self.snapshot, unique=True)
+
+    def inc(self, key: str, n: int = 1) -> None:
+        c = self._counters.get(key)
+        if c is None:        # open key set, matching ServingMetrics
+            with self._lock:
+                c = self._counters.get(key)
+                if c is None:
+                    c = self._counters[key] = self.registry.counter(key)
+        c.inc(n)
+
+    def snapshot(self) -> dict:
+        c: Dict[str, int] = {}
+        for k, counter in list(self._counters.items()):
+            v = counter.value()
+            c[k] = int(v) if float(v).is_integer() else v
+        elapsed = time.monotonic() - self._t0
+        return {
+            "counters": c,
+            "active_slots": int(self.active_slots.value()),
+            "pages_in_use": int(self.pages_in_use.value()),
+            "tokens_per_sec": round(c["tokens_out"] / elapsed, 2)
+            if elapsed > 0 else None,
+            "uptime_sec": round(elapsed, 3),
+            "ttft_ms": self.ttft.snapshot(),
+            "tpot_ms": self.tpot.snapshot(),
+            "decode_step_ms": self.step_time.snapshot(),
+        }

@@ -27,6 +27,7 @@ class UIServer:
         self._storages: List = []
         self._metrics_providers: List = []
         self._engine = None
+        self._decode_engine = None
         self._httpd: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
 
@@ -46,6 +47,15 @@ class UIServer:
         {"outputs": ...}) through a serving Engine, and export its
         metrics on /metrics."""
         self._engine = engine
+        return self.attach_metrics(engine.metrics_snapshot)
+
+    def attach_decode_engine(self, engine) -> "UIServer":
+        """Serve autoregressive generation on POST /generate (JSON
+        {"prompt_ids": [...], "max_tokens": ..., "temperature": ...,
+        "top_k": ..., "top_p": ..., "seed": ...} → {"tokens": [...]})
+        through a serving DecodeEngine, and export its TTFT/TPOT
+        histograms on /metrics."""
+        self._decode_engine = engine
         return self.attach_metrics(engine.metrics_snapshot)
 
     def _metrics_json(self) -> str:
@@ -107,6 +117,52 @@ class UIServer:
             out = self._engine.output(x, slo_ms=payload.get("slo_ms"))
             return 200, {"outputs": np.asarray(out).tolist(),
                          "model": self._engine.current_tag}
+        except OverloadedError as e:
+            return 429, {"error": str(e), "error_class": "overloaded"}
+        except DeadlineExceededError as e:
+            return 504, {"error": str(e), "error_class": "deadline_exceeded"}
+        except PoisonInputError as e:
+            return 422, {"error": str(e), "error_class": "poison_input"}
+        except (ReplicaCrashError, ReplicaHungError) as e:
+            return 500, {"error": str(e), "error_class": "replica_failure"}
+        except (KeyError, ValueError, TypeError) as e:
+            return 400, {"error": f"{type(e).__name__}: {e}",
+                         "error_class": "bad_request"}
+        except Exception as e:  # model exceptions: no traceback leak
+            return 500, {"error": f"{type(e).__name__}: {e}",
+                         "error_class": "internal"}
+
+    def _generate_json(self, body: bytes):
+        """(status, payload) for POST /generate — the decode-engine
+        twin of ``_predict_json``, same structured-error contract:
+        shed → 429 ``overloaded``, blown QUEUED deadline → 504
+        ``deadline_exceeded`` (a deadline hit MID-decode returns 200
+        with ``finish_reason: "deadline"`` and the tokens produced
+        inside the budget), non-finite logits → 422 ``poison_input``,
+        exhausted crash retries → 500 ``replica_failure``, malformed
+        request → 400 ``bad_request``."""
+        import json
+        from ..serving import (
+            DeadlineExceededError, OverloadedError, PoisonInputError,
+            ReplicaCrashError, ReplicaHungError,
+        )
+        if self._decode_engine is None:
+            return 503, {"error": "no decode engine attached",
+                         "error_class": "unavailable"}
+        try:
+            payload = json.loads(body)
+            res = self._decode_engine.generate(
+                payload["prompt_ids"],
+                max_new_tokens=payload.get("max_tokens"),
+                temperature=payload.get("temperature", 0.0),
+                top_k=payload.get("top_k", 0),
+                top_p=payload.get("top_p", 1.0),
+                seed=payload.get("seed", 0),
+                slo_ms=payload.get("slo_ms"))
+            return 200, {"tokens": res.tokens, "n_prompt": res.n_prompt,
+                         "finish_reason": res.finish_reason,
+                         "model": res.model_tag, "ttft_ms": res.ttft_ms,
+                         "tpot_ms": res.tpot_ms}
         except OverloadedError as e:
             return 429, {"error": str(e), "error_class": "overloaded"}
         except DeadlineExceededError as e:
@@ -217,6 +273,12 @@ class UIServer:
                     n = int(self.headers.get("Content-Length", 0))
                     if self.path == "/predict":
                         code, payload = server._predict_json(self.rfile.read(n))
+                        self._reply(code, json.dumps(payload).encode(),
+                                    "application/json")
+                        return
+                    if self.path == "/generate":
+                        code, payload = server._generate_json(
+                            self.rfile.read(n))
                         self._reply(code, json.dumps(payload).encode(),
                                     "application/json")
                         return

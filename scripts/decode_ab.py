@@ -1,0 +1,310 @@
+"""Decode A/B: static-batch re-encode decoding vs the DecodeEngine.
+
+Protocol (CPU for the correctness gates; the speed gates are TPU-only —
+host-side step latency dominates a tiny model on CPU, so CPU throughput
+numbers say nothing about the paged-cache win):
+
+  1. Build a small ShardedTransformerLM on a 1-device mesh and derive
+     its ``decode_program`` (ops/kv_cache.py).
+  2. Arm BASELINE — static batching, no cache: gather up to
+     ``max_slots`` requests (drain-wait), then RE-ENCODE the full padded
+     [B, max_len] sequence once per generated token with one AOT
+     executable, taking each row's next-token logits at its current
+     position.  No request joins until the whole batch finishes — the
+     classic head-of-line blocking continuous batching removes.
+  3. Arm ENGINE — serving.DecodeEngine: paged KV-cache, bucketed
+     prefill, iteration-level joins at every step boundary.
+  4. Drive the SAME open-loop prompt schedule through each arm
+     (arrival clock never waits), greedy decoding so the two arms are
+     token-comparable.
+
+Correctness gates (enforced on every platform):
+  - bit_identical: at temperature 0 the engine's echoed per-token
+    logits are BITWISE equal to re-encoding the full sequence with the
+    same program — the paged cache is exact, not approximate.
+  - tokens_match: engine greedy tokens == baseline greedy tokens.
+  - zero_compiles: ``compile_cache_size()`` identical before and after
+    serving — continuous batching never triggers a serve-time compile.
+  - stranded_zero: with a crash injected into a mid-flight decode
+    batch, every submitted future still resolves (retry or typed
+    error); nothing hangs.
+
+Speed gates (TPU only, reported everywhere):
+  - tokens_ok: engine tokens/sec >= baseline.
+  - ttft_ok: engine p99 TTFT <= baseline p99 TTFT.
+
+Last stdout line is the JSON result (the bench subprocess contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import List, Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+class StaticBatchDecoder:
+    """The baseline arm: drain-wait static batching + full re-encode
+    per token (no KV cache, no mid-batch joins).  Greedy only."""
+
+    def __init__(self, params, reencode_c, max_len: int, batch: int,
+                 max_new: int, gather_ms: float = 2.0):
+        self.params = params
+        self.reencode = reencode_c
+        self.max_len = max_len
+        self.batch = batch
+        self.max_new = max_new
+        self.gather_s = gather_ms / 1000.0
+        self._q: deque = deque()
+        self._lock = threading.Lock()
+        self._nonempty = threading.Condition(self._lock)
+        self._closed = False
+        self._worker = threading.Thread(target=self._run, daemon=True)
+        self._worker.start()
+
+    def submit(self, prompt: np.ndarray) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            self._q.append((prompt, fut, time.perf_counter()))
+            self._nonempty.notify()
+        return fut
+
+    def shutdown(self) -> None:
+        with self._lock:
+            self._closed = True
+            self._nonempty.notify()
+        self._worker.join(timeout=10)
+        with self._lock:
+            leftovers = list(self._q)
+            self._q.clear()
+        for _, fut, _ in leftovers:
+            if not fut.done():
+                fut.set_exception(RuntimeError("decoder shut down"))
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while not self._q and not self._closed:
+                    self._nonempty.wait(timeout=0.05)
+                if self._closed and not self._q:
+                    return
+            time.sleep(self.gather_s)   # drain-wait: hope more arrive
+            with self._lock:
+                group = [self._q.popleft()
+                         for _ in range(min(self.batch, len(self._q)))]
+            if not group:
+                continue
+            try:
+                self._decode_group(group)
+            except Exception as e:
+                for _, fut, _ in group:
+                    if not fut.done():
+                        fut.set_exception(e)
+
+    def _decode_group(self, group) -> None:
+        # the re-encode executable is AOT-compiled at [batch, max_len]:
+        # a partial group still pays for the full static batch shape
+        seq = np.zeros((self.batch, self.max_len), np.int32)
+        pos = np.ones((self.batch,), np.int64)
+        toks: List[List[int]] = [[] for _ in group]
+        ttft: List[Optional[float]] = [None] * len(group)
+        for b, (prompt, _, _) in enumerate(group):
+            seq[b, :prompt.shape[0]] = prompt
+            pos[b] = prompt.shape[0]
+        budget = [min(self.max_new, self.max_len - int(p)) for p in pos]
+        for _ in range(max(budget)):
+            lg = np.asarray(self.reencode(self.params, seq))
+            now = time.perf_counter()
+            done = True
+            for b, (_, _, t_submit) in enumerate(group):
+                if len(toks[b]) >= budget[b]:
+                    continue
+                tok = int(np.argmax(lg[b, pos[b] - 1]))
+                if ttft[b] is None:
+                    ttft[b] = (now - t_submit) * 1e3
+                toks[b].append(tok)
+                if pos[b] < self.max_len:
+                    seq[b, pos[b]] = tok
+                pos[b] += 1
+                if len(toks[b]) < budget[b]:
+                    done = False
+            if done:
+                break
+        for b, (_, fut, _) in enumerate(group):
+            if not fut.done():
+                fut.set_result({"tokens": toks[b], "ttft_ms": ttft[b]})
+
+
+def _percentile(vals: List[float], p: float) -> Optional[float]:
+    if not vals:
+        return None
+    s = sorted(vals)
+    return round(float(s[int(p * (len(s) - 1))]), 3)
+
+
+def run_arm(submit, n_requests: int, interarrival_s: float, prompts,
+            get_stats) -> dict:
+    """Open-loop driver: submit on a fixed arrival clock, then collect.
+    ``get_stats(result) -> (n_tokens, ttft_ms)``."""
+    futs: List[Future] = []
+    t_start = time.perf_counter()
+    for i in range(n_requests):
+        target = t_start + i * interarrival_s
+        delay = target - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        futs.append(submit(prompts[i % len(prompts)]))
+    tokens = 0
+    ttfts: List[float] = []
+    errors = 0
+    t_last = t_start
+    for fut in futs:
+        try:
+            res = fut.result(timeout=180)
+        except Exception:
+            errors += 1
+            continue
+        t_last = max(t_last, time.perf_counter())
+        n, ttft = get_stats(res)
+        tokens += n
+        if ttft is not None:
+            ttfts.append(ttft)
+    wall = max(t_last - t_start, 1e-9)
+    return {
+        "completed": n_requests - errors, "errors": errors,
+        "wall_s": round(wall, 4), "tokens_out": tokens,
+        "tokens_per_sec": round(tokens / wall, 2),
+        "ttft_p50_ms": _percentile(ttfts, 0.50),
+        "ttft_p99_ms": _percentile(ttfts, 0.99),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--requests", type=int, default=None)
+    ap.add_argument("--interarrival-ms", type=float, default=4.0)
+    ap.add_argument("--max-slots", type=int, default=4)
+    ap.add_argument("--max-new", type=int, default=12)
+    args = ap.parse_args()
+
+    import jax
+
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+    from deeplearning4j_tpu.parallel.transformer import ShardedTransformerLM
+    from deeplearning4j_tpu.serving import DecodeEngine
+
+    platform = jax.devices()[0].platform
+    n_requests = args.requests or (40 if args.quick else 150)
+    dt = args.interarrival_ms / 1000.0
+
+    mesh = build_mesh({"data": 1, "model": 1, "seq": 1, "pipe": 1},
+                      devices=jax.devices()[:1])
+    lm = ShardedTransformerLM(vocab_size=64, n_layers=2, d_model=64,
+                              n_heads=4, max_len=64, mesh=mesh, seed=7)
+    eng = DecodeEngine(lm, max_slots=args.max_slots, page_size=8,
+                       default_max_new=args.max_new, max_queue=100_000,
+                       admission="block").load()
+    ccs0 = eng.compile_cache_size()
+    prog = eng.program
+
+    reencode_c = jax.jit(prog.reencode).lower(
+        lm.params, np.zeros((args.max_slots, prog.max_len),
+                            np.int32)).compile()
+    baseline = StaticBatchDecoder(lm.params, reencode_c, prog.max_len,
+                                  args.max_slots, args.max_new)
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 64, size=n).astype(np.int32)
+               for n in (5, 11, 7, 3, 14, 9)]
+
+    # -- correctness: bit-identity + token agreement --------------------
+    re1 = jax.jit(prog.reencode).lower(
+        lm.params, np.zeros((1, prog.max_len), np.int32)).compile()
+    bit_identical = True
+    tokens_match = True
+    for p in prompts[:3]:
+        res = eng.generate(p, max_new_tokens=args.max_new, temperature=0.0,
+                           echo_logits=True)
+        base = baseline.submit(p).result(timeout=120)
+        tokens_match = tokens_match and res.tokens == base["tokens"]
+        seq = np.zeros((1, prog.max_len), np.int32)
+        full = list(p) + res.tokens
+        seq[0, :len(full)] = full
+        ref = np.asarray(re1(lm.params, seq))[0]
+        for j in range(len(res.tokens)):
+            if not np.array_equal(ref[len(p) + j - 1], res.logits[j]):
+                bit_identical = False
+
+    # -- speed: same open-loop schedule through each arm ----------------
+    print(f"decode_ab: {n_requests} requests @ {args.interarrival_ms}ms, "
+          f"max_slots={args.max_slots}, max_new={args.max_new}, "
+          f"platform={platform}", file=sys.stderr)
+    base_stats = run_arm(
+        baseline.submit, n_requests, dt, prompts,
+        lambda r: (len(r["tokens"]), r["ttft_ms"]))
+    eng_stats = run_arm(
+        lambda p: eng.generate_async(p, max_new_tokens=args.max_new,
+                                     temperature=0.0),
+        n_requests, dt, prompts,
+        lambda r: (len(r.tokens), r.ttft_ms))
+    baseline.shutdown()
+
+    # -- resilience: crash a mid-flight decode batch; nothing strands ---
+    crash_futs = [eng.generate_async(prompts[i % len(prompts)],
+                                     max_new_tokens=args.max_new,
+                                     temperature=0.0)
+                  for i in range(2 * args.max_slots)]
+    eng._crash_next = True
+    stranded = 0
+    for fut in crash_futs:
+        try:
+            fut.result(timeout=120)
+        except Exception:
+            pass                 # a typed failure is resolved, not stranded
+        if not fut.done():
+            stranded += 1
+    snap = eng.metrics_snapshot()
+    zero_compiles = eng.compile_cache_size() == ccs0
+    eng.shutdown()
+
+    tokens_ratio = (eng_stats["tokens_per_sec"]
+                    / max(base_stats["tokens_per_sec"], 1e-9))
+    ttft_ok = (eng_stats["ttft_p99_ms"] is not None
+               and base_stats["ttft_p99_ms"] is not None
+               and eng_stats["ttft_p99_ms"] <= base_stats["ttft_p99_ms"])
+    result = {
+        "platform": platform, "quick": args.quick,
+        "n_requests": n_requests, "interarrival_ms": args.interarrival_ms,
+        "max_slots": args.max_slots, "max_new": args.max_new,
+        "baseline": base_stats, "engine": eng_stats,
+        "engine_counters": snap["counters"],
+        "compile_cache_size": snap["compile_cache_size"],
+        # correctness gates — every platform
+        "bit_identical": bit_identical,
+        "tokens_match": tokens_match,
+        "zero_compiles": zero_compiles,
+        "stranded": stranded,
+        "crash_retries": snap["counters"]["retries"],
+        # speed gates — TPU only (reported everywhere)
+        "tokens_ratio_engine_vs_baseline": round(tokens_ratio, 4),
+        "tokens_ok": round(tokens_ratio, 2) >= 1.0,
+        "ttft_ok": ttft_ok,
+        "speed_gated": platform == "tpu",
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

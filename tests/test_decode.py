@@ -1,0 +1,427 @@
+"""Autoregressive decode engine: paged KV-cache, prefill/decode split,
+continuous batching (docs/SERVING.md "Autoregressive decode").
+
+The key contracts tested here:
+  - seeded sampling is deterministic: same (prompt, seed, knobs) ->
+    same tokens, regardless of co-batched traffic or a crash-retry
+  - greedy decode logits are BITWISE identical to re-encoding the full
+    sequence (the paged cache is exact, not approximate)
+  - early EOS frees cache pages immediately and the recycled pages
+    serve the next request uncorrupted
+  - hot-swap mid-decode never mixes versions: in-flight requests
+    finish on the version that prefilled them
+  - zero XLA compiles at serve time after load() (AOT warmup)
+  - every submitted future resolves (crash retry, poison isolation,
+    deadline, shutdown) — never a hang
+  - loading a decode engine does not perturb the wrapped network's
+    one-shot output path (bitwise regression pin)
+"""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel.mesh import build_mesh
+from deeplearning4j_tpu.parallel.transformer import ShardedTransformerLM
+from deeplearning4j_tpu.serving import (
+    ContinuousBatcher, DeadlineExceededError, DecodeEngine,
+    OverloadedError, PoisonInputError,
+)
+
+VOCAB, MAXLEN = 48, 32
+#: test-controlled clock shared by the module engine: bumping the
+#: offset expires deadlines deterministically mid-decode
+CLOCK_OFFSET = [0.0]
+
+
+def _clock():
+    return time.monotonic() + CLOCK_OFFSET[0]
+
+
+@pytest.fixture(scope="module")
+def lm():
+    import jax
+
+    mesh = build_mesh({"data": 1, "model": 1, "seq": 1, "pipe": 1},
+                      jax.devices()[:1])
+    return ShardedTransformerLM(vocab_size=VOCAB, n_layers=2, d_model=32,
+                                n_heads=2, max_len=MAXLEN, mesh=mesh, seed=11)
+
+
+@pytest.fixture(scope="module")
+def engine(lm):
+    eng = DecodeEngine(lm, max_slots=3, page_size=8, default_max_new=8,
+                       clock=_clock).load()
+    yield eng
+    eng.shutdown()
+
+
+def _tokens(engine, prompt, **kw):
+    return engine.generate(prompt, **kw).tokens
+
+
+def _ctr(engine, key):
+    return engine.metrics.snapshot()["counters"][key]
+
+
+class TestSamplingDeterminism:
+    def test_greedy_repeatable(self, engine):
+        a = _tokens(engine, [1, 2, 3], max_new_tokens=8)
+        b = _tokens(engine, [1, 2, 3], max_new_tokens=8)
+        assert a == b and len(a) == 8
+
+    @pytest.mark.parametrize("temperature,top_k,top_p", [
+        (0.7, 0, 1.0), (0.9, 5, 1.0), (0.8, 0, 0.9), (1.2, 7, 0.85),
+    ])
+    def test_seeded_sampling_repeatable(self, engine, temperature, top_k,
+                                        top_p):
+        kw = dict(max_new_tokens=8, temperature=temperature, top_k=top_k,
+                  top_p=top_p, seed=13)
+        assert _tokens(engine, [4, 5], **kw) == _tokens(engine, [4, 5], **kw)
+
+    def test_seed_changes_sampled_text(self, engine):
+        runs = {tuple(_tokens(engine, [7, 8, 9], max_new_tokens=8,
+                              temperature=1.5, seed=s)) for s in range(4)}
+        assert len(runs) > 1
+
+    def test_greedy_token_is_argmax_of_echoed_logits(self, engine):
+        res = engine.generate([2, 3, 4], max_new_tokens=6, echo_logits=True)
+        assert res.logits.shape == (6, VOCAB)
+        assert res.tokens == [int(np.argmax(r)) for r in res.logits]
+
+    def test_validation(self, engine, lm):
+        with pytest.raises(ValueError):
+            engine.generate_async([])                        # empty prompt
+        with pytest.raises(ValueError):
+            engine.generate_async([VOCAB])                   # out of vocab
+        with pytest.raises(ValueError):
+            engine.generate_async(list(range(MAXLEN)))       # too long
+        with pytest.raises(ValueError):
+            engine.generate_async([1], temperature=-0.1)
+        with pytest.raises(ValueError):
+            engine.generate_async([1], top_p=0.0)
+        with pytest.raises(ValueError):
+            engine.generate_async([1], top_k=VOCAB + 1)
+        with pytest.raises(RuntimeError):                    # before load()
+            DecodeEngine(lm, max_slots=1, page_size=8).generate_async([1])
+
+
+class TestBitIdentity:
+    def test_decode_logits_match_full_reencode(self, engine, lm):
+        import jax
+
+        prog = engine.program
+        res = engine.generate([3, 1, 4, 1, 5], max_new_tokens=10,
+                              echo_logits=True)
+        seq = np.zeros((1, prog.max_len), np.int32)
+        seq[0, :5] = [3, 1, 4, 1, 5]
+        seq[0, 5:5 + len(res.tokens)] = res.tokens
+        ref = np.asarray(jax.jit(prog.reencode)(lm.params, seq))[0]
+        for t in range(len(res.tokens)):
+            assert np.array_equal(res.logits[t], ref[4 + t]), f"token {t}"
+
+    def test_cobatched_tokens_match_solo_runs(self, engine):
+        prompts = [[1, 2], [9, 8, 7], [20, 21, 22, 23]]
+        solo = [_tokens(engine, p, max_new_tokens=8) for p in prompts]
+        futs = [engine.generate_async(p, max_new_tokens=8) for p in prompts]
+        assert [f.result(timeout=60).tokens for f in futs] == solo
+
+
+class TestPagedCache:
+    def test_early_eos_frees_pages_for_reuse(self, engine, lm):
+        # the greedy first token for this prompt becomes the small
+        # engine's EOS id, forcing a 1-token generation
+        eos = _tokens(engine, [3, 4], max_new_tokens=6)[0]
+        small = DecodeEngine(lm, max_slots=1, page_size=8,
+                             eos_id=eos).load()
+        try:
+            assert small.total_pages == 5    # scratch + 4: no slack at all
+            a = small.generate([3, 4], max_new_tokens=20)
+            assert a.finish_reason == "eos" and a.tokens == [eos]
+            snap = small.metrics_snapshot()
+            assert snap["pages_in_use"] == 0 and snap["active_slots"] == 0
+            # a full-length request needs EVERY pool page -> it can only
+            # run on the pages the EOS'd request just freed, and must
+            # still match the (eos-free) engine's greedy prefix exactly
+            ref = _tokens(engine, [5, 6, 7], max_new_tokens=29)
+            b = small.generate([5, 6, 7], max_new_tokens=29)
+            assert b.tokens == ref[:len(b.tokens)]
+            assert b.finish_reason in ("eos", "max_tokens")
+            assert small.metrics_snapshot()["pages_in_use"] == 0
+            # shutdown resolves anything submitted afterwards
+            small.shutdown()
+            with pytest.raises(RuntimeError):
+                small.generate_async([1]).result(timeout=10)
+        finally:
+            small.shutdown()
+
+    def test_gauges_return_to_zero_when_idle(self, engine):
+        engine.generate([1], max_new_tokens=2)
+        snap = engine.metrics_snapshot()
+        assert snap["active_slots"] == 0 and snap["pages_in_use"] == 0
+
+
+class TestStopConditions:
+    def test_max_tokens(self, engine):
+        res = engine.generate([6, 7], max_new_tokens=5)
+        assert res.finish_reason == "max_tokens" and len(res.tokens) == 5
+        assert res.n_prompt == 2 and res.ttft_ms is not None
+
+    def test_queued_deadline_expiry_raises(self, engine):
+        fut = engine.generate_async([1, 2], deadline=_clock() - 1.0)
+        with pytest.raises(DeadlineExceededError):
+            fut.result(timeout=30)
+
+    def test_mid_decode_deadline_is_a_stop_not_an_error(self, engine):
+        t0 = _ctr(engine, "tokens_out")
+        fut = engine.generate_async([2, 2], max_new_tokens=30,
+                                    slo_ms=3_600_000.0)
+        deadline = time.monotonic() + 30
+        while _ctr(engine, "tokens_out") <= t0:
+            assert time.monotonic() < deadline, "prefill never landed"
+            time.sleep(0.0005)
+        try:
+            CLOCK_OFFSET[0] = 7200.0        # jump far past the deadline
+            res = fut.result(timeout=60)
+        finally:
+            CLOCK_OFFSET[0] = 0.0
+        assert res.finish_reason == "deadline"
+        assert 1 <= len(res.tokens) < 30    # partial result, no exception
+
+
+class TestAdmission:
+    def test_shed_policy_raises_overloaded(self):
+        b = ContinuousBatcher(max_batch=2, slo_ms=1000, max_queue=1,
+                              admission="shed")
+        b.submit_request("spec-a")
+        with pytest.raises(OverloadedError):
+            b.submit_request("spec-b")
+        b.close(fail_pending=True)
+
+
+class TestHotSwap:
+    def test_swap_mid_decode_never_mixes_versions(self, engine, lm):
+        import jax
+
+        ref_v0 = _tokens(engine, [10, 11], max_new_tokens=24)
+        v1 = jax.tree_util.tree_map(
+            lambda a: (a * 1.37 + 0.05).astype(a.dtype), lm.params)
+        pre = _ctr(engine, "prefills")
+        fut_old = engine.generate_async([10, 11], max_new_tokens=24)
+        deadline = time.monotonic() + 30
+        while _ctr(engine, "prefills") <= pre:
+            assert time.monotonic() < deadline, "prefill never landed"
+            time.sleep(0.0005)
+        try:
+            engine.swap_model(v1, "v1")
+            fut_new = engine.generate_async([10, 11], max_new_tokens=24)
+            r_old = fut_old.result(timeout=60)
+            r_new = fut_new.result(timeout=60)
+            assert r_old.model_tag == "v0" and r_old.tokens == ref_v0
+            assert r_new.model_tag == "v1"
+            ref_v1 = _tokens(engine, [10, 11], max_new_tokens=24)  # pure v1
+            assert r_new.tokens == ref_v1 and ref_v1 != ref_v0
+        finally:
+            engine.swap_model(lm, "v0")
+        assert _tokens(engine, [10, 11], max_new_tokens=24) == ref_v0
+        assert engine.metrics_snapshot()["versions"] == ["v0"]  # v1 GC'd
+
+    def test_swap_rejects_mismatched_tree(self, engine, lm):
+        import jax
+
+        bad = jax.tree_util.tree_map(
+            lambda a: np.zeros(np.shape(a) + (2,), np.float32), lm.params)
+        with pytest.raises(ValueError):
+            engine.swap_model(bad, "vbad")
+
+
+class TestResilience:
+    def test_crash_retries_regenerate_identical_tokens(self, engine):
+        prompts = [[1, 2], [3, 4, 5], [6]]
+        refs = [_tokens(engine, p, max_new_tokens=6) for p in prompts]
+        c0 = {k: _ctr(engine, k)
+              for k in ("replica_crashes", "retries", "errors")}
+        engine._crash_next = True
+        futs = [engine.generate_async(p, max_new_tokens=6) for p in prompts]
+        got = [f.result(timeout=60) for f in futs]    # nothing stranded
+        assert [r.tokens for r in got] == refs
+        assert _ctr(engine, "replica_crashes") > c0["replica_crashes"]
+        assert _ctr(engine, "retries") > c0["retries"]
+        assert _ctr(engine, "errors") == c0["errors"]
+
+    def test_supervisor_respawns_dead_loop(self, engine, monkeypatch):
+        # the injected BaseException below is SUPPOSED to escape the
+        # loop thread — keep pytest's thread excepthook quiet about it
+        monkeypatch.setattr(threading, "excepthook", lambda args: None)
+        r0 = _ctr(engine, "replica_respawns")
+        orig = engine._step_once
+
+        def die_once():
+            engine._step_once = orig
+            raise KeyboardInterrupt    # BaseException: kills the thread
+
+        engine._step_once = die_once
+        engine.generate_async([1]).result(timeout=60)   # wakes + recovers
+        deadline = time.monotonic() + 30
+        while _ctr(engine, "replica_respawns") <= r0:
+            assert time.monotonic() < deadline, "supervisor never respawned"
+            time.sleep(0.005)
+        assert engine.health_snapshot()["ready"]
+        assert _tokens(engine, [1], max_new_tokens=2)   # still serving
+
+    def test_poison_isolated_and_pages_scrubbed(self, engine, lm):
+        import jax
+
+        ref = _tokens(engine, [12, 13], max_new_tokens=6)
+        ref_long = _tokens(engine, [14, 15], max_new_tokens=30)
+        nan = jax.tree_util.tree_map(
+            lambda a: np.full(np.shape(a), np.nan,
+                              np.asarray(a).dtype), lm.params)
+        p0 = _ctr(engine, "poison_isolated")
+        pre = _ctr(engine, "prefills")
+        fut_good = engine.generate_async([14, 15], max_new_tokens=30)
+        deadline = time.monotonic() + 30
+        while _ctr(engine, "prefills") <= pre:
+            assert time.monotonic() < deadline, "prefill never landed"
+            time.sleep(0.0005)
+        try:
+            engine.swap_model(nan, "vnan")
+            with pytest.raises(PoisonInputError):
+                engine.generate([16, 17], max_new_tokens=6)
+            # the co-batched in-flight request (old version) is unharmed
+            assert fut_good.result(timeout=60).tokens == ref_long
+        finally:
+            engine.swap_model(lm, "v0")
+        assert _ctr(engine, "poison_isolated") > p0
+        # scrub proof: the poisoned slot's recycled pages serve clean
+        # (a NaN row left in the pool would contaminate via 0 * NaN)
+        assert _tokens(engine, [12, 13], max_new_tokens=6) == ref
+
+
+class TestZeroServeTimeCompiles:
+    def test_compile_cache_frozen_across_varied_traffic(self, engine):
+        n0 = engine.compile_cache_size()
+        for prompt in ([1], [1, 2, 3], list(range(1, 9)),
+                       list(range(1, 18))):   # spans several buckets
+            engine.generate(prompt, max_new_tokens=3)
+        engine.generate([5, 6], max_new_tokens=4, temperature=0.9,
+                        top_k=5, top_p=0.9, seed=3)
+        engine.generate([5, 6], max_new_tokens=4, echo_logits=True)
+        futs = [engine.generate_async([i + 1], max_new_tokens=4)
+                for i in range(3)]
+        [f.result(timeout=60) for f in futs]
+        assert engine.compile_cache_size() == n0
+
+
+class TestHttpGenerate:
+    @pytest.fixture()
+    def server(self, engine):
+        from deeplearning4j_tpu.ui.server import UIServer
+        srv = UIServer(port=0).attach_decode_engine(engine).start()
+        yield srv
+        srv.stop()
+
+    def _post(self, srv, body):
+        data = body if isinstance(body, bytes) else json.dumps(body).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/generate", data=data,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_generate_ok_and_metrics(self, engine, server):
+        code, out = self._post(server, {"prompt_ids": [1, 2, 3],
+                                        "max_tokens": 4, "seed": 1})
+        assert code == 200 and len(out["tokens"]) == 4
+        assert out["finish_reason"] == "max_tokens" and out["n_prompt"] == 3
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics") as r:
+            m = json.loads(r.read())
+        snap = next(s for s in m["serving"] if "ttft_ms" in s)
+        assert snap["ttft_ms"]["count"] >= 1 and "tpot_ms" in snap
+
+    def test_error_mapping(self, server):
+        assert self._post(server, {"max_tokens": 2})[0] == 400
+        code, out = self._post(server, {"prompt_ids": [VOCAB + 5]})
+        assert (code, out["error_class"]) == (400, "bad_request")
+        assert self._post(server, b"{not json")[0] == 400
+        code, out = self._post(server, {"prompt_ids": [1], "slo_ms": 0.0})
+        assert (code, out["error_class"]) == (504, "deadline_exceeded")
+
+    def test_no_engine_is_503(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+        srv = UIServer(port=0).start()
+        try:
+            code, out = self._post(srv, {"prompt_ids": [1]})
+            assert (code, out["error_class"]) == (503, "unavailable")
+        finally:
+            srv.stop()
+
+
+class TestOneShotPredictRegression:
+    def test_mln_output_bitwise_unchanged_by_decode_engine(self):
+        import jax
+
+        from deeplearning4j_tpu.models import TransformerLM
+        from deeplearning4j_tpu.models.transformer import (
+            TransformerDecodeAdapter,
+        )
+
+        net = TransformerLM(vocab_size=32, n_layers=1, d_model=32,
+                            n_heads=2, max_len=16, seed=0, kernel="xla")
+        x = np.arange(24, dtype=np.int32).reshape(2, 12) % 32
+        before = np.asarray(net.output(x))
+        eng = DecodeEngine(TransformerDecodeAdapter(net), max_slots=1,
+                           page_size=8).load()
+        try:
+            res = eng.generate([1, 2, 3], max_new_tokens=6,
+                               echo_logits=True)
+            assert len(res.tokens) == 6
+            # the adapter's decode is bit-exact vs its own re-encode too
+            seq = np.zeros((1, 16), np.int32)
+            seq[0, :3] = [1, 2, 3]
+            seq[0, 3:9] = res.tokens
+            ref = np.asarray(jax.jit(eng.program.reencode)(
+                eng._versions[eng.current_tag], seq))[0]
+            for t in range(6):
+                assert np.array_equal(res.logits[t], ref[2 + t])
+        finally:
+            eng.shutdown()
+        after = np.asarray(net.output(x))
+        assert before.dtype == after.dtype
+        assert np.array_equal(before, after)
+
+
+class TestCliGenerate:
+    def test_transformer_checkpoint(self, tmp_path, capsys):
+        from deeplearning4j_tpu.cli import main
+        from deeplearning4j_tpu.models import TransformerLM
+
+        net = TransformerLM(vocab_size=48, n_layers=1, d_model=32,
+                            n_heads=2, max_len=16, seed=0, kernel="xla")
+        path = str(tmp_path / "tlm.zip")
+        net.save(path)
+        rc = main(["generate", "--model", path, "--prompt", "ab",
+                   "--max-tokens", "4", "--seed", "1"])
+        assert rc == 0
+        assert len(capsys.readouterr().out) > 0
+
+    def test_recurrent_checkpoint(self, tmp_path, capsys):
+        from deeplearning4j_tpu.cli import main
+        from deeplearning4j_tpu.models.textgen_lstm import TextGenerationLSTM
+
+        net = TextGenerationLSTM(vocab_size=48, hidden=16, seed=0)
+        path = str(tmp_path / "trnn.zip")
+        net.save(path)
+        rc = main(["generate", "--model", path, "--prompt", "ab",
+                   "--max-tokens", "4"])
+        assert rc == 0
+        assert len(capsys.readouterr().out) > 0

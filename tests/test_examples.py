@@ -20,9 +20,10 @@ SCRIPTS = sorted(f for f in os.listdir(_EX)
 
 
 def test_all_tutorial_numbers_present():
-    # the reference arc is 8 tutorials + the TPU flagship
+    # the reference arc is 8 tutorials + the TPU flagship + decode serving
     nums = {s.split("_")[0] for s in SCRIPTS}
-    assert nums == {"01", "02", "03", "04", "05", "06", "07", "08", "09"}
+    assert nums == {"01", "02", "03", "04", "05", "06", "07", "08", "09",
+                    "10"}
 
 
 @pytest.mark.parametrize("script", SCRIPTS)
