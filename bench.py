@@ -71,6 +71,15 @@ stderr, including:
     compiles across respawns, canary auto-rollback on exactly the
     regressed version, and chaos-off bit-identity with the pre-PR
     engine configuration (docs/SERVING.md "Failure model")
+  - fleet_load_chaos: the fleet-router resilience gate
+    (scripts/fleet_load_soak.py) — host_straggle/host_preempt/host_kill
+    faults (the kill fired mid-rolling-swap) against a 3-host fleet
+    under an open-loop diurnal+burst+heavy-tail trace, plus a clean
+    rolling promote and a million-request scale arm, hard-gated on
+    zero stranded futures, at-most-once delivery, zero version mixing
+    after promote/rollback, bounded post-fault p99 and shed rate, and
+    chaos-off bit-identity with a single-host engine
+    (docs/SERVING.md "Fleet serving")
   - decode_tokens_per_sec: the autoregressive-decode A/B gate
     (scripts/decode_ab.py) — static-batch full-re-encode decoding vs
     serving.DecodeEngine (paged KV-cache, bucketed prefill/decode split,
@@ -1132,6 +1141,73 @@ def bench_serving_chaos():
             "wall_seconds": soak["wall_seconds"]}
 
 
+def bench_fleet_load():
+    """Config 18: fleet load + chaos (scripts/fleet_load_soak.py; CPU
+    subprocess — the routing/failover logic under test is host-side).
+    An open-loop seeded trace (diurnal rate, burst windows, heavy-tail
+    sizes) against a 3-host fleet router while every fleet fault kind
+    fires driver-side: a straggling host (dispatch must steer away), a
+    preemption notice (drain + re-place, planned leave), and a host
+    KILLED mid-rolling-swap (the already-swapped survivors must roll
+    back; the aborted version never appears after the call returns).
+    Plus a clean registry promote through the router and a memory-
+    bounded million-request scale arm streamed through the router
+    against instant synthetic hosts.  HARD gates: zero stranded
+    futures, at-most-once delivery (zero double-delivered), zero
+    version mixing after promote/rollback, p99 under the SLO bound
+    overall AND inside the 1s post-fault windows, bounded shed rate,
+    zero router in-flight after shutdown, and a chaos-off 2-host fleet
+    arm whose outputs are BIT-IDENTICAL to a single-host engine with
+    every resilience counter at zero.  The reported value is router
+    throughput on the scale arm."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    script = os.path.join(_REPO, "scripts", "fleet_load_soak.py")
+    cmd = [sys.executable, script] + (["--quick"] if QUICK else [])
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=1800, cwd=_REPO)
+    if p.returncode != 0:
+        raise RuntimeError(f"fleet_load_soak failed (rc={p.returncode}): "
+                           f"{p.stdout[-500:]} {p.stderr[-1000:]}")
+    soak = json.loads(p.stdout.strip().splitlines()[-1])
+    if soak.get("stranded") != 0 or soak.get("scale_stranded") != 0:
+        raise RuntimeError(f"fleet soak STRANDED futures: {soak}")
+    if soak.get("double_delivered") != 0:
+        raise RuntimeError(f"at-most-once delivery gate FAILED: {soak}")
+    if (soak.get("unmatched_versions") != 0
+            or soak.get("v1_after_promote") != 0
+            or soak.get("v3_after_rollback") != 0):
+        raise RuntimeError(f"version-mixing gate FAILED: {soak}")
+    if not soak.get("p99_ok"):
+        raise RuntimeError(f"fleet p99 gate FAILED post-fault: {soak}")
+    if not soak.get("promote_ok") or not soak.get("swap_rolled_back"):
+        raise RuntimeError(f"rolling swap/rollback gate FAILED: {soak}")
+    if not soak.get("off_behavior_identical"):
+        raise RuntimeError("chaos-off fleet is no longer behavior-"
+                           f"identical to a single host: {soak}")
+    if not soak.get("soak_ok"):
+        raise RuntimeError(f"fleet load soak gate FAILED: {soak}")
+    return {"metric": "fleet_load_chaos",
+            "value": soak["scale_rps"], "unit": "router req/sec",
+            "platform": soak["platform"],
+            "faults_injected": soak["faults_injected"],
+            "retries": soak["retries"],
+            "timeouts": soak["timeouts"],
+            "late_discards": soak["late_discards"],
+            "affinity_routed": soak["affinity_routed"],
+            "shed_rate": soak["shed_rate"],
+            "p99_ms": soak["p99_ms"],
+            "p99_post_fault_ms": soak["p99_post_fault_ms"],
+            "scale_requests": soak["scale_requests"],
+            "scale_peak_outstanding": soak["scale_peak_outstanding"],
+            "stranded": 0, "double_delivered": 0,
+            "off_behavior_identical": True,
+            "wall_seconds": soak["wall_seconds"]}
+
+
 def bench_chaos_recovery():
     """Config 11: chaos-tested fault recovery (scripts/chaos_soak.py; the
     subprocess mechanism, CPU — fault injection needs no accelerator).  A
@@ -1582,6 +1658,7 @@ def main() -> None:
                      ("preemption_recovery", bench_preemption),
                      ("serving_throughput", bench_serving),
                      ("serving_chaos_recovery", bench_serving_chaos),
+                     ("fleet_load_chaos", bench_fleet_load),
                      ("input_pipeline_overlap", bench_input_pipeline),
                      ("telemetry_overhead", bench_telemetry_overhead),
                      ("static_analysis_clean", bench_static_analysis),

@@ -518,31 +518,71 @@ def cmd_predict(args) -> int:
     return 0
 
 
+def _serve_queue_depth(engine) -> int:
+    """Pending work still inside a serving engine (or fleet router) —
+    the drain loop below waits for this to reach zero."""
+    batcher = getattr(engine, "batcher", None)
+    if batcher is not None:
+        return batcher.qsize()
+    return int(engine.metrics_snapshot().get("queue_depth", 0))
+
+
 def cmd_serve(args) -> int:
     """Production serving (docs/SERVING.md): load a checkpoint into the
-    versioned registry, AOT-warm every shape bucket, and serve — either
-    over HTTP (POST /predict + GET /metrics on the UI server) or as a
-    --smoke self-test that pushes synthetic requests through the engine
-    and prints the metrics snapshot."""
-    from .serving import Engine, ModelRegistry
+    versioned registry, AOT-warm every shape bucket, and serve — over
+    HTTP (POST /predict + GET /metrics on the UI server), as a --fleet
+    router fronting remote serve hosts, or as a --smoke self-test that
+    pushes synthetic requests through the engine and prints the metrics
+    snapshot.
+
+    A SIGTERM/SIGUSR1 preemption notice (docs/FAULT_TOLERANCE.md env
+    contract) triggers a graceful drain: admission stops (new requests
+    shed with HTTP 429), in-flight requests finish within the grace
+    budget, and the process exits with ``PREEMPTED_EXIT_CODE`` so the
+    pod launcher relaunches it without burning restart budget."""
+    import os
+    import time
+
+    from .parallel.distributed import ENV_SERVE_PORT, PREEMPTED_EXIT_CODE
+    from .parallel.launcher import Heartbeat
+    from .parallel.preemption import PreemptionHandler
+    from .serving import Engine, FleetRouter, HttpHost, ModelRegistry
 
     trace_path = _setup_trace(args)
-    reg = ModelRegistry()
-    name = args.name
-    version = reg.load(name, args.model, version=args.version)
-    reg.set_alias(name, "prod", version)
-    engine = Engine.from_registry(
-        reg, name, "prod", max_batch=args.max_batch, slo_ms=args.slo_ms,
-        replicas=args.replicas, max_queue=args.queue_cap,
-        admission=args.admission,
-        forward_timeout_s=args.forward_timeout,
-        max_retries=args.max_retries,
-        breaker_threshold=args.breaker_threshold)
-    engine.load()
-    print(f"serving {name} v{version} (alias 'prod'): "
-          f"max_batch={args.max_batch}, slo={args.slo_ms}ms, "
-          f"replicas={len(engine._replicas)}, admission={args.admission}, "
-          f"warmed buckets {engine.batcher.buckets}")
+    if not args.fleet and not args.model:
+        raise SystemExit("serve needs --model (or --fleet HOST:PORT,...)")
+    if args.fleet:
+        if args.smoke:
+            raise SystemExit("serve --smoke is incompatible with --fleet")
+        engine = FleetRouter(
+            max_retries=args.max_retries,
+            request_timeout_s=args.forward_timeout,
+            breaker_threshold=args.breaker_threshold)
+        for ep in [e.strip() for e in args.fleet.split(",") if e.strip()]:
+            url = ep if ep.startswith("http") else f"http://{ep}"
+            engine.add_host(ep, engine=HttpHost(
+                url, timeout_s=args.forward_timeout or 5.0))
+        print(f"fleet router over {sorted(engine.hosts())}: "
+              f"max_retries={args.max_retries}, "
+              f"request_timeout={args.forward_timeout}")
+    else:
+        reg = ModelRegistry()
+        name = args.name
+        version = reg.load(name, args.model, version=args.version)
+        reg.set_alias(name, "prod", version)
+        engine = Engine.from_registry(
+            reg, name, "prod", max_batch=args.max_batch, slo_ms=args.slo_ms,
+            replicas=args.replicas, max_queue=args.queue_cap,
+            admission=args.admission,
+            forward_timeout_s=args.forward_timeout,
+            max_retries=args.max_retries,
+            breaker_threshold=args.breaker_threshold)
+        engine.load()
+        print(f"serving {name} v{version} (alias 'prod'): "
+              f"max_batch={args.max_batch}, slo={args.slo_ms}ms, "
+              f"replicas={len(engine._replicas)}, "
+              f"admission={args.admission}, "
+              f"warmed buckets {engine.batcher.buckets}")
     if args.smoke:
         shape = engine._example_shape
         rng = np.random.default_rng(0)
@@ -557,21 +597,40 @@ def cmd_serve(args) -> int:
         return 0
     from .ui import UIServer
 
-    server = UIServer(port=args.port, host=args.host).attach_engine(engine)
+    # under the pod launcher each serving worker gets a stable port
+    # assignment via the env contract; an explicit --port wins
+    port = args.port
+    if port == 9000 and os.environ.get(ENV_SERVE_PORT):
+        port = int(os.environ[ENV_SERVE_PORT])
+    server = UIServer(port=port, host=args.host).attach_engine(engine)
     server.start()
+    heartbeat = Heartbeat.start_from_env()
+    handler = PreemptionHandler.install_from_env()
     print(f"listening on http://{args.host}:{server.port} — "
-          "POST /predict, GET /metrics, GET /healthz, GET /trace")
-    import threading
-
+          "POST /predict, GET /metrics, GET /healthz, GET /trace",
+          flush=True)
+    preempted = False
     try:
-        threading.Event().wait()
+        while not handler.requested:
+            time.sleep(0.2)
+        preempted = True
+        # graceful drain: shed new admissions, let in-flight requests
+        # finish inside the grace window, then hand the port back
+        engine.begin_drain()
+        print(f"serve: preemption notice — draining "
+              f"({handler.remaining_s:.1f}s grace)", flush=True)
+        while _serve_queue_depth(engine) > 0 and handler.remaining_s > 0.5:
+            time.sleep(0.05)
     except KeyboardInterrupt:
         pass
     finally:
         server.stop()
         engine.shutdown()
+        if heartbeat is not None:
+            heartbeat.stop()
+        handler.uninstall()
         _flush_trace(trace_path)
-    return 0
+    return PREEMPTED_EXIT_CODE if preempted else 0
 
 
 def _sample_probs(probs: np.ndarray, temperature: float, top_k: int,
@@ -758,11 +817,15 @@ def cmd_launch(args) -> int:
         grace_s=args.grace,
         straggler_factor=args.straggler_factor,
         straggler_beats=args.straggler_beats,
-        straggler_policy=args.straggler_policy)
+        straggler_policy=args.straggler_policy,
+        serve=args.serve)
     print(f"launch: {args.nprocs} worker(s) x "
           f"{args.devices_per_proc or 'default'} device(s), "
           f"bootstrap={args.bootstrap}, run dir {run_dir}"
           + (f", chaos {chaos}" if chaos else ""))
+    if args.serve:
+        print("launch: fleet endpoints "
+              + ",".join(launcher.serve_endpoints()))
     report = launcher.run()
     print(f"launch: completed={report['completed']} "
           f"restarts={report['restarts']} "
@@ -952,6 +1015,12 @@ def build_parser() -> argparse.ArgumentParser:
                     "incarnation files under RUN_DIR/trace) and merge "
                     "them — plus the launcher's own membership/leave/join "
                     "events — into ONE pod-timeline Chrome trace at PATH")
+    ln.add_argument("--serve", action="store_true",
+                    help="serving-fleet mode: assign each worker a stable "
+                    "serve port (exported as DL4J_TPU_SERVE_PORT, stable "
+                    "across relaunch) and print the fleet endpoints — pair "
+                    "with a 'serve' worker command and a `serve --fleet` "
+                    "router (docs/SERVING.md 'Fleet serving')")
     ln.add_argument("--join", action="store_true",
                     help="join an existing cluster as one worker instead "
                     "of forking (one `launch --join` per host on a pod)")
@@ -976,7 +1045,14 @@ def build_parser() -> argparse.ArgumentParser:
     r.set_defaults(fn=cmd_predict)
 
     v = sub.add_parser("serve", help="serve a saved model (docs/SERVING.md)")
-    v.add_argument("--model", required=True, help="checkpoint zip to serve")
+    v.add_argument("--model", default=None,
+                   help="checkpoint zip to serve (required unless --fleet)")
+    v.add_argument("--fleet", metavar="HOST:PORT,...",
+                   help="run a fleet router instead of a local engine: "
+                   "front the comma-separated serve hosts with "
+                   "least-loaded dispatch, session affinity, dead-host "
+                   "failover, and rolling promote (docs/SERVING.md "
+                   "'Fleet serving')")
     v.add_argument("--name", default="model",
                    help="registry name for the model (default: 'model')")
     v.add_argument("--version", type=int, default=None,

@@ -61,8 +61,8 @@ from .elastic import (
     RecoverableInfraError, StepHangError,
 )
 from .chaos import (
-    ChaosInjector, FaultKind, FaultSchedule, ServingChaos, bitflip_file,
-    truncate_file,
+    ChaosInjector, FaultKind, FaultSchedule, FleetChaos, ServingChaos,
+    bitflip_file, truncate_file,
 )
 from .moe import MoE, init_moe_params, moe_forward_dense, moe_forward_ep
 from .distributed import (

@@ -106,11 +106,25 @@ class FaultKind:
     #: shadow-traffic comparison must auto-roll-back (driver-side: the
     #: workload registers the bad version and calls set_alias(canary=))
     BAD_VERSION = "bad_version"
+    #: a whole serving HOST dies under live traffic (engine shutdown /
+    #: process kill) — the fleet router must fail it over: in-flight
+    #: futures resolve, survivors absorb the retries, nothing stranded
+    #: (scripts/fleet_load_soak.py schedules one mid-rolling-swap)
+    HOST_KILL = "host_kill"
+    #: a serving host takes an ANNOUNCED preemption notice — the router
+    #: drains it within the grace budget (peers absorb the load) and
+    #: takes it out of rotation as a planned leave
+    HOST_PREEMPT = "host_preempt"
+    #: a serving host turns straggler: every request it serves from the
+    #: scheduled one on is slowed — least-loaded routing plus per-request
+    #: timeouts must steer traffic away without failing the fleet SLO
+    HOST_STRAGGLE = "host_straggle"
 
     ALL = (DEVICE_LOSS, CKPT_WRITE_CRASH, CKPT_TRUNCATE, CKPT_BITFLIP,
            HUNG_STEP, NAN_GRADS, PROC_KILL, PROC_HANG,
            PREEMPT_NOTICE, COORD_KILL, SLOW_WORKER,
-           REPLICA_CRASH, REPLICA_HANG, POISON_INPUT, BAD_VERSION)
+           REPLICA_CRASH, REPLICA_HANG, POISON_INPUT, BAD_VERSION,
+           HOST_KILL, HOST_PREEMPT, HOST_STRAGGLE)
 
     #: kinds that take down the whole PROCESS — only meaningful under a
     #: multi-process launcher (in-process soaks must not schedule them).
@@ -129,6 +143,12 @@ class FaultKind:
     #: the last two are DRIVER-side (the workload injects them)
     SERVING_KINDS = (REPLICA_CRASH, REPLICA_HANG, POISON_INPUT, BAD_VERSION)
     SERVING_ENGINE_KINDS = (REPLICA_CRASH, REPLICA_HANG)
+
+    #: fleet-level fault kinds (scripts/fleet_load_soak.py) — all
+    #: DRIVER-side: the load harness pops them per submitted request and
+    #: acts on the fleet (kill/preempt/slow a host); the router under
+    #: test only sees the consequences
+    FLEET_KINDS = (HOST_KILL, HOST_PREEMPT, HOST_STRAGGLE)
 
 
 def truncate_file(path: str, keep_fraction: float = 0.5) -> None:
@@ -446,6 +466,56 @@ class ServingChaos:
                                   replica=replica_idx)
                 logger.warning("serving chaos @batch %d: %s (replica %d)",
                                self.batch_index, kind, replica_idx)
+        return kinds
+
+    def injected(self, kind: Optional[str] = None) -> int:
+        with self._lock:
+            if kind is None:
+                return len(self.events)
+            return sum(1 for e in self.events if e["kind"] == kind)
+
+
+class FleetChaos:
+    """Deterministic fault injection for a serving FLEET — the fleet
+    analog of :class:`ServingChaos`, keyed by the 1-based index of
+    requests SUBMITTED to the router (not batches executed: the load
+    harness is open-loop, so submission order is the deterministic,
+    replayable axis — execution order under failover is not).
+
+    All fleet kinds are driver-side: the load harness calls
+    ``pop_request()`` before each submission and acts on what comes back
+    (kill a host's engine, deliver a preemption notice, slow a host) —
+    the :class:`~..serving.fleet.FleetRouter` under test only observes
+    the consequences.  See scripts/fleet_load_soak.py.
+    """
+
+    def __init__(self, schedule: FaultSchedule,
+                 clock: Callable[[], float] = time.monotonic):
+        for kinds in schedule.faults.values():
+            for kind in kinds:
+                if kind not in FaultKind.FLEET_KINDS:
+                    raise ValueError(
+                        f"{kind!r} is not a fleet fault — FleetChaos takes "
+                        f"{FaultKind.FLEET_KINDS}")
+        self.schedule = schedule
+        self.clock = clock
+        self.request_index = 0
+        self.events: List[dict] = []
+        self._lock = threading.Lock()
+
+    def pop_request(self) -> List[str]:
+        """Faults scheduled for the next request index, consumed.
+        Called by the load harness once per submitted request."""
+        with self._lock:
+            self.request_index += 1
+            kinds = self.schedule.pop(self.request_index)
+            for kind in kinds:
+                self.events.append({"request": self.request_index,
+                                    "kind": kind, "t": self.clock()})
+                obs_trace.instant("fault", cat="chaos", kind=kind,
+                                  request=self.request_index)
+                logger.warning("fleet chaos @request %d: %s",
+                               self.request_index, kind)
         return kinds
 
     def injected(self, kind: Optional[str] = None) -> int:

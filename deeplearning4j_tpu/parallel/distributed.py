@@ -50,6 +50,10 @@ ENV_GRACE_S = "DL4J_TPU_GRACE_S"
 #: survivor with the lowest alive id from the membership ledger instead
 #: of dying on CoordinatorUnreachableError (launcher.elect_coordinator)
 ENV_COORD_PORTS = "DL4J_TPU_COORD_PORTS"
+#: HTTP serving port assigned to this worker by the launcher when it was
+#: started with ``--serve`` — ``cmd_serve`` binds its UIServer here (and
+#: a fleet router finds every host at the launcher's serve_endpoints())
+ENV_SERVE_PORT = "DL4J_TPU_SERVE_PORT"
 
 #: distinct exit code for a PLANNED leave: the worker received a
 #: preemption notice, wrote its emergency checkpoint, and exited on

@@ -54,7 +54,7 @@ from ..obs.metrics import get_registry, merge_snapshots
 from .distributed import (
     ENV_CHAOS, ENV_CONNECT_TIMEOUT, ENV_COORD_PORTS, ENV_COORDINATOR,
     ENV_GRACE_S, ENV_INCARNATION, ENV_NUM_PROCESSES, ENV_PROCESS_ID,
-    ENV_RUN_DIR, ENV_TRACE_DIR, PREEMPTED_EXIT_CODE,
+    ENV_RUN_DIR, ENV_SERVE_PORT, ENV_TRACE_DIR, PREEMPTED_EXIT_CODE,
     CoordinatorUnreachableError, initialize, resolve_process_index,
 )
 from .elastic import FailureDetector, RecoverableInfraError
@@ -607,6 +607,7 @@ class PodLauncher:
                  straggler_factor: float = 2.0,
                  straggler_beats: int = 3,
                  straggler_policy: str = "flag",
+                 serve: bool = False,
                  clock: Callable[[], float] = time.time):
         if num_workers < 1:
             raise ValueError(f"num_workers must be >= 1, got {num_workers}")
@@ -661,6 +662,14 @@ class PodLauncher:
         self.straggler_factor = straggler_factor
         self.straggler_beats = max(1, int(straggler_beats))
         self.straggler_policy = straggler_policy
+        # serving worker role (``launch --serve``): every worker gets a
+        # preassigned HTTP port exported as DL4J_TPU_SERVE_PORT — a
+        # serve-role worker binds its UIServer there, and a fleet router
+        # (serving/fleet.py) reaches the whole pod via serve_endpoints();
+        # ports are STABLE across relaunches so a recovered host rejoins
+        # the fleet at the same address
+        self.serve_ports: Optional[List[int]] = (
+            [free_port() for _ in range(num_workers)] if serve else None)
         # one injectable wall clock shared with the membership ledger:
         # launcher event times, notice deadlines and heartbeat staleness
         # all read the SAME clock, and fake-clock tests can drive it
@@ -704,6 +713,14 @@ class PodLauncher:
                 "stragglers_flagged": sum(1 for h in self.handles
                                           if h.straggler_flagged),
                 "events": by_kind}
+
+    def serve_endpoints(self) -> List[str]:
+        """``host:port`` per worker when launched with ``serve=True``
+        (``launch --serve``) — feed these to ``serve --fleet`` or
+        ``FleetRouter`` over ``HttpHost``s."""
+        if self.serve_ports is None:
+            raise RuntimeError("launcher was not started with serve=True")
+        return [f"127.0.0.1:{p}" for p in self.serve_ports]
 
     # -- env / spawn -------------------------------------------------------
 
@@ -761,6 +778,8 @@ class PodLauncher:
                 env["MEGASCALE_NUM_SLICES"] = str(self.megascale_slices)
         if self.trace_dir:
             env[ENV_TRACE_DIR] = self.trace_dir
+        if self.serve_ports is not None:
+            env[ENV_SERVE_PORT] = str(self.serve_ports[h.process_id])
         spec = self.chaos.get(h.process_id)
         if spec and h.incarnation == 0:
             env[ENV_CHAOS] = spec     # consumed once per RUN: a relaunched

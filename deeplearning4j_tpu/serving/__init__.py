@@ -41,13 +41,16 @@ from .engine import (
     Engine, PoisonInputError, ReplicaCrashError, ReplicaHungError,
     ServingUnavailableError,
 )
-from .metrics import DecodeMetrics, LatencyHistogram, ServingMetrics
+from .fleet import FleetHost, FleetRouter, FleetTimeoutError, HttpHost
+from .metrics import (DecodeMetrics, FleetMetrics, LatencyHistogram,
+                      ServingMetrics)
 from .registry import ModelRegistry
 
 __all__ = [
     "ADMISSION_POLICIES", "ContinuousBatcher", "DeadlineExceededError",
     "DecodeEngine", "DecodeMetrics", "DynamicBatcher", "Engine",
-    "GenerationResult", "LatencyHistogram", "ModelRegistry",
+    "FleetHost", "FleetMetrics", "FleetRouter", "FleetTimeoutError",
+    "GenerationResult", "HttpHost", "LatencyHistogram", "ModelRegistry",
     "OverloadedError", "PoisonInputError", "ReplicaCrashError",
     "ReplicaHungError", "ServingMetrics", "ServingUnavailableError",
     "pow2_buckets",

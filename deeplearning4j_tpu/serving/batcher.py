@@ -113,6 +113,7 @@ class DynamicBatcher:
         self._nonempty = threading.Condition(self._lock)
         self._space = threading.Condition(self._lock)
         self._closed = False
+        self._draining = False
         # bucket -> EMA device ms; the exec budget subtracted from the
         # oldest deadline when deciding how long a batch may keep filling
         self._exec_ema_ms: Dict[int, float] = {}
@@ -162,6 +163,12 @@ class DynamicBatcher:
             if self._closed:
                 fut.set_exception(RuntimeError("serving engine is shut down"))
                 return fut
+            if self._draining:
+                if self.metrics:
+                    self.metrics.inc("shed")
+                raise OverloadedError(
+                    "admission stopped: engine is draining (preemption "
+                    "notice)")
             if len(self._pending) >= self.max_queue:
                 if self.admission == "shed":
                     if self.metrics:
@@ -169,12 +176,19 @@ class DynamicBatcher:
                     raise OverloadedError(
                         f"admission queue full ({self.max_queue} requests); "
                         "policy=shed")
-                while len(self._pending) >= self.max_queue and not self._closed:
+                while (len(self._pending) >= self.max_queue
+                       and not self._closed and not self._draining):
                     self._space.wait(timeout=0.1)
                 if self._closed:
                     fut.set_exception(
                         RuntimeError("serving engine is shut down"))
                     return fut
+                if self._draining:
+                    if self.metrics:
+                        self.metrics.inc("shed")
+                    raise OverloadedError(
+                        "admission stopped: engine is draining (preemption "
+                        "notice)")
             self._pending.append(_Request(x, fut, now, dl))
             self._nonempty.notify()
         return fut
@@ -258,6 +272,22 @@ class DynamicBatcher:
 
     # -- shutdown ----------------------------------------------------------
 
+    def begin_drain(self) -> None:
+        """Stop admission without failing anything queued: every
+        SUBSEQUENT submit sheds (``OverloadedError``, → HTTP 429)
+        regardless of the admission policy — block-policy callers
+        already waiting for space are woken and shed too — while queued
+        requests keep draining through ``next_batch``/``admit``.  The
+        graceful-preemption front half: shed new, finish in-flight,
+        then ``close()``.  Idempotent."""
+        with self._lock:
+            self._draining = True
+            self._space.notify_all()
+
+    def draining(self) -> bool:
+        with self._lock:
+            return self._draining
+
     def close(self, fail_pending: bool = True) -> None:
         """Idempotent.  With ``fail_pending`` every queued request —
         including one enqueued concurrently with shutdown — resolves
@@ -304,6 +334,12 @@ class ContinuousBatcher(DynamicBatcher):
             if self._closed:
                 fut.set_exception(RuntimeError("serving engine is shut down"))
                 return fut
+            if self._draining:
+                if self.metrics:
+                    self.metrics.inc("shed")
+                raise OverloadedError(
+                    "admission stopped: engine is draining (preemption "
+                    "notice)")
             if len(self._pending) >= self.max_queue:
                 if self.admission == "shed":
                     if self.metrics:
@@ -311,12 +347,19 @@ class ContinuousBatcher(DynamicBatcher):
                     raise OverloadedError(
                         f"admission queue full ({self.max_queue} requests); "
                         "policy=shed")
-                while len(self._pending) >= self.max_queue and not self._closed:
+                while (len(self._pending) >= self.max_queue
+                       and not self._closed and not self._draining):
                     self._space.wait(timeout=0.1)
                 if self._closed:
                     fut.set_exception(
                         RuntimeError("serving engine is shut down"))
                     return fut
+                if self._draining:
+                    if self.metrics:
+                        self.metrics.inc("shed")
+                    raise OverloadedError(
+                        "admission stopped: engine is draining (preemption "
+                        "notice)")
             r = _Request(np.empty((1, 0), np.float32), fut, now, dl)
             r.payload = payload
             self._pending.append(r)

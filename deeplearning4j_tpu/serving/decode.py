@@ -741,6 +741,14 @@ class DecodeEngine:
         return {"status": "ready" if ready else "unready", "ready": ready,
                 "kind": "decode", "model": self.current_tag}
 
+    def begin_drain(self) -> None:
+        """Stop admission (new submissions shed → 429) while queued and
+        in-flight generations complete — the decode half of the
+        graceful SIGTERM drain (docs/SERVING.md)."""
+        self.batcher.begin_drain()
+        self.metrics.inc("drains")
+        obs_trace.instant("serve/drain", cat="serve")
+
     def shutdown(self) -> None:
         """Idempotent; every queued AND in-flight future resolves."""
         with self._lock:

@@ -1052,6 +1052,16 @@ class Engine:
             snap["canary_decisions"] = list(self._canary_log[-8:])
         return snap
 
+    def begin_drain(self) -> None:
+        """Graceful-preemption front half (docs/SERVING.md "Graceful
+        SIGTERM drain"): stop admission — every subsequent submission
+        sheds (``OverloadedError`` → HTTP 429) — while queued and
+        in-flight batches complete normally.  Call ``shutdown()`` once
+        ``queue_depth`` reaches zero or the grace budget runs out."""
+        self.batcher.begin_drain()
+        self.metrics.inc("drains")
+        obs_trace.instant("serve/drain", cat="serve")
+
     def shutdown(self, timeout: float = 5.0) -> None:
         """Deterministic shutdown: every request — queued, in a replica
         queue, or submitted concurrently with this call — resolves

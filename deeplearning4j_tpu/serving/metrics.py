@@ -165,6 +165,67 @@ class ServingMetrics:
         }
 
 
+# every counter a fresh fleet router reports as zero (docs/SERVING.md
+# fleet section: dispatch set, then failover, then swap/drain lifecycle)
+_FLEET_COUNTER_KEYS = (
+    "requests", "dispatched", "delivered", "retries", "shed", "failed",
+    "timeouts", "late_discards", "affinity_routed",
+    "host_failures", "host_down", "host_up",
+    "drains", "preempt_drains", "rolling_swaps", "swap_hosts", "rollbacks",
+)
+
+
+class FleetMetrics:
+    """Per-router metric set for the fleet router (serving/fleet.py):
+    fleet end-to-end latency (submit → delivered, across retries and
+    failover) plus dispatch/failover/swap counters and host-population
+    gauges.  Exported like ``ServingMetrics``: a plain ``snapshot()``
+    dict, a typed per-router registry, and a collector named ``fleet``
+    on the process-global registry so one ``/metrics`` response carries
+    the router beside every per-host engine (docs/OBSERVABILITY.md)."""
+
+    def __init__(self, buckets_ms: Sequence[float] = DEFAULT_BUCKETS_MS,
+                 registry: MetricsRegistry = None):
+        self.registry = registry or MetricsRegistry()
+        self.e2e = self.registry.register(
+            LatencyHistogram(buckets_ms, name="fleet_e2e_ms"))
+        self._counters = {k: self.registry.counter(k)
+                          for k in _FLEET_COUNTER_KEYS}
+        self._lock = threading.Lock()
+        self.hosts_up = self.registry.gauge("hosts_up")
+        self.hosts_up.set(0)
+        self.hosts_total = self.registry.gauge("hosts_total")
+        self.hosts_total.set(0)
+        self._t0 = time.monotonic()
+        self.global_name = get_registry().register_collector(
+            "fleet", self.snapshot, unique=True)
+
+    def inc(self, key: str, n: int = 1) -> None:
+        c = self._counters.get(key)
+        if c is None:        # open key set, matching ServingMetrics
+            with self._lock:
+                c = self._counters.get(key)
+                if c is None:
+                    c = self._counters[key] = self.registry.counter(key)
+        c.inc(n)
+
+    def snapshot(self) -> dict:
+        c: Dict[str, int] = {}
+        for k, counter in list(self._counters.items()):
+            v = counter.value()
+            c[k] = int(v) if float(v).is_integer() else v
+        elapsed = time.monotonic() - self._t0
+        return {
+            "counters": c,
+            "hosts_up": int(self.hosts_up.value()),
+            "hosts_total": int(self.hosts_total.value()),
+            "requests_per_sec": round(c["requests"] / elapsed, 2)
+            if elapsed > 0 else None,
+            "uptime_sec": round(elapsed, 3),
+            "fleet_e2e_ms": self.e2e.snapshot(),
+        }
+
+
 # every counter a fresh decode engine reports as zero (docs/SERVING.md
 # decode section: throughput set, then stop conditions, then resilience)
 _DECODE_COUNTER_KEYS = (

@@ -181,13 +181,39 @@ class UIServer:
     def _healthz_json(self):
         """(status, payload) for GET /healthz: liveness + readiness with
         per-replica health (healthy/degraded/dead) from the engine's
-        supervisor.  503 when no engine is attached or no replica is
-        dispatchable — load balancers can take the box out of rotation."""
-        if self._engine is None:
+        supervisor.  Readiness covers EVERY attached engine — a host
+        serving only decode traffic answers from its DecodeEngine's
+        health, not a blanket 503 (ready-with-no-evidence is as wrong as
+        unready-with-evidence).  With both engines attached, ready means
+        BOTH are ready (each serves its own endpoint; a dead one must
+        take the box out of rotation).  503 when nothing is attached or
+        some attached engine is not dispatchable."""
+        engines = {}
+        if self._engine is not None:
+            engines["predict"] = self._engine
+        if self._decode_engine is not None:
+            engines["decode"] = self._decode_engine
+        if not engines:
             return 503, {"status": "unready", "ready": False,
                          "error": "no serving engine attached"}
-        snap = self._engine.health_snapshot()
-        return (200 if snap.get("ready") else 503), snap
+
+        def _snap(e):
+            s = e.health_snapshot()
+            tag = getattr(e, "current_tag", None)
+            if tag and "model" not in s:   # lets a remote FleetRouter
+                s["model"] = tag           # read each host's live tag
+            return s
+
+        if len(engines) == 1:
+            snap = _snap(next(iter(engines.values())))
+            return (200 if snap.get("ready") else 503), snap
+        per = {k: _snap(e) for k, e in engines.items()}
+        ready = all(s.get("ready") for s in per.values())
+        status = ("ok" if all(s.get("status") == "ok"
+                              for s in per.values())
+                  else "degraded" if ready else "unready")
+        return (200 if ready else 503), {"status": status, "ready": ready,
+                                         "engines": per}
 
     def enable_remote_listener(self) -> "UIServer":
         """Accept POSTed stats on /remote into the first attached storage

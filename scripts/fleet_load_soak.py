@@ -1,0 +1,626 @@
+"""Fleet load soak: the cross-host router under scripted chaos and
+million-request open-loop load (bench config ``fleet_load_chaos``).
+
+Arms (CPU; the routing/failover logic under test is host-side — run
+with ``JAX_PLATFORMS=cpu``, as bench.py's subprocess harness does):
+
+  off-identity — the SAME seeded request trace served synchronously
+      (one outstanding request at a time, so every batch is a singleton
+      and bitwise-comparable) by (a) a plain single-host engine and
+      (b) a 2-host fleet router over engines with identical weights.
+      Outputs must be BIT-IDENTICAL and every resilience counter zero:
+      the fleet machinery idle changes no behavior.
+
+  chaos — an open-loop trace (diurnal rate + burst windows +
+      heavy-tailed request sizes) against a 3-host fleet with every
+      fleet fault kind firing (FleetChaos, keyed by request index —
+      all driver-side):
+        * host_straggle: one host's service latency spikes; the
+          least-loaded dispatch must steer traffic away while its
+          in-flight count stays elevated.
+        * host_preempt: a host takes a SIGTERM-style preemption notice;
+          the router drains it within the grace budget and re-places
+          its traffic on the survivors (planned leave, PR-9 semantics).
+        * host_kill mid-rolling-swap: a second rolling swap is running
+          when the chaos kill arms — the host dies exactly as the swap
+          reaches it.  The already-swapped survivors must roll back;
+          the fleet never serves the aborted version past the end of
+          the call.
+      Plus a CLEAN rolling promote (registry `promote` through the
+      router) mid-run: completes, alias moves, zero version mixing
+      after it returns.
+
+  scale — a memory-bounded million-request arm: the seeded trace is
+      STREAMED (generator, never materialized) through the router
+      against instant synthetic hosts, gating zero stranded futures
+      and bounded peak in-flight at full pipeline rate.
+
+Gates (consumed by bench.py ``fleet_load_chaos``):
+  - stranded == 0 (all arms): every submitted future resolves (result
+    or typed error) within the drain timeout — nothing hangs, ever
+  - double_delivered == 0: no request's future ever resolves twice
+    (at-most-once delivery; a timed-out attempt's late success is a
+    counted discard, never a second delivery)
+  - version gates: every successful response matches exactly ONE known
+    model version; after the clean promote returns, no old-version
+    response for later submissions; after the mid-swap rollback
+    returns, the aborted version never appears again
+  - p99_ok: end-to-end p99 (overall AND inside the 1s windows after
+    each host fault) stays under the SLO budget
+  - shed_rate bounded: back-pressure sheds are < 2% of submissions
+  - swap semantics: the clean promote reports ok, the sabotaged swap
+    reports rolled_back with the killed host down
+  - orphans == 0: after shutdown the router carries zero in-flight
+
+Last stdout line is the JSON result (the bench subprocess contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import threading
+import time
+from concurrent.futures import Future
+from typing import Iterator, List, Optional, Tuple
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+QUICK = "--quick" in sys.argv or os.environ.get("BENCH_QUICK", "0") == "1"
+
+# the router's resilience counters that must stay ZERO while nothing is
+# failing — the off-identity arm's "machinery idle" gate
+_IDLE_COUNTERS = ("retries", "timeouts", "failed", "shed", "late_discards",
+                  "host_failures", "host_down", "drains", "preempt_drains",
+                  "rollbacks")
+
+
+def _mlp(seed=7):
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import (
+        MultiLayerNetwork, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.updaters import Sgd
+
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(lr=0.05))
+            .layer(Dense(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(12)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def _trace(n: int, seed: int = 0,
+           base_ms: float = 3.0) -> Iterator[Tuple[float, int,
+                                                   Optional[str]]]:
+    """Seeded open-loop arrival trace: diurnal rate modulation over the
+    run, scripted burst windows, heavy-tailed request sizes.  Yields
+    ``(t_arrival_s, rows, session)`` LAZILY — the million-request scale
+    arm must never materialize the trace."""
+    rng = np.random.default_rng(seed)
+    t = 0.0
+    for i in range(n):
+        phase = i / max(n - 1, 1)
+        rate = 1.0 + 0.6 * math.sin(2.0 * math.pi * phase)   # diurnal
+        if (i // 97) % 7 == 0:                               # burst window
+            rate *= 4.0
+        t += float(rng.exponential(base_ms / 1000.0 / rate))
+        rows = 1 + min(7, int(rng.pareto(1.6)))              # heavy tail
+        session = f"s{i % 5}" if i % 8 == 0 else None
+        yield t, rows, session
+
+
+def _requests(n: int, seed: int = 0) -> List[np.ndarray]:
+    """The materialized feature arrays for the (small) engine arms —
+    sizes follow the same heavy-tail trace."""
+    rng = np.random.default_rng(seed + 1)
+    return [rng.normal(size=(rows, 12)).astype(np.float32)
+            for _, rows, _ in _trace(n, seed=seed)]
+
+
+def _p99(lat: List[float]):
+    if not lat:
+        return None
+    return float(np.percentile(np.asarray(lat), 99))
+
+
+# ---------------------------------------------------------------------------
+# arm 1: chaos-off behavior identity (single host vs an idle fleet)
+# ---------------------------------------------------------------------------
+
+def run_off_identity(n_requests: int) -> dict:
+    from deeplearning4j_tpu.serving import Engine, FleetRouter
+
+    stream = _requests(n_requests)
+
+    solo = Engine(_mlp(seed=7), max_batch=8, slo_ms=30_000,
+                  replicas=1).load()
+    ref = [np.asarray(solo.output(x, slo_ms=30_000)) for x in stream]
+    solo.shutdown()
+
+    router = FleetRouter(max_retries=1, breaker_threshold=3)
+    engines = [Engine(_mlp(seed=7), max_batch=8, slo_ms=30_000,
+                      replicas=1).load() for _ in range(2)]
+    for i, eng in enumerate(engines):
+        router.add_host(f"h{i}", engine=eng)
+    got = [np.asarray(router.output(x, slo_ms=30_000)) for x in stream]
+    snap = router.metrics_snapshot()
+    router.shutdown(shutdown_hosts=True)
+
+    bitwise = all(a.shape == b.shape and np.array_equal(a, b)
+                  for a, b in zip(ref, got))
+    idle = all(snap["counters"][k] == 0 for k in _IDLE_COUNTERS)
+    return {"off_bitwise": bool(bitwise), "off_counters_idle": bool(idle),
+            "off_delivered": snap["counters"]["delivered"],
+            "off_behavior_identical": bool(
+                bitwise and idle
+                and snap["counters"]["delivered"] == n_requests),
+            "off_requests": n_requests}
+
+
+# ---------------------------------------------------------------------------
+# arm 2: the chaos arm
+# ---------------------------------------------------------------------------
+
+class _ChaosHost:
+    """Engine wrapper carrying the driver-side fleet faults: a straggle
+    flag delays every response (keeping the router's in-flight count
+    for this host elevated — exactly the signal least-loaded dispatch
+    steers on), ``kill_on_swap`` makes the host die the moment a
+    rolling swap touches it, and a killed host fails all traffic."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.straggle_s = 0.0
+        self.kill_on_swap = False
+        self.killed = False
+        self.killed_at: Optional[float] = None
+
+    def output_async(self, x, slo_ms=None):
+        from deeplearning4j_tpu.serving import ServingUnavailableError
+        if self.killed:
+            raise ServingUnavailableError("host killed (chaos)")
+        fut = self.inner.output_async(x, slo_ms=slo_ms)
+        delay = self.straggle_s
+        if delay <= 0:
+            return fut
+        out: Future = Future()
+
+        def relay(f, d=delay):
+            timer = threading.Timer(d, _propagate, args=(f, out))
+            timer.daemon = True
+            timer.start()
+        fut.add_done_callback(relay)
+        return out
+
+    def swap_model(self, model, tag=None):
+        if self.kill_on_swap or self.killed:
+            self.killed = True
+            self.killed_at = time.monotonic()
+            raise RuntimeError("host killed mid-swap (chaos)")
+        return self.inner.swap_model(model, tag)
+
+    @property
+    def current_tag(self):
+        return self.inner.current_tag
+
+    def metrics_snapshot(self):
+        return self.inner.metrics_snapshot()
+
+    def health_snapshot(self):
+        if self.killed:
+            return {"status": "unready", "ready": False}
+        return self.inner.health_snapshot()
+
+    def shutdown(self, timeout: float = 5.0):
+        self.inner.shutdown(timeout=timeout)
+
+
+def _propagate(src: Future, dst: Future) -> None:
+    exc = src.exception()
+    if exc is not None:
+        dst.set_exception(exc)
+    else:
+        dst.set_result(src.result())
+
+
+class _Ledger:
+    """One record per submission, always — the stranded / at-most-once
+    / version-mixing gates all read from here."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.records: List[dict] = []
+        self.n_submitted = 0
+        self.n_done = 0
+        self.resolutions: dict = {}     # rid -> times the future resolved
+
+    def submit(self, router, rid, x, session, slo_ms):
+        t_submit = time.monotonic()
+        fut = router.output_async(x, slo_ms=slo_ms, session=session)
+        with self.lock:
+            self.n_submitted += 1
+
+        def cb(f, rid=rid, t_submit=t_submit):
+            t = time.monotonic()
+            exc = f.exception()
+            rec = {"rid": rid, "t_submit": t_submit, "t_done": t,
+                   "latency_ms": (t - t_submit) * 1e3,
+                   "error": type(exc).__name__ if exc is not None else None,
+                   "out": None if exc is not None else np.asarray(f.result())}
+            with self.lock:
+                self.records.append(rec)
+                self.n_done += 1
+                self.resolutions[rid] = self.resolutions.get(rid, 0) + 1
+        fut.add_done_callback(cb)
+
+    def wait_done_count(self, n, timeout):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self.lock:
+                if self.n_done >= n:
+                    return True
+            time.sleep(0.01)
+        return False
+
+    def drain(self, timeout):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self.lock:
+                if self.n_done >= self.n_submitted:
+                    return True
+            time.sleep(0.02)
+        return False
+
+
+def _classify(out: Optional[np.ndarray], refs: dict, atol=1e-3):
+    """Which model version produced this response?  Distinct seeds keep
+    the versions numerically far apart, so a tolerance match against
+    the per-request reference outputs is unambiguous."""
+    if out is None:
+        return None
+    matches = [v for v, ref in refs.items()
+               if out.shape == ref.shape
+               and np.allclose(out, ref, atol=atol)]
+    return matches[0] if len(matches) == 1 else "ambiguous"
+
+
+def run_chaos_arm(n_requests: int) -> dict:
+    from deeplearning4j_tpu.parallel import (
+        FaultKind, FaultSchedule, FleetChaos,
+    )
+    from deeplearning4j_tpu.serving import Engine, FleetRouter, ModelRegistry
+
+    slo_ms = 2500.0
+    xs = _requests(n_requests)
+    arrivals = [t for t, _, _ in _trace(n_requests)]
+    sessions = [s for _, _, s in _trace(n_requests)]
+
+    nets = {"v1": _mlp(seed=7), "v2": _mlp(seed=11), "v3": _mlp(seed=13)}
+    # per-request reference outputs per version (one stacked forward
+    # each): the response classifier for the version-mixing gates
+    stacked = np.concatenate(xs, axis=0)
+    splits = np.cumsum([x.shape[0] for x in xs])[:-1]
+    refs_by_rid = []
+    ref_rows = {v: np.split(np.asarray(net.output(stacked)), splits)
+                for v, net in nets.items()}
+    for i in range(n_requests):
+        refs_by_rid.append({v: ref_rows[v][i] for v in nets})
+
+    reg = ModelRegistry()
+    v1 = reg.register("m", nets["v1"])
+    reg.set_alias("m", "prod", v1)
+    v2 = reg.register("m", nets["v2"])
+
+    wrappers = []
+    router = FleetRouter(max_retries=2, request_timeout_s=1.0,
+                         breaker_threshold=3)
+    for i in range(3):
+        eng = Engine.from_registry(
+            reg, "m", "prod", max_batch=8, slo_ms=slo_ms, replicas=1,
+            max_queue=100_000, admission="shed", max_wait_ms=2.0)
+        eng.load()
+        w = _ChaosHost(eng)
+        wrappers.append(w)
+        router.add_host(f"h{i}", engine=w)
+
+    # driver-side fault schedule, keyed by 1-based submission index
+    idx_straggle = max(2, n_requests // 6)
+    idx_preempt = max(3, n_requests // 3)
+    idx_kill = max(4, (2 * n_requests) // 3)
+    chaos = FleetChaos(FaultSchedule.scripted({
+        idx_straggle: [FaultKind.HOST_STRAGGLE],
+        idx_preempt: [FaultKind.HOST_PREEMPT],
+        idx_kill: [FaultKind.HOST_KILL],
+    }))
+
+    ledger = _Ledger()
+    kill_armed = threading.Event()
+    fault_windows: List[float] = []
+
+    def on_fault(kind):
+        if kind == FaultKind.HOST_STRAGGLE:
+            wrappers[2].straggle_s = 0.25
+            fault_windows.append(time.monotonic())
+            t = threading.Timer(1.5, lambda: setattr(
+                wrappers[2], "straggle_s", 0.0))
+            t.daemon = True
+            t.start()
+        elif kind == FaultKind.HOST_PREEMPT:
+            # deliver the notice from a side thread: drain blocks until
+            # h1's in-flight empties, and the submitter must stay open-loop
+            fault_windows.append(time.monotonic())
+            threading.Thread(
+                target=lambda: router.notify_preemption("h1", grace_s=10),
+                daemon=True).start()
+        elif kind == FaultKind.HOST_KILL:
+            kill_armed.set()
+
+    def open_loop():
+        t0 = time.monotonic()
+        for i, x in enumerate(xs):
+            delay = t0 + arrivals[i] - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            for kind in chaos.pop_request():
+                on_fault(kind)
+            ledger.submit(router, i, x, sessions[i], slo_ms)
+    submit_thread = threading.Thread(target=open_loop, daemon=True)
+    t_start = time.monotonic()
+    submit_thread.start()
+
+    # -- clean rolling promote mid-run (v1 -> v2) --------------------------
+    ledger.wait_done_count(n_requests // 2, timeout=120)
+    promote_report = router.promote(reg, "m", version=v2,
+                                    drain_timeout_s=30.0)
+    t_promote_done = time.monotonic()
+    alias_after_promote = reg.resolve("m", "prod")[0]
+
+    # -- sabotaged rolling swap (v2 -> v3): the chaos kill fires mid-swap --
+    kill_armed.wait(timeout=120)
+    wrappers[2].kill_on_swap = True
+    v3 = reg.register("m", nets["v3"])
+    swap_report = router.promote(reg, "m", version=v3, drain_timeout_s=30.0)
+    t_rollback_done = time.monotonic()
+    if wrappers[2].killed_at is not None:
+        fault_windows.append(wrappers[2].killed_at)
+    alias_after_rollback = reg.resolve("m", "prod")[0]
+
+    submit_thread.join(timeout=120)
+    all_done = ledger.drain(timeout=120)
+    wall_s = time.monotonic() - t_start
+    snap = router.metrics_snapshot()
+    health = router.health_snapshot()
+    final_tag = router.current_tag
+    router.shutdown(shutdown_hosts=True)
+    orphans = int(router.metrics_snapshot()["queue_depth"])
+
+    with ledger.lock:
+        records = list(ledger.records)
+        n_submitted = ledger.n_submitted
+        resolutions = dict(ledger.resolutions)
+    stranded = max(0, n_submitted - len(records))
+    if submit_thread.is_alive():
+        stranded += n_requests
+    double_delivered = sum(1 for c in resolutions.values() if c > 1)
+
+    for r in records:
+        r["version"] = _classify(r["out"], refs_by_rid[r["rid"]])
+    ok_recs = [r for r in records if r["error"] is None]
+    unmatched = sum(1 for r in ok_recs
+                    if r["version"] in (None, "ambiguous"))
+    # version mixing: submissions AFTER the clean promote returned must
+    # never see v1; submissions after the rollback returned never see v3
+    v1_after_promote = sum(1 for r in ok_recs
+                           if r["t_submit"] > t_promote_done
+                           and r["version"] == "v1")
+    v3_after_rollback = sum(1 for r in ok_recs
+                            if r["t_submit"] > t_rollback_done
+                            and r["version"] == "v3")
+
+    errors: dict = {}
+    for r in records:
+        if r["error"] is not None:
+            errors[r["error"]] = errors.get(r["error"], 0) + 1
+    shed_like = (errors.get("OverloadedError", 0)
+                 + snap["counters"]["shed"])
+    shed_rate = shed_like / max(n_submitted, 1)
+
+    lat_all = [r["latency_ms"] for r in ok_recs]
+    p99_all = _p99(lat_all)
+    post_fault = []
+    for t0 in fault_windows:
+        post_fault += [r["latency_ms"] for r in ok_recs
+                       if t0 <= r["t_done"] <= t0 + 1.0]
+    p99_fault = _p99(post_fault)
+    p99_ok = bool(p99_all is not None and p99_all <= slo_ms
+                  and (p99_fault is None or p99_fault <= slo_ms))
+
+    c = snap["counters"]
+    out = {
+        "n_requests": n_requests, "n_submitted": n_submitted,
+        "wall_seconds": round(wall_s, 2),
+        "stranded": int(stranded),
+        "all_done_before_timeout": bool(all_done),
+        "double_delivered": int(double_delivered),
+        "faults_injected": chaos.injected(),
+        "fault_events": chaos.events,
+        "delivered": c["delivered"], "failed": c["failed"],
+        "retries": c["retries"], "timeouts": c["timeouts"],
+        "late_discards": c["late_discards"],
+        "affinity_routed": c["affinity_routed"],
+        "host_failures": c["host_failures"],
+        "preempt_drains": c["preempt_drains"],
+        "errors": errors,
+        "shed_rate": round(shed_rate, 4),
+        "p99_ms": round(p99_all, 2) if p99_all is not None else None,
+        "p99_post_fault_ms": (round(p99_fault, 2)
+                              if p99_fault is not None else None),
+        "post_fault_samples": len(post_fault),
+        "p99_bound_ms": slo_ms, "p99_ok": p99_ok,
+        "unmatched_versions": int(unmatched),
+        "v1_after_promote": int(v1_after_promote),
+        "v3_after_rollback": int(v3_after_rollback),
+        "promote_ok": bool(promote_report["ok"]),
+        "alias_after_promote": alias_after_promote,
+        "swap_rolled_back": bool(swap_report["rolled_back"]),
+        "swap_failed_host": swap_report["failed_host"],
+        "alias_after_rollback": alias_after_rollback,
+        "hosts_final": {h: s for h, s in router.hosts().items()},
+        "final_tag": final_tag,
+        "health_final": health["status"],
+        "orphans": orphans,
+    }
+    out["chaos_ok"] = bool(
+        out["stranded"] == 0
+        and out["all_done_before_timeout"]
+        and out["double_delivered"] == 0
+        and out["faults_injected"] == 3
+        and out["unmatched_versions"] == 0
+        and out["v1_after_promote"] == 0
+        and out["v3_after_rollback"] == 0
+        and out["promote_ok"]
+        and out["alias_after_promote"] == 2
+        and out["swap_rolled_back"]
+        and out["swap_failed_host"] == "h2"
+        and out["alias_after_rollback"] == 2
+        and out["final_tag"] == "m:v2"
+        and out["hosts_final"]["h0"] == "up"
+        and out["hosts_final"]["h1"] == "down"
+        and out["hosts_final"]["h2"] == "down"
+        and out["affinity_routed"] > 0
+        and out["shed_rate"] <= 0.02
+        and out["p99_ok"]
+        # the fleet keeps serving on the survivor
+        and out["health_final"] == "degraded"
+        and out["orphans"] == 0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# arm 3: the million-request scale arm
+# ---------------------------------------------------------------------------
+
+class _InstantHost:
+    """Zero-latency synthetic engine: completes every request inline.
+    The scale arm measures ROUTER bookkeeping at millions of requests —
+    the hosts must not be the bottleneck."""
+
+    def output_async(self, x, slo_ms=None):
+        fut: Future = Future()
+        fut.set_result(x)
+        return fut
+
+    def metrics_snapshot(self):
+        return {"queue_depth": 0}
+
+    def health_snapshot(self):
+        return {"status": "ok", "ready": True, "model": "syn:v1"}
+
+    @property
+    def current_tag(self):
+        return "syn:v1"
+
+    def shutdown(self, timeout: float = 5.0):
+        pass
+
+
+def run_scale_arm(n_requests: int) -> dict:
+    from deeplearning4j_tpu.serving import FleetRouter
+
+    router = FleetRouter(max_retries=1, breaker_threshold=3)
+    for i in range(3):
+        router.add_host(f"syn{i}", engine=_InstantHost())
+
+    # instant hosts resolve inline, so every callback runs on the
+    # submitter thread — plain (unlocked) counters are safe here
+    state = {"done": 0, "outstanding": 0, "peak": 0}
+
+    def cb(f):
+        state["done"] += 1
+        state["outstanding"] -= 1
+
+    t0 = time.monotonic()
+    n_submitted = 0
+    for _, _, session in _trace(n_requests, seed=3):
+        fut = router.output_async(n_submitted, session=session)
+        n_submitted += 1
+        state["outstanding"] += 1
+        state["peak"] = max(state["peak"], state["outstanding"])
+        fut.add_done_callback(cb)
+    wall_s = time.monotonic() - t0
+    snap = router.metrics_snapshot()
+    router.shutdown(shutdown_hosts=True)
+
+    c = snap["counters"]
+    out = {
+        "scale_requests": n_requests,
+        "scale_wall_seconds": round(wall_s, 2),
+        "scale_rps": round(n_submitted / max(wall_s, 1e-9)),
+        "scale_delivered": c["delivered"],
+        "scale_failed": c["failed"],
+        "scale_stranded": int(n_submitted - state["done"]),
+        "scale_peak_outstanding": state["peak"],
+        "scale_affinity_routed": c["affinity_routed"],
+    }
+    out["scale_ok"] = bool(
+        out["scale_delivered"] == n_requests
+        and out["scale_failed"] == 0
+        and out["scale_stranded"] == 0
+        and out["scale_peak_outstanding"] <= 4096
+        and out["scale_affinity_routed"] > 0)
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--requests", type=int, default=None,
+                    help="chaos-arm request count")
+    ap.add_argument("--scale-requests", type=int, default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    quick = args.quick or QUICK
+    n_chaos = args.requests or (240 if quick else 600)
+    n_off = 60 if quick else 150
+    n_scale = args.scale_requests or (50_000 if quick else 1_000_000)
+
+    print(f"fleet_load_soak: {n_chaos} chaos requests, {n_off} identity "
+          f"requests, {n_scale} scale requests, "
+          f"platform={jax.devices()[0].platform}", file=sys.stderr)
+
+    # tracing rides along (fleet/request spans, retry/drain/swap
+    # instants); a FAILED soak dumps the ring buffer as its artifact
+    from deeplearning4j_tpu.obs import trace as obs_trace
+    rec = obs_trace.enable_tracing(capacity=131072)
+
+    out = {"config": "fleet_load_chaos",
+           "platform": jax.devices()[0].platform, "quick": quick}
+    out.update(run_off_identity(n_off))
+    out.update(run_chaos_arm(n_chaos))
+    out.update(run_scale_arm(n_scale))
+    out["soak_ok"] = bool(out["off_behavior_identical"] and out["chaos_ok"]
+                          and out["scale_ok"])
+    if not out["soak_ok"]:
+        import tempfile
+        path = os.path.join(tempfile.gettempdir(),
+                            "fleet_load_soak_failure.trace.json")
+        try:
+            out["trace_artifact"] = rec.save(path)
+        except OSError:
+            out["trace_artifact"] = None
+    print(json.dumps(out), flush=True)
+    return 0 if out["soak_ok"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

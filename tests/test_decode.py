@@ -203,6 +203,85 @@ class TestAdmission:
             b.submit_request("spec-b")
         b.close(fail_pending=True)
 
+    def test_concurrent_submit_sheds_boundedly_and_leaks_nothing(self):
+        """16 threads race submit_request at a queue cap of 10 with no
+        consumer: the admission lock must admit EXACTLY max_queue specs
+        (never cap+1 from a check-then-act race), shed the rest with a
+        typed error, keep each thread's admitted specs in its submit
+        order, and close() must resolve every admitted future — the
+        queue-cap contract the fleet soak leans on at millions of
+        requests."""
+        cap, n_threads, per_thread = 10, 16, 8
+        b = ContinuousBatcher(max_batch=4, slo_ms=1000, max_queue=cap,
+                              admission="shed")
+        start = threading.Barrier(n_threads)
+        admitted, shed = [], []
+        lock = threading.Lock()
+
+        def pump(tid):
+            start.wait()
+            for i in range(per_thread):
+                spec = (tid, i)
+                try:
+                    fut = b.submit_request(spec)
+                except OverloadedError:
+                    with lock:
+                        shed.append(spec)
+                else:
+                    with lock:
+                        admitted.append((spec, fut))
+
+        threads = [threading.Thread(target=pump, args=(t,))
+                   for t in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert len(admitted) == cap == b.qsize()
+        assert len(shed) == n_threads * per_thread - cap
+        # FIFO per thread: admit() drains in arrival order, and a
+        # thread's later spec never overtakes its earlier one
+        drained = b.admit(cap)
+        assert [r.payload for r in drained] == [s for s, _ in admitted]
+        per_tid = {}
+        for tid, i in (r.payload for r in drained):
+            assert per_tid.get(tid, -1) < i
+            per_tid[tid] = i
+        # no leaked futures: close() resolves everything still admitted
+        for r in drained:
+            r.future.set_result("served")
+        b.close(fail_pending=True)
+        for (_, fut) in admitted:
+            assert fut.done()
+        assert all(fut.result(timeout=1) == "served"
+                   for _, fut in admitted)
+
+    def test_begin_drain_wakes_blocked_submitter_to_shed(self):
+        """admission="block" parks submitters on the space condvar; a
+        drain (serve SIGTERM) must wake them into a typed shed, not
+        leave them blocked past the grace window."""
+        b = ContinuousBatcher(max_batch=2, slo_ms=1000, max_queue=1,
+                              admission="block")
+        b.submit_request("occupies-the-queue")
+        errs = []
+
+        def blocked():
+            try:
+                b.submit_request("parked")
+            except Exception as e:  # noqa: BLE001 - recorded, asserted
+                errs.append(e)
+
+        t = threading.Thread(target=blocked)
+        t.start()
+        time.sleep(0.1)
+        assert t.is_alive() and not errs     # genuinely parked
+        b.begin_drain()
+        t.join(timeout=10)
+        assert not t.is_alive()
+        assert len(errs) == 1 and isinstance(errs[0], OverloadedError)
+        assert b.qsize() == 1                # queued work kept for drain
+        b.close(fail_pending=True)
+
 
 class TestHotSwap:
     def test_swap_mid_decode_never_mixes_versions(self, engine, lm):
@@ -364,6 +443,56 @@ class TestHttpGenerate:
             assert (code, out["error_class"]) == (503, "unavailable")
         finally:
             srv.stop()
+
+    def test_healthz_covers_decode_engine(self, engine, server):
+        """A decode-only host must answer readiness from ITS engine —
+        not the blanket 503 the endpoint returned before decode health
+        was wired in (a healthy box would have been pulled from every
+        fleet rotation)."""
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/healthz") as r:
+            assert r.status == 200
+            h = json.loads(r.read())
+        assert h["ready"] is True and h["status"] == "ready"
+        assert h["kind"] == "decode"
+        assert h["model"] == engine.current_tag
+
+    def test_healthz_with_both_engines_is_per_engine(self, engine):
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        class _DeadPredict:
+            def health_snapshot(self):
+                return {"status": "unready", "ready": False}
+
+            def metrics_snapshot(self):
+                return {"queue_depth": 0}
+
+        srv = (UIServer(port=0).attach_engine(_DeadPredict())
+               .attach_decode_engine(engine).start())
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/healthz")
+            assert ei.value.code == 503      # one dead engine -> out of
+            h = json.loads(ei.value.read())  # rotation, with evidence
+            assert h["ready"] is False and h["status"] == "unready"
+            assert h["engines"]["predict"]["ready"] is False
+            assert h["engines"]["decode"]["ready"] is True
+        finally:
+            srv.stop()
+
+    def test_decode_metrics_ride_the_global_registry(self, engine, server):
+        """DecodeMetrics registers a process-global collector: one
+        /metrics response carries TTFT/TPOT and decode counters under
+        registry.collected, keyed by the engine's registered name."""
+        name = engine.metrics.global_name
+        assert name.startswith("decode")
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics") as r:
+            m = json.loads(r.read())
+        snap = m["registry"]["collected"][name]
+        assert snap["counters"]["requests"] >= 1
+        assert "ttft_ms" in snap and "tpot_ms" in snap
 
 
 class TestOneShotPredictRegression:
