@@ -1635,12 +1635,114 @@ def bench_continuous_batching():
             "speed_gated": ab["speed_gated"]}
 
 
+def bench_cold_start():
+    """Config 22: zero-cold-start A/B (scripts/cold_start_ab.py; CPU
+    subprocess — bundle serialization and the load controller are host-
+    side).  Cold ``Engine.load()`` (XLA compiles every bucket) vs a
+    fresh process-equivalent warm load from a warmup bundle
+    (serialize_executable round-trip), plus an autoscale burst soak.
+    HARD gates on EVERY platform: warm load >= 3x faster than cold,
+    warm outputs BITWISE identical to cold, zero bundle misses, the
+    compile-cache-size witness flat across serving in both arms, the
+    burst soak scales up within budget / back down after idle with zero
+    new compiles and zero stranded futures, and the persistent compile
+    cache writes through (serving/warmcache.py)."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    script = os.path.join(_REPO, "scripts", "cold_start_ab.py")
+    cmd = [sys.executable, script] + (["--quick"] if QUICK else [])
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=1800, cwd=_REPO)
+    if p.returncode != 0:
+        raise RuntimeError(f"cold_start_ab failed (rc={p.returncode}): "
+                           f"{p.stderr[-1500:]}")
+    ab = json.loads(p.stdout.strip().splitlines()[-1])
+    if not ab.get("speedup_ok"):
+        raise RuntimeError("cold-start speedup gate FAILED (warm-from-"
+                           f"bundle load must be >= 3x cold compile): {ab}")
+    if not ab.get("bitwise_ok"):
+        raise RuntimeError("cold-start bitwise gate FAILED (warm-arm "
+                           f"outputs must match cold-arm bitwise): {ab}")
+    if not ab.get("bundle_ok"):
+        raise RuntimeError("cold-start bundle gate FAILED (warm arm must "
+                           f"load with zero bundle misses): {ab}")
+    if not ab.get("cache_flat_ok"):
+        raise RuntimeError("cold-start AOT gate FAILED (compile_cache_size "
+                           f"must stay flat while serving): {ab}")
+    if not ab.get("autoscale_ok"):
+        raise RuntimeError("autoscale soak gate FAILED (scale up in "
+                           "budget, down after idle, zero compiles, zero "
+                           f"stranded): {ab}")
+    if not ab.get("compile_cache_ok"):
+        raise RuntimeError("persistent compile cache gate FAILED (enabled "
+                           f"cache dir must be populated): {ab}")
+    return {"metric": "cold_start_load_speedup",
+            "value": ab["load_speedup_warm_vs_cold"],
+            "unit": "x (cpu)" if ab["platform"] != "tpu" else "x",
+            "platform": ab["platform"],
+            "cold_load_s": ab["cold"]["load_s"],
+            "warm_load_s": ab["warm"]["load_s"],
+            "bundle_bytes": ab["cold"]["bundle_bytes"],
+            "scale_ups": ab["soak"]["scale_ups"],
+            "scale_downs": ab["soak"]["scale_downs"],
+            "burst_s": ab["soak"]["burst_s"],
+            "bitwise_ok": True, "bundle_ok": True, "cache_flat_ok": True,
+            "autoscale_ok": True, "compile_cache_ok": True}
+
+
+def _backfill_artifacts() -> None:
+    """One-time repair of pre-round-6 artifacts: derive the structured
+    ``parsed.results`` list from the stderr-tail regex and write it BACK
+    into the BENCH_r*.json file (entries marked ``backfilled``), so the
+    regression gate stops depending on free-text parsing of history.  An
+    artifact yielding NO metrics either way gets a loud warning — a
+    silently-empty artifact would disable the gate without a trace."""
+    import glob
+    import re
+
+    for path in sorted(glob.glob(os.path.join(_REPO, "BENCH_r*.json"))):
+        with open(path) as f:
+            art = json.load(f)
+        parsed = art.setdefault("parsed", {})
+        if parsed.get("results"):
+            continue
+        derived = [
+            {"metric": m.group(1), "value": float(m.group(2)),
+             "backfilled": True}
+            for m in re.finditer(r"^\s{2}(\w+): ([\d.]+) \S+",
+                                 art.get("tail", ""), re.MULTILINE)
+        ]
+        if parsed.get("metric") and parsed.get("value") is not None:
+            if parsed["metric"] not in {d["metric"] for d in derived}:
+                derived.append({"metric": parsed["metric"],
+                                "value": float(parsed["value"]),
+                                "backfilled": True})
+        if not derived:
+            log(f"  WARNING {os.path.basename(path)}: no metrics "
+                "recoverable (structured or regex) — this round is "
+                "INVISIBLE to the regression gate")
+            continue
+        parsed["results"] = derived
+        with open(path, "w") as f:
+            json.dump(art, f, indent=1)
+        log(f"  backfilled {os.path.basename(path)}: {len(derived)} "
+            "structured metrics written from the legacy stderr-tail regex")
+
+
 def main() -> None:
     import jax
 
+    from deeplearning4j_tpu.serving.warmcache import enable_compile_cache
+
+    cache_dir = enable_compile_cache()  # DL4J_TPU_COMPILE_CACHE env only
     platform = jax.devices()[0].platform
     log(f"bench: platform={platform} devices={len(jax.devices())} "
-        f"quick={QUICK} window={STEPS}")
+        f"quick={QUICK} window={STEPS}"
+        + (f" compile_cache={cache_dir}" if cache_dir else ""))
+    _backfill_artifacts()
     results = []
     primary = None
     for name, fn in [("mlp_mnist", bench_mlp_mnist),
@@ -1664,7 +1766,8 @@ def main() -> None:
                      ("static_analysis_clean", bench_static_analysis),
                      ("fused_update_ab", bench_fused_update_ab),
                      ("quantized_serving_ab", bench_quantized_serving_ab),
-                     ("continuous_batching_ab", bench_continuous_batching)]:
+                     ("continuous_batching_ab", bench_continuous_batching),
+                     ("cold_start_ab", bench_cold_start)]:
         try:
             t0 = time.perf_counter()
             out = fn()

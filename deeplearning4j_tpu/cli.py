@@ -321,6 +321,10 @@ def cmd_train(args) -> int:
               f"{distributed.process_count()}")
     heartbeat = Heartbeat.start_from_env()
     trace_path = _setup_trace(args)
+    from .serving.warmcache import enable_compile_cache
+    cache_dir = enable_compile_cache(getattr(args, "compile_cache", None))
+    if cache_dir:
+        print(f"compile cache: {cache_dir}")
 
     net = _build_model(args)
     xs, ys = _load_data(args.data, train=True, num_classes=_num_classes_of(net))
@@ -547,8 +551,12 @@ def cmd_serve(args) -> int:
     from .parallel.launcher import Heartbeat
     from .parallel.preemption import PreemptionHandler
     from .serving import Engine, FleetRouter, HttpHost, ModelRegistry
+    from .serving.warmcache import enable_compile_cache
 
     trace_path = _setup_trace(args)
+    cache_dir = enable_compile_cache(getattr(args, "compile_cache", None))
+    if cache_dir:
+        print(f"compile cache: {cache_dir}")
     if not args.fleet and not args.model:
         raise SystemExit("serve needs --model (or --fleet HOST:PORT,...)")
     if args.fleet:
@@ -577,7 +585,9 @@ def cmd_serve(args) -> int:
             forward_timeout_s=args.forward_timeout,
             max_retries=args.max_retries,
             breaker_threshold=args.breaker_threshold)
-        engine.load()
+        # an explicit --warm-bundle wins; otherwise the registry's
+        # checkpoint provenance finds `<checkpoint>.warm` automatically
+        engine.load(warm_bundle=getattr(args, "warm_bundle", None))
         print(f"serving {name} v{version} (alias 'prod'): "
               f"max_batch={args.max_batch}, slo={args.slo_ms}ms, "
               f"replicas={len(engine._replicas)}, "
@@ -764,6 +774,13 @@ def cmd_launch(args) -> int:
     if rest[0] not in ("train", "evaluate", "predict", "serve", "summary"):
         raise SystemExit(f"launch worker command must be a "
                          f"deeplearning4j_tpu subcommand, got {rest[0]!r}")
+    # arm the shared compile cache BEFORE any worker exists: enable_
+    # compile_cache exports DL4J_TPU_COMPILE_CACHE, which both forked
+    # workers and the --join re-exec inherit
+    from .serving.warmcache import enable_compile_cache
+    cache_dir = enable_compile_cache(getattr(args, "compile_cache", None))
+    if cache_dir:
+        print(f"launch: compile cache {cache_dir}")
     if args.join:
         # join mode: THIS process becomes worker --process-id of an
         # existing cluster (one `launch --join` per host on a real pod)
@@ -940,6 +957,11 @@ def build_parser() -> argparse.ArgumentParser:
                    "trace JSON to PATH on exit (view in chrome://tracing "
                    "or ui.perfetto.dev; '{process}' expands to the worker "
                    "index; docs/OBSERVABILITY.md)")
+    t.add_argument("--compile-cache", metavar="DIR",
+                   help="persistent XLA compile cache (serving/warmcache.py): "
+                   "compiled executables are stored in DIR and later "
+                   "processes skip the compile (default: the "
+                   "DL4J_TPU_COMPILE_CACHE env var; unset = off)")
     t.add_argument("--grace", type=float, default=None, metavar="SECONDS",
                    help="preemption grace budget for --elastic-dir runs: "
                    "on SIGTERM/SIGUSR1 (a preemption notice) the next "
@@ -1021,6 +1043,11 @@ def build_parser() -> argparse.ArgumentParser:
                     "across relaunch) and print the fleet endpoints — pair "
                     "with a 'serve' worker command and a `serve --fleet` "
                     "router (docs/SERVING.md 'Fleet serving')")
+    ln.add_argument("--compile-cache", metavar="DIR",
+                    help="export DL4J_TPU_COMPILE_CACHE=DIR to every worker: "
+                    "they share one persistent XLA compile cache, so a "
+                    "relaunched worker (or the whole next pod run) reuses "
+                    "executables instead of recompiling")
     ln.add_argument("--join", action="store_true",
                     help="join an existing cluster as one worker instead "
                     "of forking (one `launch --join` per host on a pod)")
@@ -1082,6 +1109,15 @@ def build_parser() -> argparse.ArgumentParser:
     v.add_argument("--breaker-threshold", type=int, default=3,
                    help="consecutive replica failures that trip its circuit "
                    "breaker (dispatch routes around it; default 3)")
+    v.add_argument("--compile-cache", metavar="DIR",
+                   help="persistent XLA compile cache (serving/warmcache.py): "
+                   "a restarted server reuses DIR's executables instead of "
+                   "cold-compiling (default: DL4J_TPU_COMPILE_CACHE env)")
+    v.add_argument("--warm-bundle", metavar="PATH",
+                   help="warmup bundle of serialized AOT executables to "
+                   "deserialize at load (default: <checkpoint>.warm next to "
+                   "--model when present; docs/SERVING.md 'Cold start & "
+                   "autoscaling')")
     v.add_argument("--smoke", type=int, default=0, metavar="N",
                    help="push N synthetic requests through the engine, "
                    "print the metrics snapshot, and exit (self-test)")

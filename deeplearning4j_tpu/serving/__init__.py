@@ -25,6 +25,13 @@ remains as a thin back-compat shim over this engine).  Pieces:
                deterministic sampling, per-request stop conditions,
                crash-retry/poison-isolation/hot-swap decode-shaped;
                TTFT + time-per-output-token first-class (DecodeMetrics)
+  warmcache.py zero-cold-start: process-wide JAX persistent compile
+               cache (DL4J_TPU_COMPILE_CACHE / --compile-cache) +
+               warmup bundles (serialized AOT executables next to the
+               checkpoint zip; silent fallback to compile on any miss)
+  autoscale.py load-driven replica autoscaling controller (hysteresis +
+               cooldown + bounds, injectable clock); actuated by the
+               engine supervisor loops via PR-7 birth/retire machinery
 
 Reference lineage: DL4J's ParallelInference BATCHED mode + the model-
 server role; design cf. the serving sections of "TensorFlow: A system
@@ -32,6 +39,7 @@ for large-scale machine learning" and TPU serving practice (PAPERS.md).
 See docs/SERVING.md.
 """
 
+from .autoscale import ReplicaAutoscaler
 from .batcher import (
     ADMISSION_POLICIES, ContinuousBatcher, DeadlineExceededError,
     DynamicBatcher, OverloadedError, pow2_buckets,
@@ -45,13 +53,18 @@ from .fleet import FleetHost, FleetRouter, FleetTimeoutError, HttpHost
 from .metrics import (DecodeMetrics, FleetMetrics, LatencyHistogram,
                       ServingMetrics)
 from .registry import ModelRegistry
+from .warmcache import (
+    bundle_path_for, device_fingerprint, enable_compile_cache, load_bundle,
+    save_bundle,
+)
 
 __all__ = [
     "ADMISSION_POLICIES", "ContinuousBatcher", "DeadlineExceededError",
     "DecodeEngine", "DecodeMetrics", "DynamicBatcher", "Engine",
     "FleetHost", "FleetMetrics", "FleetRouter", "FleetTimeoutError",
     "GenerationResult", "HttpHost", "LatencyHistogram", "ModelRegistry",
-    "OverloadedError", "PoisonInputError", "ReplicaCrashError",
-    "ReplicaHungError", "ServingMetrics", "ServingUnavailableError",
-    "pow2_buckets",
+    "OverloadedError", "PoisonInputError", "ReplicaAutoscaler",
+    "ReplicaCrashError", "ReplicaHungError", "ServingMetrics",
+    "ServingUnavailableError", "bundle_path_for", "device_fingerprint",
+    "enable_compile_cache", "load_bundle", "pow2_buckets", "save_bundle",
 ]

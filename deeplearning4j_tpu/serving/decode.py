@@ -228,79 +228,114 @@ class DecodeEngine:
         self._crash_next = False   # test hook: raise inside the next step
         self._thread: Optional[threading.Thread] = None
         self._supervisor: Optional[threading.Thread] = None
+        self._autoscaler = None             # see enable_autoscale()
+        self._autoscale_cb = None
+        self._autoscale_interval_s = 0.25
+        self._last_autoscale_t: Optional[float] = None
+        self._shed_seen = 0.0
+        self._logical_replicas = 1
 
     # -- load / warmup -----------------------------------------------------
 
-    def load(self) -> "DecodeEngine":
+    def load(self, warm_bundle: Optional[str] = None) -> "DecodeEngine":
         """Allocate the pool and AOT-compile + run every serve-path
         executable: one prefill per prompt bucket, the decode step, the
         two samplers, the pool reset, and the page scrub.  After this,
         ``compile_cache_size()`` must not grow while serving — the
-        zero-serve-time-compiles contract."""
+        zero-serve-time-compiles contract.
+
+        ``warm_bundle`` points at a bundle written by
+        :meth:`save_warmup_bundle` (serving/warmcache.py): each
+        executable deserializes instead of compiling, with per-key
+        fallback to compile on any miss.  Bundle hits are still executed
+        once below, so the donated pool state flows identically to a
+        cold load."""
         import jax
 
         from ..ops.kv_cache import alloc_cache
+        from .warmcache import load_bundle
 
         prog = self.program
         params = self._versions[self._serve_tag]
         s_n, pps, v_n = self.max_slots, prog.pages_per_slot, prog.vocab_size
         kp, vp = alloc_cache(prog.n_layers, self.total_pages, prog.page_size,
                              prog.n_heads, prog.d_head)
+        bundle = load_bundle(warm_bundle) if warm_bundle else {}
+        hits = misses = 0
 
-        step_c = jax.jit(prog.step, donate_argnums=(1, 2)).lower(
-            params, kp, vp, np.zeros((s_n, pps), np.int32),
-            np.zeros((s_n,), np.int32), np.zeros((s_n,), np.int32),
-            np.zeros((s_n,), bool)).compile()
-        kp, vp, lgs = step_c(
-            params, kp, vp, np.zeros((s_n, pps), np.int32),
-            np.zeros((s_n,), np.int32), np.zeros((s_n,), np.int32),
-            np.zeros((s_n,), bool))
-        self._compiled[("step",)] = step_c
+        def _get(key, build):
+            nonlocal hits, misses
+            exe = bundle.get(key)
+            if exe is not None:
+                hits += 1
+                return exe
+            misses += 1
+            return build()
 
-        lg1 = None
-        prefill_jit = jax.jit(prog.prefill, donate_argnums=(1, 2))
-        for b in self.prompt_buckets:
-            pf = prefill_jit.lower(
-                params, kp, vp, np.zeros((pps,), np.int32),
-                np.zeros((b,), np.int32), np.int32(1)).compile()
-            kp, vp, lg1 = pf(params, kp, vp, np.zeros((pps,), np.int32),
-                             np.zeros((b,), np.int32), np.int32(1))
-            self._compiled[("prefill", b)] = pf
+        t0 = self.clock()
+        with obs_trace.span("serve/warmup", cat="serve", kind="decode",
+                            tag=self._serve_tag):
+            step_c = _get("step", lambda: jax.jit(
+                prog.step, donate_argnums=(1, 2)).lower(
+                    params, kp, vp, np.zeros((s_n, pps), np.int32),
+                    np.zeros((s_n,), np.int32), np.zeros((s_n,), np.int32),
+                    np.zeros((s_n,), bool)).compile())
+            kp, vp, lgs = step_c(
+                params, kp, vp, np.zeros((s_n, pps), np.int32),
+                np.zeros((s_n,), np.int32), np.zeros((s_n,), np.int32),
+                np.zeros((s_n,), bool))
+            self._compiled[("step",)] = step_c
 
-        one, batch = _make_samplers(v_n)
-        s1 = jax.jit(one).lower(
-            lg1, np.float32(0), np.int32(0), np.float32(1), np.uint32(0),
-            np.int32(0)).compile()
-        tok, _ = s1(lg1, np.float32(0), np.int32(0), np.float32(1),
-                    np.uint32(0), np.int32(0))
-        np.asarray(tok)
-        self._compiled[("sample1",)] = s1
-        sb = jax.jit(batch).lower(
-            lgs, np.zeros((s_n,), np.float32), np.zeros((s_n,), np.int32),
-            np.ones((s_n,), np.float32), np.zeros((s_n,), np.uint32),
-            np.zeros((s_n,), np.int32)).compile()
-        toks, _ = sb(lgs, np.zeros((s_n,), np.float32),
-                     np.zeros((s_n,), np.int32), np.ones((s_n,), np.float32),
-                     np.zeros((s_n,), np.uint32), np.zeros((s_n,), np.int32))
-        np.asarray(toks)
-        self._compiled[("sample",)] = sb
+            lg1 = None
+            prefill_jit = jax.jit(prog.prefill, donate_argnums=(1, 2))
+            for b in self.prompt_buckets:
+                pf = _get(f"prefill:{b}", lambda b=b: prefill_jit.lower(
+                    params, kp, vp, np.zeros((pps,), np.int32),
+                    np.zeros((b,), np.int32), np.int32(1)).compile())
+                kp, vp, lg1 = pf(params, kp, vp, np.zeros((pps,), np.int32),
+                                 np.zeros((b,), np.int32), np.int32(1))
+                self._compiled[("prefill", b)] = pf
 
-        def _reset(k, v):
-            import jax.numpy as jnp
-            return jnp.zeros_like(k), jnp.zeros_like(v)
+            one, batch = _make_samplers(v_n)
+            s1 = _get("sample1", lambda: jax.jit(one).lower(
+                lg1, np.float32(0), np.int32(0), np.float32(1), np.uint32(0),
+                np.int32(0)).compile())
+            tok, _ = s1(lg1, np.float32(0), np.int32(0), np.float32(1),
+                        np.uint32(0), np.int32(0))
+            np.asarray(tok)
+            self._compiled[("sample1",)] = s1
+            sb = _get("sample", lambda: jax.jit(batch).lower(
+                lgs, np.zeros((s_n,), np.float32), np.zeros((s_n,), np.int32),
+                np.ones((s_n,), np.float32), np.zeros((s_n,), np.uint32),
+                np.zeros((s_n,), np.int32)).compile())
+            toks, _ = sb(lgs, np.zeros((s_n,), np.float32),
+                         np.zeros((s_n,), np.int32),
+                         np.ones((s_n,), np.float32),
+                         np.zeros((s_n,), np.uint32),
+                         np.zeros((s_n,), np.int32))
+            np.asarray(toks)
+            self._compiled[("sample",)] = sb
 
-        def _scrub(k, v, ids):
-            # zero the given pages (padded with repeats — idempotent)
-            return k.at[:, ids].set(0.0), v.at[:, ids].set(0.0)
+            def _reset(k, v):
+                import jax.numpy as jnp
+                return jnp.zeros_like(k), jnp.zeros_like(v)
 
-        reset_c = jax.jit(_reset, donate_argnums=(0, 1)).lower(
-            kp, vp).compile()
-        kp, vp = reset_c(kp, vp)
-        self._compiled[("reset",)] = reset_c
-        scrub_c = jax.jit(_scrub, donate_argnums=(0, 1)).lower(
-            kp, vp, np.zeros((pps,), np.int32)).compile()
-        kp, vp = scrub_c(kp, vp, np.zeros((pps,), np.int32))
-        self._compiled[("scrub",)] = scrub_c
+            def _scrub(k, v, ids):
+                # zero the given pages (padded with repeats — idempotent)
+                return k.at[:, ids].set(0.0), v.at[:, ids].set(0.0)
+
+            reset_c = _get("reset", lambda: jax.jit(
+                _reset, donate_argnums=(0, 1)).lower(kp, vp).compile())
+            kp, vp = reset_c(kp, vp)
+            self._compiled[("reset",)] = reset_c
+            scrub_c = _get("scrub", lambda: jax.jit(
+                _scrub, donate_argnums=(0, 1)).lower(
+                    kp, vp, np.zeros((pps,), np.int32)).compile())
+            kp, vp = scrub_c(kp, vp, np.zeros((pps,), np.int32))
+            self._compiled[("scrub",)] = scrub_c
+        self.metrics.inc("bundle_hits", hits)
+        self.metrics.inc("bundle_misses", misses)
+        self.metrics.inc("warmup_seconds_total", self.clock() - t0)
 
         self._cache = (kp, vp)
         self._loaded = True
@@ -309,6 +344,18 @@ class DecodeEngine:
             target=self._supervise, name="decode-supervisor", daemon=True)
         self._supervisor.start()
         return self
+
+    def save_warmup_bundle(self, path: str) -> str:
+        """Export every serve-path executable as a warmup bundle
+        (serving/warmcache.py) so a fresh process — a scaled-up decode
+        host, a respawn — deserializes in milliseconds via
+        ``load(warm_bundle=path)`` instead of paying the XLA compiles."""
+        from .warmcache import save_bundle
+        if not self._loaded:
+            raise RuntimeError("load() the engine before bundling")
+        entries = {":".join(str(p) for p in key): exe
+                   for key, exe in self._compiled.items()}
+        return save_bundle(path, self._serve_tag, entries)
 
     def compile_cache_size(self) -> int:
         """Executables backing the serve path.  Must not grow after
@@ -418,6 +465,64 @@ class DecodeEngine:
                 name=f"decode-loop-{gen}", daemon=True)
             self._thread.start()
 
+    def enable_autoscale(self, on_scale, autoscaler=None, *,
+                         min_replicas: int = 1, max_replicas: int = 4,
+                         interval_s: float = 0.25,
+                         **knobs) -> "DecodeEngine":
+        """Arm the load controller over the decode queue.  Unlike the
+        predict engine, decode slot capacity is COMPILE-SHAPE-FIXED
+        (the step executable is compiled for ``max_slots``), so the
+        actuator is a callback, not an in-process replica birth: the
+        fleet tier owns physical decode scaling (a new `serve` host
+        warming from this engine's warmup bundle — docs/SERVING.md
+        "Cold start & autoscaling").  ``on_scale(delta, replicas)`` is
+        called with +1/-1 and the new logical replica count; spans and
+        scale counters are emitted here either way."""
+        from .autoscale import ReplicaAutoscaler
+        if autoscaler is None:
+            autoscaler = ReplicaAutoscaler(
+                min_replicas=int(min_replicas),
+                max_replicas=int(max_replicas),
+                clock=self.clock, **knobs)
+        self._autoscale_interval_s = float(interval_s)
+        self._shed_seen = self.metrics.counter_value("shed")
+        self._autoscale_cb = on_scale
+        self._autoscaler = autoscaler
+        return self
+
+    def _autoscale_tick(self) -> None:
+        a = self._autoscaler
+        if a is None or not self._loaded or self._shutdown:
+            return
+        now = self.clock()
+        if (self._last_autoscale_t is not None
+                and now - self._last_autoscale_t < self._autoscale_interval_s):
+            return
+        self._last_autoscale_t = now
+        shed = self.metrics.counter_value("shed")
+        shed_delta = shed - self._shed_seen
+        self._shed_seen = shed
+        with self._lock:
+            active = sum(1 for s in self._slots if s is not None)
+        decision = a.observe(self.batcher.qsize(), active,
+                             self._logical_replicas,
+                             shed_delta=int(shed_delta))
+        if decision == 0:
+            return
+        self._logical_replicas += decision
+        if decision > 0:
+            with obs_trace.span("serve/scale_up", cat="serve",
+                                kind="decode",
+                                replicas=self._logical_replicas):
+                self._autoscale_cb(1, self._logical_replicas)
+            self.metrics.inc("scale_ups")
+        else:
+            with obs_trace.span("serve/scale_down", cat="serve",
+                                kind="decode",
+                                replicas=self._logical_replicas):
+                self._autoscale_cb(-1, self._logical_replicas)
+            self.metrics.inc("scale_downs")
+
     def _supervise(self) -> None:
         """Respawn the decode loop if it dies outright (a crash its own
         handler could not absorb) — in-flight requests are retried or
@@ -427,6 +532,7 @@ class DecodeEngine:
                 if self._shutdown:
                     return
                 t = self._thread
+            self._autoscale_tick()
             if t is not None and not t.is_alive():
                 obs_trace.instant("serve/replica_crash", cat="serve",
                                   kind="decode_loop_dead")

@@ -114,6 +114,11 @@ def _jitable(model) -> bool:
             and hasattr(model, "state"))
 
 
+def _bundle_key(bucket: int, dtype: str) -> str:
+    """Warmup-bundle key for one predict executable (serving/warmcache.py)."""
+    return f"predict|b={bucket}|dtype={dtype}"
+
+
 class _ModelVersion:
     """Immutable serving snapshot of one model version: the jitted
     forward + per-replica device-resident params/state, plus the drain
@@ -125,6 +130,10 @@ class _ModelVersion:
         self.model = model
         self.tag = tag
         self.fwd = None
+        # AOT executables for the lead device, keyed by _bundle_key —
+        # populated at warmup (bundle deserialize or explicit
+        # lower().compile()); replicas on other devices use the jit fwd
+        self.aot: Dict[str, Any] = {}
         self.params: List[Any] = []
         self.state: List[Any] = []
         self.active = 0          # batches currently executing on this version
@@ -279,6 +288,7 @@ class Engine:
         n = len(devices) if replicas in (-1, 0) else int(replicas)
         if n < 1:
             raise ValueError(f"replicas must be >=1 or -1, got {replicas}")
+        self._inflight_per_replica = int(inflight_per_replica)
         self._replicas = [
             _Replica(i, devices[i % len(devices)], inflight_per_replica)
             for i in range(n)]
@@ -293,6 +303,10 @@ class Engine:
         self._warm_dtypes: Tuple[str, ...] = ("float32",)
         self._loaded = False
         self._shutdown = False
+        self._autoscaler = None             # see enable_autoscale()
+        self._autoscale_interval_s = 0.25
+        self._last_autoscale_t: Optional[float] = None
+        self._shed_seen = 0.0
         self.batch_log: List[dict] = []  # bounded; test/debug hook
         self._log_lock = threading.Lock()
         if registry is not None and name is not None:
@@ -330,7 +344,8 @@ class Engine:
     def load(self, input_shape: Optional[Sequence[int]] = None,
              dtypes: Sequence[str] = ("float32",),
              quantize: Optional[str] = None,
-             calibration_inputs=None) -> "Engine":
+             calibration_inputs=None,
+             warm_bundle: Optional[str] = None) -> "Engine":
         """AOT warmup: compile every (bucket, dtype) pair on every
         replica so no user request pays a compile.  ``input_shape`` is
         the per-example shape; inferred from the model's configured
@@ -344,7 +359,15 @@ class Engine:
         representative per-example inputs; a fixed-seed synthetic batch
         when omitted — pass real inputs for production envelopes), and
         warmup compiles the QUANTIZED executables, so the
-        zero-serve-time-compiles contract covers the int8 path too."""
+        zero-serve-time-compiles contract covers the int8 path too.
+
+        ``warm_bundle`` points warmup at an explicit warmup-bundle zip
+        (serving/warmcache.py); omitted, the ``<checkpoint>.warm``
+        convention is tried for registry-loaded models.  A usable bundle
+        deserializes the AOT executables instead of compiling them — the
+        zero-cold-start path; any miss silently falls back to compiling.
+        The quantized path never reads a bundle (its executables differ
+        from the float checkpoint's)."""
         shape = tuple(input_shape) if input_shape is not None else (
             self._infer_example_shape())
         if shape is None:
@@ -366,26 +389,72 @@ class Engine:
             with self._vlock:
                 self._current = _ModelVersion(
                     qm, self._current.tag + "+int8", self._devices)
-        self._warm_version(self._current)
+        self._warm_version(self._current, warm_bundle=warm_bundle,
+                           use_bundle=quantize is None)
         self._loaded = True
         return self
 
-    def _warm_version(self, v: _ModelVersion) -> None:
+    def _load_bundle_for(self, v: _ModelVersion,
+                         explicit_path: Optional[str] = None) -> dict:
+        """Resolve + load the warmup bundle for a version: an explicit
+        path wins, else the ``<checkpoint>.warm`` convention via the
+        provenance the registry stamps on loaded models.  Returns {} on
+        any miss (warmcache's fallback-to-compile contract)."""
+        if v.fwd is None:
+            return {}
+        from . import warmcache
+        path = explicit_path
+        if path is None:
+            ckpt = getattr(v.model, "_checkpoint_path", None)
+            if ckpt:
+                path = warmcache.bundle_path_for(ckpt)
+        if not path:
+            return {}
+        return warmcache.load_bundle(path)
+
+    def _warm_version(self, v: _ModelVersion,
+                      warm_bundle: Optional[str] = None,
+                      use_bundle: bool = True) -> None:
         if self._example_shape is None:
             return
+        bundle = (self._load_bundle_for(v, warm_bundle) if use_bundle
+                  else {})
         for dtype in self._warm_dtypes:
             for b in self.batcher.buckets:
+                dts = str(np.dtype(dtype))
                 x = np.zeros((b,) + self._example_shape, dtype=dtype)
                 t0 = self.clock()
-                for i in range(len(self._replicas)):
-                    np.asarray(self._run_forward(v, i, x))
-                # amortized per-replica steady-ish cost; the first call
-                # includes the compile, so only the LAST replica's time
-                # would be clean — re-run replica 0 once for the EMA seed
-                t0 = self.clock()
+                with obs_trace.span("serve/warmup", cat="serve", bucket=b,
+                                    dtype=dts, tag=v.tag):
+                    self._warm_pair(v, b, dts, x, bundle)
+                self.metrics.inc("warmup_seconds_total",
+                                 self.clock() - t0)
+                # the warm passes above include the compile (or bundle
+                # deserialize), so re-run replica 0 once for a clean
+                # per-bucket EMA seed
+                t1 = self.clock()
                 np.asarray(self._run_forward(v, 0, x))
-                self.batcher.observe_exec_ms(b, (self.clock() - t0) * 1e3)
-                self._warmed.add((b, str(np.dtype(dtype))))
+                self.batcher.observe_exec_ms(b, (self.clock() - t1) * 1e3)
+                self._warmed.add((b, dts))
+
+    def _warm_pair(self, v: _ModelVersion, b: int, dts: str, x: np.ndarray,
+                   bundle: dict) -> None:
+        """Warm one (bucket, dtype) pair: install the lead-device AOT
+        executable (bundle hit, else explicit lower+compile) and run it
+        on every replica (non-lead-device replicas warm the jit path)."""
+        if v.fwd is not None:
+            key = _bundle_key(b, dts)
+            if key not in v.aot:
+                hit = bundle.get(key)
+                if hit is not None:
+                    v.aot[key] = hit
+                    self.metrics.inc("bundle_hits")
+                else:
+                    v.aot[key] = v.fwd.lower(
+                        v.params[0], v.state[0], x).compile()
+                    self.metrics.inc("bundle_misses")
+        for i in range(len(self._replicas)):
+            np.asarray(self._run_forward(v, i, x))
 
     def _rewarm_replica(self, idx: int) -> None:
         """Re-warm one (respawned) replica: run every warmed (bucket,
@@ -408,12 +477,40 @@ class Engine:
 
     def compile_cache_size(self) -> Optional[int]:
         """Number of compiled executables backing the CURRENT version's
-        forward (None for non-jit-able models).  After ``load()`` this
-        must not grow while serving bucket-shaped requests — the
-        zero-compiles-at-serve-time contract (also across replica
-        respawns: re-warm is a cache-hit pass)."""
+        forward (None for non-jit-able models): the jit cache PLUS the
+        AOT warm executables.  After ``load()`` this must not grow while
+        serving bucket-shaped requests — the zero-compiles-at-serve-time
+        contract (also across replica respawns and autoscale births:
+        re-warm is a cache-hit/AOT pass)."""
         with self._vlock:
-            return self._current.cache_size()
+            jit_n = self._current.cache_size()
+            if jit_n is None:
+                return None
+            return jit_n + len(self._current.aot)
+
+    def save_warmup_bundle(self, path: Optional[str] = None) -> str:
+        """Write the current version's AOT executables as a warmup
+        bundle (serving/warmcache.py).  Default path: the
+        ``<checkpoint>.warm`` convention next to the version's
+        checkpoint zip (registry-loaded models carry their provenance).
+        A fresh process passes the bundle to ``load(warm_bundle=)`` —
+        or just registry-loads the same checkpoint — and warms from
+        disk instead of compiling."""
+        from . import warmcache
+        with self._vlock:
+            v = self._current
+        if not v.aot:
+            raise RuntimeError(
+                "nothing to bundle — load() the engine first (non-jit-able "
+                "models have no AOT executables)")
+        if path is None:
+            ckpt = getattr(v.model, "_checkpoint_path", None)
+            if not ckpt:
+                raise ValueError(
+                    "model has no checkpoint provenance (not registry-"
+                    "loaded); pass path= explicitly")
+            path = warmcache.bundle_path_for(ckpt)
+        return warmcache.save_bundle(path, v.tag, dict(v.aot))
 
     # -- request path ------------------------------------------------------
 
@@ -438,19 +535,20 @@ class Engine:
 
     def _dispatch_loop(self) -> None:
         rr = 0
-        n = len(self._replicas)
         while True:
             batch = self.batcher.next_batch()
             if batch is None:
                 break
-            rr = self._place_batch(batch, rr, n)
-        for r in self._replicas:
+            rr = self._place_batch(batch, rr)
+        for r in list(self._replicas):
             r.queue.put(_SENTINEL)
 
-    def _place_batch(self, batch: List[_Request], rr: int, n: int) -> int:
+    def _place_batch(self, batch: List[_Request], rr: int) -> int:
         """Round-robin placement skipping unhealthy/full replicas; waits
         (expiring deadlines) when nothing is dispatchable, fails the
-        batch deterministically on shutdown."""
+        batch deterministically on shutdown.  The replica list is
+        re-snapshotted every round — the autoscaler grows and shrinks
+        it concurrently."""
         while True:
             if self._shutdown:
                 for req in batch:
@@ -461,7 +559,9 @@ class Engine:
             batch = self._expire_batch(batch, now)
             if not batch:
                 return rr
-            candidates = [self._replicas[(rr + k) % n] for k in range(n)]
+            reps = list(self._replicas)
+            n = len(reps)
+            candidates = [reps[(rr + k) % n] for k in range(n)]
             dispatchable = [c for c in candidates
                             if self._dispatchable(c, now)]
             for c in dispatchable:
@@ -543,8 +643,29 @@ class Engine:
 
     # -- execution ---------------------------------------------------------
 
+    def _ensure_replica_params(self, v: _ModelVersion,
+                               replica_idx: int) -> None:
+        if replica_idx < len(v.params):
+            return
+        # a version built before an autoscale birth has no device-resident
+        # params for the new replica yet — extend on first touch
+        from ..datasets.device_prefetch import device_put_batch
+        with self._vlock:
+            while len(v.params) <= replica_idx:
+                d = self._replicas[len(v.params)].device
+                v.params.append(device_put_batch(v.model.params, d))
+                v.state.append(device_put_batch(v.model.state, d))
+
     def _run_forward(self, v: _ModelVersion, replica_idx: int, xs: np.ndarray):
         if v.fwd is not None:
+            self._ensure_replica_params(v, replica_idx)
+            if v.aot and self._replicas[replica_idx].device == self._devices[0]:
+                # AOT executables are compiled for the lead device; only
+                # replicas pinned there may run them (np inputs are
+                # uncommitted, params are per-replica device-resident)
+                exe = v.aot.get(_bundle_key(xs.shape[0], str(xs.dtype)))
+                if exe is not None:
+                    return exe(v.params[replica_idx], v.state[replica_idx], xs)
             return v.fwd(v.params[replica_idx], v.state[replica_idx], xs)
         out = v.model.output(xs)
         return out[0] if isinstance(out, list) else out
@@ -777,10 +898,113 @@ class Engine:
             if self._shutdown:
                 return
             now = self.clock()
-            for r in self._replicas:
+            for r in list(self._replicas):
                 if self._shutdown:
                     return
                 self._check_replica(r, now)
+            self._autoscale_tick(now)
+
+    # -- autoscaling --------------------------------------------------------
+
+    def enable_autoscale(self, autoscaler=None, *,
+                         min_replicas: Optional[int] = None,
+                         max_replicas: Optional[int] = None,
+                         interval_s: float = 0.25, **knobs) -> "Engine":
+        """Arm load-driven replica autoscaling (docs/SERVING.md "Cold
+        start & autoscaling").  The supervisor loop ticks a
+        ``ReplicaAutoscaler`` every ``interval_s`` with queue depth,
+        in-flight count, and the shed-counter delta; +1 births a replica
+        warmed from the AOT cache (zero new compiles), -1 retires the
+        last replica once idle.  Pass a pre-built controller for full
+        control (tests inject fake clocks), or knobs for the default one
+        (``up_load``/``down_load``/``up_ticks``/``down_ticks``/
+        ``cooldown_s``)."""
+        from .autoscale import ReplicaAutoscaler
+        if autoscaler is None:
+            n = len(self._replicas)
+            autoscaler = ReplicaAutoscaler(
+                min_replicas=n if min_replicas is None else int(min_replicas),
+                max_replicas=n if max_replicas is None else int(max_replicas),
+                clock=self.clock, **knobs)
+        self._autoscale_interval_s = float(interval_s)
+        self._shed_seen = self.metrics.counter_value("shed")
+        self._autoscaler = autoscaler
+        return self
+
+    def _autoscale_tick(self, now: float) -> None:
+        a = self._autoscaler
+        if a is None or not self._loaded or self._shutdown:
+            return
+        if (self._last_autoscale_t is not None
+                and now - self._last_autoscale_t < self._autoscale_interval_s):
+            return
+        self._last_autoscale_t = now
+        shed = self.metrics.counter_value("shed")
+        shed_delta = shed - self._shed_seen
+        self._shed_seen = shed
+        reps = list(self._replicas)
+        inflight = 0
+        for r in reps:
+            inflight += r.queue.qsize()
+            with r.lock:
+                if r.busy_since is not None:
+                    inflight += 1
+        decision = a.observe(self.batcher.qsize(), inflight, len(reps),
+                             shed_delta=int(shed_delta))
+        if decision > 0:
+            self._add_replica()
+        elif decision < 0:
+            self._retire_replica()
+
+    def _add_replica(self) -> None:
+        """Autoscale birth: a new replica on the next local device,
+        warmed from the already-compiled executables (AOT/jit cache-hit
+        pass — zero new compiles, the same contract as a respawn)."""
+        import jax
+
+        devices = jax.local_devices()
+        idx = len(self._replicas)
+        device = devices[idx % len(devices)]
+        r = _Replica(idx, device, self._inflight_per_replica)
+        with obs_trace.span("serve/scale_up", cat="serve", replica=idx):
+            self._start_replica_thread(r)
+            self._replicas.append(r)
+            self._devices.append(device)
+            self._rewarm_replica(idx)
+        self.metrics.inc("scale_ups")
+
+    def _retire_replica(self) -> None:
+        """Autoscale retire: remove the LAST replica (keeping indices
+        dense for round-robin) once it is live and idle; a busy one is
+        left for the next tick.  Anything a racing dispatch parked
+        behind the sentinel is redispatched — nothing strands."""
+        if len(self._replicas) <= 1:
+            return
+        r = self._replicas[-1]
+        with r.lock:
+            alive = r.thread is not None and r.thread.is_alive()
+            busy = r.busy_since is not None
+        if not alive or busy or not r.queue.empty():
+            return
+        with obs_trace.span("serve/scale_down", cat="serve", replica=r.idx):
+            # unroute first (the dispatcher snapshots the list), then
+            # sentinel the thread out
+            self._replicas.pop()
+            self._devices.pop()
+            r.queue.put(_SENTINEL)
+            if r.thread is not None:
+                r.thread.join(timeout=5.0)
+            leftovers = []
+            while True:
+                try:
+                    item = r.queue.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not _SENTINEL:
+                    leftovers.append(item)
+            for item in leftovers:
+                self._redispatch([q for q in item if not q.future.done()])
+        self.metrics.inc("scale_downs")
 
     def _check_replica(self, r: _Replica, now: float) -> None:
         with r.lock:
@@ -1005,18 +1229,24 @@ class Engine:
 
     # -- hot swap ----------------------------------------------------------
 
-    def swap_model(self, model, tag: Optional[str] = None) -> str:
+    def swap_model(self, model, tag: Optional[str] = None,
+                   warm_bundle: Optional[str] = None) -> str:
         """Atomic hot-swap: build + AOT-warm the new version, flip the
         current pointer, then drain — block until every in-flight batch
         on the old version completes before releasing it.  In-flight
         requests keep their version; a batch never mixes two versions.
         Returns the retired version's tag (rollback = swap back, or an
-        alias move in the registry)."""
+        alias move in the registry).
+
+        ``warm_bundle`` (or the incoming model's registry-stamped
+        ``<checkpoint>.warm`` provenance) lets the warm pass deserialize
+        AOT executables instead of compiling — a mid-traffic swap warms
+        from disk; any miss falls back to compile silently."""
         # graftcheck: disable=GC201 (wall-anchor: human-facing default tag names WHEN the swap happened; never feeds math or replay)
         nv = _ModelVersion(model, tag or f"swap@{time.time():.0f}",
                            self._devices)
         if self._loaded:
-            self._warm_version(nv)
+            self._warm_version(nv, warm_bundle=warm_bundle)
         return self._swap_version(nv)
 
     def _swap_version(self, nv: _ModelVersion) -> str:

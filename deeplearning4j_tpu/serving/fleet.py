@@ -692,13 +692,21 @@ class FleetRouter:
     def rolling_swap(self, model, tag: str, *, rollback_model=None,
                      rollback_tag: Optional[str] = None,
                      kind: str = "predict",
-                     drain_timeout_s: float = 30.0) -> dict:
+                     drain_timeout_s: float = 30.0,
+                     warm_bundle: Optional[str] = None) -> dict:
         """Swap every up host to (``model``, ``tag``) one at a time under
         live traffic: drain the host (peers absorb its load), swap,
         undrain, move on.  If a host dies mid-swap it is marked down and
         the already-swapped survivors roll back to
         (``rollback_model``, ``rollback_tag``) — the fleet never serves
-        two versions past the end of this call."""
+        two versions past the end of this call.
+
+        ``warm_bundle`` is handed to each host's ``swap_model`` so the
+        incoming version deserializes its executables instead of
+        compiling (serving/warmcache.py) — the swap's drain window stays
+        flat instead of absorbing a per-host cold compile.  Hosts whose
+        engine does not take the keyword (remote ``HttpHost`` proxies)
+        get the plain swap."""
         self.metrics.inc("rolling_swaps")
         report: Dict[str, Any] = {"ok": True, "tag": tag, "swapped": [],
                                   "rolled_back": False,
@@ -715,7 +723,15 @@ class FleetRouter:
                         raise FleetTimeoutError(
                             f"drain of {host.host_id} timed out after "
                             f"{drain_timeout_s}s")
-                    host.engine_for(kind).swap_model(model, tag)
+                    eng = host.engine_for(kind)
+                    if warm_bundle is not None:
+                        try:
+                            eng.swap_model(model, tag,
+                                           warm_bundle=warm_bundle)
+                        except TypeError:
+                            eng.swap_model(model, tag)
+                    else:
+                        eng.swap_model(model, tag)
                     swapped.append(host)
                     self.metrics.inc("swap_hosts")
                     obs_trace.instant("fleet/swap_host", cat="fleet",
@@ -757,12 +773,19 @@ class FleetRouter:
 
     def promote(self, registry, name: str, version=None,
                 alias: str = "prod", kind: str = "predict",
-                drain_timeout_s: float = 30.0) -> dict:
+                drain_timeout_s: float = 30.0,
+                warm_bundle: Optional[str] = None) -> dict:
         """Roll a registry promote through the fleet: resolve the new
         version once, remember the current alias target for rollback,
         swap host-by-host, and move the alias ONLY after every host
         swapped — a failed roll leaves both the fleet and the alias on
-        the old version."""
+        the old version.
+
+        When the version came off disk via ``registry.load``, its warmup
+        bundle (``<checkpoint>.warm``, if present) is used automatically:
+        ``warm_bundle`` overrides, else the checkpoint provenance the
+        registry stamped on the model resolves it inside each engine's
+        swap warmup."""
         new_version, new_model = registry.resolve(
             name, "latest" if version is None else version)
         try:
@@ -774,7 +797,8 @@ class FleetRouter:
             rollback_model=old_model,
             rollback_tag=(f"{name}:v{old_version}"
                           if old_version is not None else None),
-            kind=kind, drain_timeout_s=drain_timeout_s)
+            kind=kind, drain_timeout_s=drain_timeout_s,
+            warm_bundle=warm_bundle)
         report["version"] = new_version
         if report["ok"]:
             registry.set_alias(name, alias, new_version)

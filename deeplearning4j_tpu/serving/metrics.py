@@ -80,7 +80,8 @@ class LatencyHistogram(Histogram):
 
 
 # every counter a fresh engine reports as zero (docs/SERVING.md: the
-# batching/admission set, then the resilience + canary set)
+# batching/admission set, then the resilience + canary set, then the
+# cold-start/autoscale set)
 _COUNTER_KEYS = (
     "requests", "rows", "batches", "padded_rows",
     "shed", "deadline_missed", "errors", "swaps", "unwarmed_serves",
@@ -88,6 +89,8 @@ _COUNTER_KEYS = (
     "respawn_failures",
     "retries", "poison_isolated", "circuit_opens",
     "canary_promotions", "canary_rollbacks", "canary_mirrored_batches",
+    "warmup_seconds_total", "bundle_hits", "bundle_misses",
+    "scale_ups", "scale_downs",
 )
 
 
@@ -130,6 +133,12 @@ class ServingMetrics:
                 if c is None:
                     c = self._counters[key] = self.registry.counter(key)
         c.inc(n)
+
+    def counter_value(self, key: str) -> float:
+        """Current value of one counter (0.0 if never incremented) — the
+        cheap read the autoscaler's shed-delta signal polls."""
+        c = self._counters.get(key)
+        return float(c.value()) if c is not None else 0.0
 
     def record_batch(self, n_requests: int, rows: int, padded_rows: int,
                      device_ms: float) -> None:
@@ -227,12 +236,15 @@ class FleetMetrics:
 
 
 # every counter a fresh decode engine reports as zero (docs/SERVING.md
-# decode section: throughput set, then stop conditions, then resilience)
+# decode section: throughput set, then stop conditions, then resilience,
+# then the cold-start set)
 _DECODE_COUNTER_KEYS = (
     "requests", "tokens_out", "prefills", "decode_steps",
     "eos_stops", "max_token_stops", "deadline_stops",
     "shed", "deadline_missed", "errors", "retries",
     "poison_isolated", "replica_crashes", "replica_respawns", "swaps",
+    "warmup_seconds_total", "bundle_hits", "bundle_misses",
+    "scale_ups", "scale_downs",
 )
 
 
@@ -274,6 +286,12 @@ class DecodeMetrics:
                 if c is None:
                     c = self._counters[key] = self.registry.counter(key)
         c.inc(n)
+
+    def counter_value(self, key: str) -> float:
+        """Current value of one counter (0.0 if never incremented) — the
+        cheap read the autoscaler's shed-delta signal polls."""
+        c = self._counters.get(key)
+        return float(c.value()) if c is not None else 0.0
 
     def snapshot(self) -> dict:
         c: Dict[str, int] = {}

@@ -50,6 +50,10 @@ class ModelRegistry:
                          List[Tuple[Callable[[int, Any], None],
                                     Optional[Callable]]]] = {}
         self._canary_log: Dict[str, List[dict]] = {}
+        # (name, version) -> checkpoint path, for versions that came off
+        # disk — the provenance serving uses to find warmup bundles
+        # (serving/warmcache.py: `<checkpoint>.warm` next to the zip)
+        self._paths: Dict[Tuple[str, int], str] = {}
 
     # -- registration ------------------------------------------------------
 
@@ -71,10 +75,31 @@ class ModelRegistry:
     def load(self, name: str, path: str,
              version: Optional[int] = None) -> int:
         """Load a checkpoint zip (serializer FORMAT_VERSION 1-4) and
-        register it."""
+        register it.  The checkpoint path is recorded as provenance —
+        on the registry (:meth:`checkpoint_path`) AND stamped on the
+        model object — so serving's warmup can find the version's
+        warmup bundle (``<checkpoint>.warm``) through every swap /
+        promote seam without re-plumbing paths."""
         from ..utils.serializer import load_model
 
-        return self.register(name, load_model(path), version=version)
+        model = load_model(path)
+        model._checkpoint_path = str(path)
+        version = self.register(name, model, version=version)
+        with self._lock:
+            self._paths[(name, version)] = str(path)
+        return version
+
+    def checkpoint_path(self, name: str, ref: Any = "latest") -> Optional[str]:
+        """The checkpoint zip a version was loaded from (None for
+        in-memory registrations)."""
+        with self._lock:
+            if name not in self._models:
+                return None
+            try:
+                v = self._resolve_version_locked(name, ref)
+            except KeyError:
+                return None
+            return self._paths.get((name, v))
 
     # -- lookup ------------------------------------------------------------
 

@@ -1,0 +1,190 @@
+"""Zero-cold-start plumbing: persistent compile cache + warmup bundles.
+
+Two independent mechanisms, both optional and both silent-on-miss:
+
+1. **Persistent compilation cache** — `enable_compile_cache()` points the
+   process-wide JAX compilation cache at a directory (explicit argument
+   wins, else the ``DL4J_TPU_COMPILE_CACHE`` env var).  Every
+   ``jax.jit`` compile in the process — train, serve, launch workers,
+   bench — then reads/writes XLA executables on disk, so a respawned
+   process recompiles nothing it has compiled before.
+
+2. **Warmup bundles** — explicit AOT executables serialized with
+   ``jax.experimental.serialize_executable`` into a zip written next to
+   the checkpoint (``model.zip`` → ``model.zip.warm``), keyed by
+   (version tag, executable key, device fingerprint, jax version) with
+   sha256 integrity digests per entry (same idiom as the checkpoint
+   serializer).  A fresh ``Engine.load()`` / ``DecodeEngine.load()``
+   deserializes instead of compiling; ANY miss — absent file, corrupt
+   entry, truncated zip, wrong tag, wrong device fingerprint, wrong jax
+   version — falls back to compiling, never raises.  A missing bundle
+   is silent (the normal first-run case); an unusable one logs exactly
+   one warning.
+
+The executables inside a bundle are device-committed: they only run on
+the device set they were compiled for.  Callers route accordingly (see
+``Engine._run_forward``).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import pickle
+import warnings
+import zipfile
+from typing import Any, Dict, Optional
+
+import jax
+
+ENV_VAR = "DL4J_TPU_COMPILE_CACHE"
+BUNDLE_FORMAT_VERSION = 1
+BUNDLE_SUFFIX = ".warm"
+
+_enabled_dir: Optional[str] = None
+
+
+def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Enable the JAX persistent compilation cache process-wide.
+
+    Explicit ``cache_dir`` wins; otherwise the ``DL4J_TPU_COMPILE_CACHE``
+    env var; if neither is set this is a no-op returning None.  The
+    min-compile-time threshold is dropped to 0 so even the small CPU
+    test executables persist.  The env var is (re)exported so forked
+    workers (``launch``) inherit the setting.  Idempotent.
+    """
+    global _enabled_dir
+    d = cache_dir or os.environ.get(ENV_VAR)
+    if not d:
+        return None
+    d = os.path.abspath(d)
+    if _enabled_dir == d:
+        return d
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", d)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    # jax latches the cache state at the process's FIRST compile: if one
+    # already happened (e.g. the cache is enabled mid-run), the new dir
+    # is ignored until the cache re-initializes — force that here
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc,
+        )
+        _cc.reset_cache()
+    # graftcheck: disable=GC404 (best-effort: a jax build without reset_cache keeps the first-compile latch; the dir is still set for up-front enables)
+    except Exception:
+        pass
+    os.environ[ENV_VAR] = d
+    _enabled_dir = d
+    return d
+
+
+def device_fingerprint() -> str:
+    """Identity of the device set an AOT executable is valid for.
+
+    Serialized executables are XLA programs compiled for specific
+    hardware; loading one on a different backend/topology is undefined.
+    The fingerprint pins backend platform, device kind, device count,
+    and the jax version that produced the serialization format.
+    """
+    devs = jax.devices()
+    kind = devs[0].device_kind if devs else "none"
+    return "|".join(
+        [jax.default_backend(), str(kind), str(len(devs)), jax.__version__]
+    )
+
+
+def bundle_path_for(checkpoint_path: str) -> str:
+    """Warmup-bundle path convention: next to the checkpoint zip."""
+    return str(checkpoint_path) + BUNDLE_SUFFIX
+
+
+def save_bundle(path: str, tag: str, entries: Dict[str, Any]) -> str:
+    """Serialize AOT ``entries`` ({key: compiled executable}) to ``path``.
+
+    Zip layout mirrors the checkpoint serializer: a ``meta.json``
+    carrying tag / device fingerprint / jax version / key mapping /
+    per-entry sha256 integrity digests, plus one pickled
+    ``(payload, in_tree, out_tree)`` blob per executable.  Written
+    atomically (tmp + rename) so a crash mid-save never leaves a
+    half-bundle where a valid one was.
+    """
+    from jax.experimental import serialize_executable as _se
+
+    names: Dict[str, str] = {}
+    blobs: Dict[str, bytes] = {}
+    for i, key in enumerate(sorted(entries)):
+        payload, in_tree, out_tree = _se.serialize(entries[key])
+        ename = f"exec_{i}.bin"
+        names[ename] = key
+        blobs[ename] = pickle.dumps((payload, in_tree, out_tree))
+    meta = {
+        "format_version": BUNDLE_FORMAT_VERSION,
+        "tag": tag,
+        "fingerprint": device_fingerprint(),
+        "jax_version": jax.__version__,
+        "entries": names,
+        "integrity": {e: hashlib.sha256(b).hexdigest() for e, b in blobs.items()},
+    }
+    tmp = str(path) + ".tmp"
+    with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as z:
+        z.writestr("meta.json", json.dumps(meta, indent=2))
+        for ename, blob in blobs.items():
+            z.writestr(ename, blob)
+    os.replace(tmp, path)
+    return str(path)
+
+
+class _BundleMiss(Exception):
+    """Internal: a specific reason the bundle can't be used."""
+
+
+def load_bundle(path: Optional[str], tag: Optional[str] = None) -> Dict[str, Any]:
+    """Load a warmup bundle; return {} on ANY miss, never raise.
+
+    An absent file is the normal cold-start case and stays silent.  An
+    existing-but-unusable bundle (truncated/corrupt zip, integrity or
+    fingerprint or tag or jax-version mismatch, undeserializable entry)
+    emits exactly one ``RuntimeWarning`` naming the reason, then returns
+    {} so the caller compiles as if no bundle existed.
+    """
+    if not path or not os.path.exists(path):
+        return {}
+    from jax.experimental import serialize_executable as _se
+
+    try:
+        with zipfile.ZipFile(path, "r") as z:
+            meta = json.loads(z.read("meta.json"))
+            if meta.get("format_version") != BUNDLE_FORMAT_VERSION:
+                raise _BundleMiss(
+                    f"format_version {meta.get('format_version')!r}"
+                )
+            if tag is not None and meta.get("tag") != tag:
+                raise _BundleMiss(f"tag {meta.get('tag')!r} != wanted {tag!r}")
+            if meta.get("jax_version") != jax.__version__:
+                raise _BundleMiss(
+                    f"jax {meta.get('jax_version')!r} != {jax.__version__!r}"
+                )
+            fp = device_fingerprint()
+            if meta.get("fingerprint") != fp:
+                raise _BundleMiss(
+                    f"device fingerprint {meta.get('fingerprint')!r} != {fp!r}"
+                )
+            integrity = meta.get("integrity", {})
+            out: Dict[str, Any] = {}
+            for ename, key in meta.get("entries", {}).items():
+                blob = z.read(ename)
+                if integrity.get(ename) != hashlib.sha256(blob).hexdigest():
+                    raise _BundleMiss(f"integrity mismatch on {ename}")
+                payload, in_tree, out_tree = pickle.loads(blob)
+                out[key] = _se.deserialize_and_load(payload, in_tree, out_tree)
+            return out
+    except Exception as exc:  # noqa: BLE001 — fallback-to-compile contract:
+        # any unusable bundle must degrade to a cold compile, not an error.
+        warnings.warn(
+            f"warmup bundle {path!r} unusable ({exc!r}); falling back to "
+            "compile",
+            RuntimeWarning,
+            stacklevel=2,
+        )
+        return {}
