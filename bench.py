@@ -1693,6 +1693,73 @@ def bench_cold_start():
             "autoscale_ok": True, "compile_cache_ok": True}
 
 
+def bench_decode_speed():
+    """Config 23: decode-side speed offensive A/B (scripts/decode_ab.py
+    --speed-suite; CPU subprocess — the sharing/acceptance/quantization
+    logic under test is host-side + bitwise).  Three independently-gated
+    arms, HARD gates on EVERY platform:
+      prefix — shared-prefix p50 TTFT strictly below equal-length cold
+        p50 (suffix-only prefill runs a smaller bucket, so the win is
+        structural, not device-bound), prefix-hit logits BITWISE equal
+        to the re-encode oracle, greedy tokens identical to the plain
+        engine, hit counters advancing, zero serve-time compiles.
+      spec — self-draft control accepts >= k tokens/step, an
+        independent draft at temperature 0 is BITWISE identical to the
+        plain engine with accepted tokens/step >= 1.0, and a crash
+        injected mid-speculative-round strands nothing with retries
+        reproducing the plain tokens.
+      int8 — top-1 agreement vs the f32 oracle >= 0.80 (int8 changes
+        bits by design, so it gets an accuracy envelope, never the
+        identity gates) and f32/int8 pool bytes >= 2.0 (sessions at
+        fixed HBM)."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    script = os.path.join(_REPO, "scripts", "decode_ab.py")
+    cmd = [sys.executable, script, "--speed-suite"] + (
+        ["--quick"] if QUICK else [])
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=1800, cwd=_REPO)
+    if p.returncode != 0:
+        raise RuntimeError(f"decode_ab --speed-suite failed "
+                           f"(rc={p.returncode}): {p.stderr[-1500:]}")
+    ab = json.loads(p.stdout.strip().splitlines()[-1])
+    pre, spc, i8 = ab["prefix"], ab["spec"], ab["int8"]
+    if not pre.get("ok"):
+        raise RuntimeError("prefix-cache gate FAILED (hit TTFT < cold, "
+                           "bit-identity, token parity, hit counters, "
+                           f"zero compiles): {pre}")
+    if not spc.get("ok"):
+        raise RuntimeError("speculative gate FAILED (temp-0 bit-identity, "
+                           "accepted/step >= 1.0, self-draft >= k, crash "
+                           f"strands nothing): {spc}")
+    if not i8.get("ok"):
+        raise RuntimeError("int8 KV gate FAILED (top1-agree >= 0.80 "
+                           f"envelope, pool-bytes ratio >= 2.0): {i8}")
+    if not ab.get("plain_zero_compiles"):
+        raise RuntimeError("decode-speed AOT gate FAILED (plain control "
+                           f"engine paid a serve-time compile): {ab}")
+    return {"metric": "decode_ttft_hit_over_cold",
+            "value": pre["ttft_hit_over_cold"],
+            "unit": "ratio (cpu)" if ab["platform"] != "tpu" else "ratio",
+            "platform": ab["platform"],
+            "ttft_cold_p50_ms": pre["ttft_cold_p50_ms"],
+            "ttft_hit_p50_ms": pre["ttft_hit_p50_ms"],
+            "prefix_hits": pre["hits"],
+            "prefix_hit_tokens": pre["hit_tokens"],
+            "prefix_evictions": pre["evictions"],
+            "spec_accept_per_step": spc["accept_per_step"],
+            "spec_self_draft_accept_per_step":
+                spc["self_draft_accept_per_step"],
+            "spec_crash_retries": spc["crash_retries"],
+            "int8_top1_agree": i8["top1_agree"],
+            "int8_sessions_at_fixed_hbm": i8["sessions_at_fixed_hbm"],
+            "bit_identical": True, "tokens_match": True,
+            "zero_compiles": True, "stranded": 0}
+
+
 def _backfill_artifacts() -> None:
     """One-time repair of pre-round-6 artifacts: derive the structured
     ``parsed.results`` list from the stderr-tail regex and write it BACK
@@ -1767,7 +1834,8 @@ def main() -> None:
                      ("fused_update_ab", bench_fused_update_ab),
                      ("quantized_serving_ab", bench_quantized_serving_ab),
                      ("continuous_batching_ab", bench_continuous_batching),
-                     ("cold_start_ab", bench_cold_start)]:
+                     ("cold_start_ab", bench_cold_start),
+                     ("decode_speed_ab", bench_decode_speed)]:
         try:
             t0 = time.perf_counter()
             out = fn()

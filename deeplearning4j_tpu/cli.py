@@ -613,6 +613,34 @@ def cmd_serve(args) -> int:
     if port == 9000 and os.environ.get(ENV_SERVE_PORT):
         port = int(os.environ[ENV_SERVE_PORT])
     server = UIServer(port=port, host=args.host).attach_engine(engine)
+    decode_eng = None
+    wants_decode = (getattr(args, "prefix_cache", False)
+                    or getattr(args, "speculate", None)
+                    or getattr(args, "kv_dtype", None)
+                    not in (None, "float32"))
+    if wants_decode:
+        # decode-speed flags attach a DecodeEngine for POST /generate
+        # next to the predict engine (docs/SERVING.md "Decode-side
+        # optimizations")
+        if args.fleet:
+            raise SystemExit("--prefix-cache/--speculate/--kv-dtype need "
+                             "a local --model, not --fleet")
+        from .models.transformer import (TransformerBlock,
+                                         TransformerDecodeAdapter)
+        from .serving import DecodeEngine
+        net = _load_model(args.model)
+        if not any(isinstance(l, TransformerBlock)
+                   for l in net.conf.layers):
+            raise SystemExit("--prefix-cache/--speculate/--kv-dtype need "
+                             "a transformer LM checkpoint")
+        opts = _decode_opts(args)
+        decode_eng = DecodeEngine(TransformerDecodeAdapter(net),
+                                  **opts).load()
+        server.attach_decode_engine(decode_eng)
+        print(f"decode engine on POST /generate: "
+              f"prefix_cache={opts['prefix_cache']}, "
+              f"speculate_k={opts['speculate_k'] if opts['draft_model'] is not None else 0}, "
+              f"kv_dtype={opts['kv_dtype'] or 'float32'}")
     server.start()
     heartbeat = Heartbeat.start_from_env()
     handler = PreemptionHandler.install_from_env()
@@ -636,6 +664,8 @@ def cmd_serve(args) -> int:
     finally:
         server.stop()
         engine.shutdown()
+        if decode_eng is not None:
+            decode_eng.shutdown()
         if heartbeat is not None:
             heartbeat.stop()
         handler.uninstall()
@@ -659,6 +689,43 @@ def _sample_probs(probs: np.ndarray, temperature: float, top_k: int,
         p[order[1:][cum[:-1] >= top_p]] = 0.0   # keep top-1 always
     p /= p.sum()
     return int(rng.choice(p.shape[0], p=p))
+
+
+def _parse_speculate(spec):
+    """'DRAFT_CKPT[,k]' → (path, k) with a clean CLI error — the
+    --speculate argument of generate/serve."""
+    if spec is None:
+        return None, 4
+    path, sep, ks = spec.rpartition(",")
+    if sep and path:
+        try:
+            k = int(ks)
+            if k < 1:
+                raise ValueError
+        except ValueError:
+            raise SystemExit(f"bad --speculate {spec!r}: expected "
+                             "DRAFT_CKPT[,k] with k >= 1")
+        return path, k
+    return spec, 4
+
+
+def _decode_opts(args) -> dict:
+    """DecodeEngine kwargs for the decode-speed flags shared by
+    generate/serve: --prefix-cache, --speculate DRAFT_CKPT[,k],
+    --kv-dtype int8 (docs/SERVING.md "Decode-side optimizations")."""
+    from .models.transformer import TransformerDecodeAdapter
+
+    draft_path, k = _parse_speculate(getattr(args, "speculate", None))
+    draft = None
+    if draft_path:
+        draft = TransformerDecodeAdapter(_load_model(draft_path))
+    kv = getattr(args, "kv_dtype", None)
+    return {
+        "prefix_cache": bool(getattr(args, "prefix_cache", False)),
+        "draft_model": draft,
+        "speculate_k": k,
+        "kv_dtype": None if kv in (None, "float32") else kv,
+    }
 
 
 def cmd_generate(args) -> int:
@@ -693,7 +760,8 @@ def cmd_generate(args) -> int:
         if not prompt_ids:
             raise SystemExit("--prompt must be non-empty")
         eng = DecodeEngine(adapter, max_slots=1, page_size=page,
-                           default_max_new=args.max_tokens).load()
+                           default_max_new=args.max_tokens,
+                           **_decode_opts(args)).load()
         try:
             if len(prompt_ids) > eng.max_prompt:
                 raise SystemExit(f"prompt longer than the warmed buckets "
@@ -713,6 +781,12 @@ def cmd_generate(args) -> int:
         return 0
 
     # recurrent path: reference rnnTimeStep() streaming
+    if (getattr(args, "prefix_cache", False)
+            or getattr(args, "speculate", None)
+            or getattr(args, "kv_dtype", None) not in (None, "float32")):
+        raise SystemExit(
+            "--prefix-cache/--speculate/--kv-dtype need a transformer LM "
+            "checkpoint (they live in the paged decode engine)")
     out_layer = net.conf.layers[-1]
     vocab = int(getattr(out_layer, "n_out", 256) or 256)
     prompt_ids = [min(ord(c), vocab - 1) for c in args.prompt]
@@ -1125,6 +1199,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="record request/batch span tracing; the ring "
                    "buffer is served live on GET /trace and written to "
                    "PATH on shutdown (docs/OBSERVABILITY.md)")
+    v.add_argument("--prefix-cache", action="store_true",
+                   help="radix prefix cache over the paged KV pool: "
+                   "shared-prompt requests attach matching pages "
+                   "read-only and prefill only their suffix "
+                   "(docs/SERVING.md 'Decode-side optimizations')")
+    v.add_argument("--speculate", metavar="DRAFT_CKPT[,k]",
+                   help="speculative decoding: DRAFT_CKPT proposes k "
+                   "tokens per step (default k=4), the target "
+                   "verifies in one dispatch — temp-0 output is "
+                   "bit-identical to plain decode")
+    v.add_argument("--kv-dtype", choices=("float32", "int8"),
+                   default="float32",
+                   help="KV page storage dtype: int8 stores "
+                   "per-row-quantized pages + f32 scales (~4x "
+                   "sessions at fixed HBM; changes bits — gated by "
+                   "a top1-agree envelope, not the identity gates)")
     v.set_defaults(fn=cmd_serve)
 
     g = sub.add_parser(
@@ -1149,6 +1239,17 @@ def build_parser() -> argparse.ArgumentParser:
     g.add_argument("--page-size", type=int, default=16,
                    help="KV-cache page size in tokens (transformer path; "
                    "auto-shrunk for short position tables)")
+    g.add_argument("--prefix-cache", action="store_true",
+                   help="radix prefix cache over the paged KV pool "
+                   "(transformer path; docs/SERVING.md)")
+    g.add_argument("--speculate", metavar="DRAFT_CKPT[,k]",
+                   help="speculative decoding: DRAFT_CKPT proposes k "
+                   "tokens per step (default k=4); temp-0 output is "
+                   "bit-identical to plain decode")
+    g.add_argument("--kv-dtype", choices=("float32", "int8"),
+                   default="float32",
+                   help="KV page storage dtype; int8 quantizes pages "
+                   "per row (~4x sessions at fixed HBM)")
     g.set_defaults(fn=cmd_generate)
 
     s = sub.add_parser("summary", help="model + memory summary")

@@ -244,7 +244,7 @@ class TransformerDecodeAdapter:
                        max_len: Optional[int] = None):
         from ..ops.kv_cache import (
             NEG_INF, DecodeProgram, det_attention, gather_layer,
-            write_prefill, write_step,
+            write_prefill, write_step, write_tokens,
         )
 
         pos_rows = int(self.params["pos"]["P"].shape[0])
@@ -309,6 +309,58 @@ class TransformerDecodeAdapter:
                 h = block_finish(bp, h, det_attention(q, k_all, v_all, bias))
             return k_pages, v_pages, head(params, h)[:, 0]
 
+        def prefill_at(params, k_pages, v_pages, page_table_row, tokens,
+                       n_real, offset):
+            # suffix prefill for a prefix-cache hit: rows occupy absolute
+            # positions offset..offset+tb-1 and attend over the shared
+            # prefix rows already resident in the attached pages.  Same
+            # per-row ops as prefill, so logits stay bit-identical.
+            tb = tokens.shape[0]
+            pos_abs = offset + jnp.arange(tb, dtype=jnp.int32)
+            h = (tok_embed(params, tokens)
+                 + params["pos"]["P"][jnp.clip(pos_abs, 0, pos_rows - 1)]
+                 )[None]
+            bias = jnp.where(
+                jnp.arange(L, dtype=jnp.int32)[None, :]
+                <= pos_abs[:, None], 0.0, NEG_INF)[None, None]
+            pt = page_table_row[None]
+            for i, bp in enumerate(params["blocks"]):
+                q, k, v = block_kv_project(bp, h, n_heads)
+                k_pages = write_prefill(k_pages, i, page_table_row,
+                                        k.transpose(0, 2, 1, 3)[0], offset)
+                v_pages = write_prefill(v_pages, i, page_table_row,
+                                        v.transpose(0, 2, 1, 3)[0], offset)
+                k_all = gather_layer(k_pages, i, pt).transpose(0, 2, 1, 3)
+                v_all = gather_layer(v_pages, i, pt).transpose(0, 2, 1, 3)
+                h = block_finish(bp, h, det_attention(q, k_all, v_all, bias))
+            return k_pages, v_pages, head(params, h)[0, n_real - 1]
+
+        def spec_step(params, k_pages, v_pages, page_table, tokens,
+                      positions, active):
+            # speculative verify: score tokens [S, T] at absolute
+            # positions positions[s]..positions[s]+T-1 in ONE call,
+            # writing their K/V rows (overflow rows route to scratch in
+            # write_tokens).  Rejected rows are garbage-but-finite and
+            # stay masked until overwritten by the next round.
+            s_n, t_n = tokens.shape
+            pos_abs = positions[:, None] + jnp.arange(t_n, dtype=jnp.int32)
+            h = (tok_embed(params, tokens)
+                 + params["pos"]["P"][jnp.clip(pos_abs, 0, pos_rows - 1)])
+            bias = jnp.where(
+                jnp.arange(L, dtype=jnp.int32)[None, None, :]
+                <= pos_abs[:, :, None], 0.0, NEG_INF)[:, None]
+            pt = jnp.where(active[:, None], page_table, 0)
+            for i, bp in enumerate(params["blocks"]):
+                q, k, v = block_kv_project(bp, h, n_heads)
+                k_pages = write_tokens(k_pages, i, pt, positions,
+                                       k.transpose(0, 2, 1, 3))
+                v_pages = write_tokens(v_pages, i, pt, positions,
+                                       v.transpose(0, 2, 1, 3))
+                k_all = gather_layer(k_pages, i, pt).transpose(0, 2, 1, 3)
+                v_all = gather_layer(v_pages, i, pt).transpose(0, 2, 1, 3)
+                h = block_finish(bp, h, det_attention(q, k_all, v_all, bias))
+            return k_pages, v_pages, head(params, h)
+
         def reencode(params, tokens):
             b, t = tokens.shape
             h = tok_embed(params, tokens) + params["pos"]["P"][:t]
@@ -325,4 +377,5 @@ class TransformerDecodeAdapter:
             prefill=prefill, step=step, reencode=reencode,
             n_layers=n_layers, n_heads=n_heads, d_head=d_model // n_heads,
             vocab_size=self.vocab_size, max_len=L, page_size=page_size,
-            pages_per_slot=L // page_size)
+            pages_per_slot=L // page_size,
+            prefill_at=prefill_at, spec_step=spec_step)

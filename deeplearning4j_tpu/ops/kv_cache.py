@@ -40,7 +40,7 @@ flash/mha kernels.
 from __future__ import annotations
 
 import math
-from typing import Any, Callable, NamedTuple
+from typing import Any, Callable, NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -54,23 +54,78 @@ NEG_INF = _NEG_INF  # shared masking convention with ops/attention.py
 SCRATCH_PAGE = 0    # pool page 0: write target for masked-out slots
 
 
+class QuantPages(NamedTuple):
+    """Int8 page pool: symmetrically quantized values plus the f32
+    scales that ride alongside (``kv_dtype="int8"``).
+
+    ``q``: [n_layers, n_pages, page_size, n_heads, d_head] int8;
+    ``scale``: [n_layers, n_pages, page_size] f32 — one scale per cached
+    row.  Pages fill append-only (prefill writes a range, each decode
+    step appends one row), so the symmetric scale is computed per ROW at
+    write time: a page-wide amax would change as rows arrive and force
+    requantizing rows already stored.  Row granularity is the
+    page-aligned refinement of per-page quantization that append-only
+    writes admit, and every scale lives in the page-indexed side arrays
+    so pages still share/free/scrub as a unit.  Dequantization happens
+    in ``gather_layer`` (feeding ``det_scores``/``det_weighted_sum``
+    f32), so attention math is unchanged — int8 trades bits for HBM and
+    is gated behind an accuracy envelope (bench ``decode_speed_ab``).
+    """
+
+    q: Array
+    scale: Array
+
+
+KVPool = Union[Array, QuantPages]
+
+
 class KVCache(NamedTuple):
     """Device carry state: the page pools for K and V.
 
     ``k_pages`` / ``v_pages``: [n_layers, n_pages, page_size, n_heads,
-    d_head].  Page tables and sequence positions live host-side in the
-    decode engine (tiny int arrays passed per call).
+    d_head] (or :class:`QuantPages` when ``kv_dtype="int8"``).  Page
+    tables and sequence positions live host-side in the decode engine
+    (tiny int arrays passed per call).
     """
 
-    k_pages: Array
-    v_pages: Array
+    k_pages: KVPool
+    v_pages: KVPool
 
 
 def alloc_cache(n_layers: int, n_pages: int, page_size: int, n_heads: int,
-                d_head: int, dtype=jnp.float32) -> KVCache:
-    """Zero-filled pool.  ``n_pages`` INCLUDES the scratch page 0."""
+                d_head: int, dtype=jnp.float32,
+                kv_dtype: Optional[str] = None) -> KVCache:
+    """Zero-filled pool.  ``n_pages`` INCLUDES the scratch page 0.
+    ``kv_dtype="int8"`` allocates int8 value pools with f32 row scales
+    (a zero scale dequantizes untouched rows to the same 0.0 an f32
+    pool starts with)."""
     shape = (n_layers, n_pages, page_size, n_heads, d_head)
+    if kv_dtype in ("int8", "i8"):
+        def pool():
+            return QuantPages(jnp.zeros(shape, jnp.int8),
+                              jnp.zeros(shape[:3], jnp.float32))
+        return KVCache(pool(), pool())
     return KVCache(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+
+def pool_nbytes(cache) -> int:
+    """Resident bytes of a cache (pool values + any quant scales) — the
+    sessions-at-fixed-HBM arithmetic in bench ``decode_speed_ab``."""
+    return int(sum(a.size * a.dtype.itemsize
+                   for a in jax.tree_util.tree_leaves(cache)))
+
+
+def _quantize_rows(kv: Array) -> tuple:
+    """Per-row symmetric int8: ``kv`` [..., H, d] → (int8 values,
+    f32 scales [...]) with scale = amax/127 (ops/quantize.py scheme;
+    zero rows get scale 1.0 so dequant stays exact-zero).  A non-finite
+    row propagates through its SCALE, so poison isolation still sees
+    NaN after dequantization."""
+    amax = jnp.max(jnp.abs(kv), axis=(-2, -1))
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(kv / scale[..., None, None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
 
 
 def pages_for(n_tokens: int, page_size: int) -> int:
@@ -81,39 +136,93 @@ def pages_for(n_tokens: int, page_size: int) -> int:
 # -- pool read/write (pure; all shapes static) ----------------------------
 
 
-def write_prefill(pages: Array, layer: int, page_table_row: Array,
-                  kv: Array) -> Array:
+def _pool_values(pages: KVPool) -> Array:
+    return pages.q if isinstance(pages, QuantPages) else pages
+
+
+def _pool_set(pages: KVPool, layer, page_idx, slot_idx, kv: Array) -> KVPool:
+    """Scatter f32 rows into an f32 or int8 pool (quantizing on write)."""
+    if isinstance(pages, QuantPages):
+        q, sc = _quantize_rows(kv)
+        return QuantPages(pages.q.at[layer, page_idx, slot_idx].set(q),
+                          pages.scale.at[layer, page_idx, slot_idx].set(sc))
+    return pages.at[layer, page_idx, slot_idx].set(kv)
+
+
+def write_prefill(pages: KVPool, layer: int, page_table_row: Array,
+                  kv: Array, offset=0) -> KVPool:
     """Scatter a prompt's projected rows into one slot's pages.
 
     ``page_table_row`` [pages_per_slot] int32, ``kv`` [T, H, d] written
-    at positions 0..T-1.  Positions beyond the prompt's real length are
+    at positions ``offset``..``offset+T-1`` (``offset`` defaults to 0;
+    a prefix-cache suffix prefill passes the matched token count, a
+    page multiple).  Positions beyond the prompt's real length are
     garbage-but-finite and masked by the step bias until overwritten by
-    the decode steps that reach them.
+    the decode steps that reach them; positions past the slot's page
+    capacity (an offset prefill's bucket padding can overshoot) are
+    routed to the scratch page.
     """
     t = kv.shape[0]
-    page_size = pages.shape[2]
-    pos = jnp.arange(t, dtype=jnp.int32)
-    page_idx = page_table_row[pos // page_size]
-    return pages.at[layer, page_idx, pos % page_size].set(kv)
+    page_size = _pool_values(pages).shape[2]
+    pps = page_table_row.shape[0]
+    pos = offset + jnp.arange(t, dtype=jnp.int32)
+    idx = pos // page_size
+    page_idx = jnp.where(idx < pps,
+                         page_table_row[jnp.clip(idx, 0, pps - 1)],
+                         SCRATCH_PAGE)
+    return _pool_set(pages, layer, page_idx, pos % page_size, kv)
 
 
-def write_step(pages: Array, layer: int, page_table: Array, positions: Array,
-               kv: Array) -> Array:
+def write_step(pages: KVPool, layer: int, page_table: Array, positions: Array,
+               kv: Array) -> KVPool:
     """Scatter one token per slot: ``page_table`` [S, pages_per_slot],
     ``positions`` [S], ``kv`` [S, H, d].  Masked slots are routed to the
     scratch page by the caller (their table rows are zeroed)."""
-    page_size = pages.shape[2]
+    page_size = _pool_values(pages).shape[2]
     s = jnp.arange(page_table.shape[0], dtype=jnp.int32)
     page_idx = page_table[s, positions // page_size]
-    return pages.at[layer, page_idx, positions % page_size].set(kv)
+    return _pool_set(pages, layer, page_idx, positions % page_size, kv)
 
 
-def gather_layer(pages: Array, layer: int, page_table: Array) -> Array:
-    """[S, pages_per_slot] table -> [S, L, H, d] contiguous view of one
-    layer's cached rows (L = pages_per_slot * page_size)."""
-    g = pages[layer][page_table]          # [S, pps, page, H, d]
+def write_tokens(pages: KVPool, layer: int, page_table: Array,
+                 positions: Array, kv: Array) -> KVPool:
+    """Scatter a RANGE of tokens per slot — the speculative-verify
+    write.  ``page_table`` [S, pages_per_slot], ``positions`` [S] (the
+    absolute position of each slot's first row), ``kv`` [S, T, H, d]
+    written at positions ``positions[s]``..``positions[s]+T-1``.  Rows
+    past the slot's page capacity are routed to the scratch page (a
+    fixed-k speculative step near ``max_len`` overshoots by
+    construction — those proposals are never committed)."""
+    page_size = _pool_values(pages).shape[2]
+    s_n, pps = page_table.shape
+    t_n = kv.shape[1]
+    pos = positions[:, None] + jnp.arange(t_n, dtype=jnp.int32)[None, :]
+    idx = pos // page_size
+    s_ix = jnp.arange(s_n, dtype=jnp.int32)[:, None]
+    page_idx = jnp.where(idx < pps,
+                         page_table[s_ix, jnp.clip(idx, 0, pps - 1)],
+                         SCRATCH_PAGE)
+    return _pool_set(pages, layer, page_idx, pos % page_size, kv)
+
+
+def gather_layer(pages: KVPool, layer: int, page_table: Array) -> Array:
+    """[S, pages_per_slot] table -> [S, L, H, d] contiguous f32 view of
+    one layer's cached rows (L = pages_per_slot * page_size).  Int8
+    pools dequantize here — ``det_scores``/``det_weighted_sum`` always
+    see f32, so the attention math is dtype-agnostic."""
+    if isinstance(pages, QuantPages):
+        g = (pages.q[layer][page_table].astype(jnp.float32)
+             * pages.scale[layer][page_table][..., None, None])
+    else:
+        g = pages[layer][page_table]      # [S, pps, page, H, d]
     s, pps, page, h, d = g.shape
     return g.reshape(s, pps * page, h, d)
+
+
+def scrub_pool(pages: KVPool, ids: Array) -> KVPool:
+    """Zero the given page ids — values AND scales for int8 pools (a
+    stale scale would re-scale the next tenant's rows)."""
+    return jax.tree_util.tree_map(lambda a: a.at[:, ids].set(0), pages)
 
 
 # -- deterministic attention ----------------------------------------------
@@ -157,6 +266,20 @@ class DecodeProgram(NamedTuple):
            active) -> (k_pages, v_pages, logits [S, V])   all slots
       reencode(params, tokens [B, L]) -> logits [B, L, V]
           the full-forward reference the bit-identity gate compares to
+
+    Optional decode-speed entry points (``None`` when the model does
+    not provide them; the engine falls back to the plain paths):
+
+      prefill_at(params, k_pages, v_pages, page_table_row, tokens,
+                 n_real, offset) -> (k_pages, v_pages, logits [V])
+          suffix prefill for a prefix-cache hit: rows land at absolute
+          positions offset..offset+Tb-1 and attend over the shared
+          prefix pages already in the pool
+      spec_step(params, k_pages, v_pages, page_table, tokens [S, T],
+                positions [S], active [S])
+          -> (k_pages, v_pages, logits [S, T, V])
+          speculative verify: score T tokens per slot in one call,
+          writing their K/V rows (overflow rows route to scratch)
     """
 
     prefill: Callable[..., Any]
@@ -169,3 +292,5 @@ class DecodeProgram(NamedTuple):
     max_len: int            # L: fixed key length = pages_per_slot * page_size
     page_size: int
     pages_per_slot: int
+    prefill_at: Any = None
+    spec_step: Any = None

@@ -318,7 +318,7 @@ class ShardedTransformerLM:
         from ..nn.layers.normalization import layer_norm
         from ..ops.kv_cache import (
             NEG_INF, DecodeProgram, det_attention, gather_layer,
-            write_prefill, write_step,
+            write_prefill, write_step, write_tokens,
         )
 
         if int(np.prod(list(self.mesh.shape.values()))) != 1:
@@ -394,6 +394,64 @@ class ShardedTransformerLM:
             h = layer_norm(h, params["lnf_g"], params["lnf_b"])
             return k_pages, v_pages, (h @ params["head"])[:, 0]
 
+        def prefill_at(params, k_pages, v_pages, page_table_row, tokens,
+                       n_real, offset):
+            """Suffix prefill for a prefix-cache hit: the bucket's rows
+            land at absolute positions offset..offset+Tb-1 and attend
+            over the shared prefix rows already resident in the attached
+            pages.  Same per-row ops as ``prefill`` (position gather vs
+            slice reads the same table rows), so the last-real-position
+            logits stay bit-identical to a cold full prefill."""
+            tb = tokens.shape[0]
+            pos_abs = offset + jnp.arange(tb, dtype=jnp.int32)
+            h = (params["embed"][tokens]
+                 + params["pos"][jnp.clip(pos_abs, 0, pos_rows - 1)])[None]
+            bias = jnp.where(
+                jnp.arange(L, dtype=jnp.int32)[None, :]
+                <= pos_abs[:, None], 0.0, NEG_INF)[None, None]
+            pt = page_table_row[None]
+            for i, bp in enumerate(_blocks(params)):
+                q, k, v = block_kv_project(bp, h, n_heads)
+                k_pages = write_prefill(k_pages, i, page_table_row,
+                                        k.transpose(0, 2, 1, 3)[0], offset)
+                v_pages = write_prefill(v_pages, i, page_table_row,
+                                        v.transpose(0, 2, 1, 3)[0], offset)
+                k_all = gather_layer(k_pages, i, pt).transpose(0, 2, 1, 3)
+                v_all = gather_layer(v_pages, i, pt).transpose(0, 2, 1, 3)
+                h = block_finish(bp, h, det_attention(q, k_all, v_all, bias))
+            h = layer_norm(h, params["lnf_g"], params["lnf_b"])
+            return k_pages, v_pages, (h @ params["head"])[0, n_real - 1]
+
+        def spec_step(params, k_pages, v_pages, page_table, tokens,
+                      positions, active):
+            """Speculative verify: score ``tokens`` [S, T] at absolute
+            positions positions[s]..positions[s]+T-1 in ONE fixed-shape
+            call, writing their K/V rows (overflow rows route to the
+            scratch page inside write_tokens).  Rejected rows are
+            garbage-but-finite and stay masked until the next round
+            overwrites them.  Per-row math matches ``step``, so each
+            row's logits are bit-identical to stepping tokens one at a
+            time — the greedy temp-0 identity gate rides on this."""
+            s_n, t_n = tokens.shape
+            pos_abs = positions[:, None] + jnp.arange(t_n, dtype=jnp.int32)
+            h = (params["embed"][tokens]
+                 + params["pos"][jnp.clip(pos_abs, 0, pos_rows - 1)])
+            bias = jnp.where(
+                jnp.arange(L, dtype=jnp.int32)[None, None, :]
+                <= pos_abs[:, :, None], 0.0, NEG_INF)[:, None]
+            pt = jnp.where(active[:, None], page_table, 0)
+            for i, bp in enumerate(_blocks(params)):
+                q, k, v = block_kv_project(bp, h, n_heads)  # [S,H,T,dh]
+                k_pages = write_tokens(k_pages, i, pt, positions,
+                                       k.transpose(0, 2, 1, 3))
+                v_pages = write_tokens(v_pages, i, pt, positions,
+                                       v.transpose(0, 2, 1, 3))
+                k_all = gather_layer(k_pages, i, pt).transpose(0, 2, 1, 3)
+                v_all = gather_layer(v_pages, i, pt).transpose(0, 2, 1, 3)
+                h = block_finish(bp, h, det_attention(q, k_all, v_all, bias))
+            h = layer_norm(h, params["lnf_g"], params["lnf_b"])
+            return k_pages, v_pages, h @ params["head"]
+
         def reencode(params, tokens):
             """Full forward at the SAME fixed length L with the SAME
             deterministic attention — the naive-baseline arm and the
@@ -415,4 +473,5 @@ class ShardedTransformerLM:
             prefill=prefill, step=step, reencode=reencode,
             n_layers=n_layers, n_heads=n_heads, d_head=d_model // n_heads,
             vocab_size=self.vocab_size, max_len=L, page_size=page_size,
-            pages_per_slot=L // page_size)
+            pages_per_slot=L // page_size,
+            prefill_at=prefill_at, spec_step=spec_step)

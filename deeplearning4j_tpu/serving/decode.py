@@ -53,8 +53,43 @@ deterministic: the PRNG key is ``fold_in(PRNGKey(seed), token_index)``,
 so a sequence is a pure function of (params, prompt, sampling spec) —
 the property the retry path and the A/B bit-identity gate both lean on.
 
+Three stacked decode-side optimizations, each independently gated
+(docs/SERVING.md "Decode-side optimizations"):
+
+  radix prefix cache (``prefix_cache=True``)
+      A host-side trie keyed by page-sized token chunks maps
+      fully-filled prompt pages to refcounted pool pages.  On admit,
+      the longest matching PAGE-ALIGNED prefix is attached read-only to
+      the new request's page table and only the unmatched suffix
+      prefills (``prefill_at``), so a shared-prefix TTFT collapses
+      toward one suffix dispatch.  Shared pages are copy-on-write by
+      construction: a request only ever WRITES pages it privately owns
+      (the first partial page is re-prefilled privately; generated
+      tokens land past the insertable region), so sharing needs no page
+      copies at all.  ``_finish`` decrefs instead of freeing; eviction
+      is LRU over refcount-zero leaves under pool pressure.
+
+  speculative decoding (``draft_model=..., speculate_k=k``)
+      A draft program proposes k tokens per round (k cheap draft steps
+      against a draft-sized pool indexed by the SAME page table); the
+      target scores all k+1 rows in ONE fixed-shape ``spec_step``
+      dispatch and seeded rejection sampling commits 1..k+1 tokens.
+      At temperature 0 acceptance degenerates to exact greedy match,
+      so output is BIT-identical to non-speculative decode; at
+      temperature > 0 commits are exactly target-distributed but use
+      dedicated RNG streams, so the sampled sequence differs from the
+      non-speculative stream (documented, not gated).
+
+  int8 KV storage (``kv_dtype="int8"``)
+      Pages hold per-row symmetric int8 values + f32 scales
+      (ops/kv_cache.QuantPages), quantized on write and dequantized in
+      ``gather_layer`` — attention math stays f32.  ~4x sessions at
+      fixed HBM; changes bits, so it is gated by a top1-agree accuracy
+      envelope in ``decode_speed_ab``, never by the identity gates.
+
 TTFT and time-per-output-token are first-class (``DecodeMetrics``,
-``serve/prefill`` / ``serve/decode_step`` spans — docs/OBSERVABILITY.md).
+``serve/prefill`` / ``serve/decode_step`` / ``serve/prefix_attach`` /
+``serve/spec_verify`` spans — docs/OBSERVABILITY.md).
 """
 
 from __future__ import annotations
@@ -106,11 +141,15 @@ class _GenSpec:
 
 
 class _Slot:
-    """Host-side state of one occupied decode slot."""
+    """Host-side state of one occupied decode slot.  ``page_ids`` are
+    the slot's PRIVATE pages (freed at finish); ``shared_nodes`` are the
+    prefix-trie nodes it holds a reference on — the first ``n_matched``
+    are donor pages attached read-only at admit, the rest are pages this
+    slot's own prefill inserted (decref'd, never freed directly)."""
 
     __slots__ = ("req", "spec", "tag", "page_ids", "n_prompt", "pos",
                  "last_token", "tokens", "n_out", "max_new", "deadline",
-                 "t_first", "t_last", "logits")
+                 "t_first", "t_last", "logits", "shared_nodes", "n_matched")
 
     def __init__(self, req, tag: str, page_ids: List[int], max_new: int):
         self.req = req
@@ -128,6 +167,31 @@ class _Slot:
         self.t_last = 0.0
         self.logits: Optional[List[np.ndarray]] = \
             [] if self.spec.echo_logits else None
+        self.shared_nodes: List["_PrefixNode"] = []
+        self.n_matched = 0
+
+
+class _PrefixNode:
+    """One fully-filled, immutable KV page in the radix prefix trie.
+    ``key`` is the page's token tuple (length = page_size); ``refs``
+    counts the slots currently holding the page in their page table
+    (a holder of a node holds every ancestor, so refs are monotonically
+    non-increasing root -> leaf and a refs-0 node's children are also
+    refs-0).  ``last_used`` is an injectable-clock timestamp (GC201)
+    driving LRU eviction; ``detached`` marks a node already pulled out
+    of the trie (never match it again)."""
+
+    __slots__ = ("key", "page_id", "refs", "children", "parent",
+                 "last_used", "detached")
+
+    def __init__(self, key: tuple, page_id: Optional[int], parent):
+        self.key = key
+        self.page_id = page_id
+        self.refs = 0
+        self.children: Dict[tuple, "_PrefixNode"] = {}
+        self.parent = parent
+        self.last_used = 0.0
+        self.detached = False
 
 
 def _make_samplers(vocab_size: int):
@@ -163,6 +227,106 @@ def _make_samplers(vocab_size: int):
     return sample_one, sample_batch
 
 
+# speculative decoding draws from dedicated RNG streams so a request's
+# (seed, token_index) space never collides across the draft proposal,
+# the accept test, and the residual resample
+_DRAFT_STREAM, _ACCEPT_STREAM, _RESID_STREAM = 1, 2, 3
+
+
+def _make_spec_fns(vocab_size: int, n_spec: int):
+    """(propose, accept) pure fns for speculative decoding with
+    ``n_spec`` draft tokens per round.
+
+    ``propose`` samples one draft token per slot from the WARPED draft
+    distribution (same temperature/top-k/top-p filter as
+    ``_make_samplers``; one-hot(argmax) at temperature <= 0) and
+    returns the full distribution — the accept test needs p_draft(d).
+
+    ``accept`` runs exact rejection sampling: draft token j is accepted
+    iff u_j < p_target(d_j) / p_draft(d_j) with u_j a seeded uniform;
+    the first rejected position resamples from the normalized residual
+    max(p_target - p_draft, 0), and full acceptance earns the bonus
+    token from the target's row k ("residual" against an all-zero draft
+    row — pure target).  At temperature <= 0 both distributions are
+    one-hot, the ratio is exactly 0 or 1, and the commit short-circuits
+    to argmax of the target row — deterministic, RNG-free, and
+    bit-identical to the non-speculative greedy path.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def _key(seed, stream, step):
+        return jax.random.fold_in(
+            jax.random.fold_in(jax.random.PRNGKey(seed), stream), step)
+
+    def _warped(lg, t, k, p):
+        # the sample_one filter, expressed as a distribution
+        scaled = lg / jnp.maximum(t, 1e-6)
+        srt = jnp.sort(scaled)[::-1]
+        kk = jnp.clip(jnp.where(k > 0, k, vocab_size), 1, vocab_size)
+        thr_k = srt[kk - 1]
+        probs = jax.nn.softmax(srt)
+        cum_excl = jnp.cumsum(probs) - probs
+        keep = cum_excl < jnp.clip(p, 1e-6, 1.0)
+        thr_p = jnp.min(jnp.where(keep, srt, jnp.inf))
+        thr = jnp.maximum(thr_k, thr_p)
+        masked = jnp.where(scaled >= thr, scaled, -jnp.inf)
+        onehot = jax.nn.one_hot(jnp.argmax(lg), vocab_size,
+                                dtype=jnp.float32)
+        return jnp.where(t <= 0.0, onehot, jax.nn.softmax(masked))
+
+    def propose_one(lg, t, k, p, seed, step):
+        dist = _warped(lg, t, k, p)
+        g = jax.random.gumbel(_key(seed, _DRAFT_STREAM, step), lg.shape)
+        sampled = jnp.argmax(jnp.log(jnp.maximum(dist, 1e-30)) + g)
+        tok = jnp.where(t <= 0.0, jnp.argmax(lg), sampled)
+        return tok.astype(jnp.int32), dist
+
+    def accept_one(tlgs, dtoks, dprobs, t, k, p, seed, step0):
+        # tlgs [n_spec+1, V] target logits; dtoks [n_spec] draft tokens;
+        # dprobs [n_spec, V] warped draft distributions
+        finite = jnp.all(jnp.isfinite(tlgs))
+        targ = jax.vmap(lambda lg: _warped(lg, t, k, p))(tlgs)
+        j = jnp.arange(n_spec)
+        p_t_d = targ[j, dtoks]
+        p_d_d = dprobs[j, dtoks]
+        u = jax.vmap(lambda jj: jax.random.uniform(
+            _key(seed, _ACCEPT_STREAM, step0 + jj)))(j)
+        acc = u < p_t_d / jnp.maximum(p_d_d, 1e-30)
+        a = jnp.sum(jnp.cumprod(acc.astype(jnp.int32)))   # leading accepts
+        dp_full = jnp.concatenate(
+            [dprobs, jnp.zeros((1, vocab_size), jnp.float32)], 0)
+        resid = jnp.maximum(targ - dp_full, 0.0)
+        rs = jnp.sum(resid, -1, keepdims=True)
+        resid = jnp.where(rs > 0, resid / jnp.maximum(rs, 1e-30), targ)
+        jr = jnp.arange(n_spec + 1)
+
+        def draw_row(jj):
+            g = jax.random.gumbel(_key(seed, _RESID_STREAM, step0 + jj),
+                                  (vocab_size,))
+            return jnp.argmax(jnp.log(jnp.maximum(resid[jj], 1e-30)) + g)
+
+        draws = jax.vmap(draw_row)(jr).astype(jnp.int32)
+        dt_full = jnp.concatenate([dtoks, jnp.zeros((1,), jnp.int32)])
+        sampled = jnp.where(jr < a, dt_full,
+                            jnp.where(jr == a, draws, 0))
+        greedy = jnp.where(jr < a, dt_full,
+                           jnp.where(jr == a,
+                                     jnp.argmax(tlgs, -1).astype(jnp.int32),
+                                     0))
+        commit = jnp.where(t <= 0.0, greedy, sampled)
+        return (a + 1).astype(jnp.int32), commit, finite
+
+    def propose(lgs, ts, ks, ps, seeds, steps):
+        return jax.vmap(propose_one)(lgs, ts, ks, ps, seeds, steps)
+
+    def accept(tlgs, dtoks, dprobs, ts, ks, ps, seeds, steps):
+        return jax.vmap(accept_one)(tlgs, dtoks, dprobs, ts, ks, ps,
+                                    seeds, steps)
+
+    return propose, accept
+
+
 class DecodeEngine:
     """``DecodeEngine(lm).load()`` then ``generate(prompt_ids, ...)``.
 
@@ -180,12 +344,48 @@ class DecodeEngine:
                  max_queue: int = 256, admission: str = "block",
                  max_retries: int = 1, default_max_new: int = 32,
                  clock=time.monotonic, tag: str = "v0",
-                 metrics: Optional[DecodeMetrics] = None):
+                 metrics: Optional[DecodeMetrics] = None,
+                 prefix_cache: bool = False, draft_model=None,
+                 speculate_k: int = 4, kv_dtype: Optional[str] = None):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
+        if kv_dtype not in (None, "f32", "float32", "int8", "i8"):
+            raise ValueError(f"kv_dtype {kv_dtype!r} not supported "
+                             "(float32 or int8)")
         self.program = model.decode_program(page_size=page_size,
                                             max_len=max_len)
         prog = self.program
+        self._prefix_on = bool(prefix_cache)
+        if self._prefix_on and prog.prefill_at is None:
+            raise ValueError(
+                "prefix_cache=True needs a decode program with a "
+                "prefill_at entry point (suffix prefill)")
+        self._kv_dtype = kv_dtype
+        self.speculate_k = int(speculate_k)
+        self._draft_program = None
+        self._draft_params = None
+        self._draft_cache = None
+        if draft_model is not None:
+            if self.speculate_k < 1:
+                raise ValueError("speculate_k must be >= 1")
+            if prog.spec_step is None:
+                raise ValueError(
+                    "speculative decoding needs a decode program with a "
+                    "spec_step entry point (multi-token verify)")
+            dprog = draft_model.decode_program(page_size=page_size,
+                                               max_len=prog.max_len)
+            if (dprog.vocab_size != prog.vocab_size
+                    or dprog.max_len != prog.max_len
+                    or dprog.page_size != prog.page_size):
+                raise ValueError(
+                    "draft/target program mismatch: vocab "
+                    f"{dprog.vocab_size}/{prog.vocab_size}, max_len "
+                    f"{dprog.max_len}/{prog.max_len}, page_size "
+                    f"{dprog.page_size}/{prog.page_size} must all agree")
+            self._draft_program = dprog
+            self._draft_params = getattr(draft_model, "params", draft_model)
+        self._prefix_root = _PrefixNode((), None, None)
+        self._trie_pages = 0
         self.max_slots = int(max_slots)
         self.eos_id = eos_id
         self.max_retries = int(max_retries)
@@ -259,7 +459,8 @@ class DecodeEngine:
         params = self._versions[self._serve_tag]
         s_n, pps, v_n = self.max_slots, prog.pages_per_slot, prog.vocab_size
         kp, vp = alloc_cache(prog.n_layers, self.total_pages, prog.page_size,
-                             prog.n_heads, prog.d_head)
+                             prog.n_heads, prog.d_head,
+                             kv_dtype=self._kv_dtype)
         bundle = load_bundle(warm_bundle) if warm_bundle else {}
         hits = misses = 0
 
@@ -296,6 +497,22 @@ class DecodeEngine:
                                  np.zeros((b,), np.int32), np.int32(1))
                 self._compiled[("prefill", b)] = pf
 
+            if self._prefix_on:
+                # suffix prefill per bucket — only prefix-cache HITS use
+                # these, so the cold path's executables (and bits) are
+                # untouched when every request misses
+                pa_jit = jax.jit(prog.prefill_at, donate_argnums=(1, 2))
+                for b in self.prompt_buckets:
+                    pf = _get(f"prefill_at:{b}", lambda b=b: pa_jit.lower(
+                        params, kp, vp, np.zeros((pps,), np.int32),
+                        np.zeros((b,), np.int32), np.int32(1),
+                        np.int32(0)).compile())
+                    kp, vp, lg1 = pf(params, kp, vp,
+                                     np.zeros((pps,), np.int32),
+                                     np.zeros((b,), np.int32), np.int32(1),
+                                     np.int32(0))
+                    self._compiled[("prefill_at", b)] = pf
+
             one, batch = _make_samplers(v_n)
             s1 = _get("sample1", lambda: jax.jit(one).lower(
                 lg1, np.float32(0), np.int32(0), np.float32(1), np.uint32(0),
@@ -316,13 +533,17 @@ class DecodeEngine:
             np.asarray(toks)
             self._compiled[("sample",)] = sb
 
+            from ..ops.kv_cache import scrub_pool
+
             def _reset(k, v):
                 import jax.numpy as jnp
-                return jnp.zeros_like(k), jnp.zeros_like(v)
+                z = jax.tree_util.tree_map(jnp.zeros_like, (k, v))
+                return z[0], z[1]
 
             def _scrub(k, v, ids):
-                # zero the given pages (padded with repeats — idempotent)
-                return k.at[:, ids].set(0.0), v.at[:, ids].set(0.0)
+                # zero the given pages (padded with repeats — idempotent;
+                # int8 pools zero values AND scales)
+                return scrub_pool(k, ids), scrub_pool(v, ids)
 
             reset_c = _get("reset", lambda: jax.jit(
                 _reset, donate_argnums=(0, 1)).lower(kp, vp).compile())
@@ -333,6 +554,9 @@ class DecodeEngine:
                     kp, vp, np.zeros((pps,), np.int32)).compile())
             kp, vp = scrub_c(kp, vp, np.zeros((pps,), np.int32))
             self._compiled[("scrub",)] = scrub_c
+
+            if self._draft_program is not None:
+                kp, vp = self._load_spec(_get, params, kp, vp)
         self.metrics.inc("bundle_hits", hits)
         self.metrics.inc("bundle_misses", misses)
         self.metrics.inc("warmup_seconds_total", self.clock() - t0)
@@ -344,6 +568,111 @@ class DecodeEngine:
             target=self._supervise, name="decode-supervisor", daemon=True)
         self._supervisor.start()
         return self
+
+    def _load_spec(self, _get, params, kp, vp):
+        """Warm the speculative-decoding executables: the draft pool's
+        prefill/step/reset/scrub (draft dims, SAME page table), the
+        target's fixed-[S, k+1] ``spec_step`` verify, and the
+        propose/accept samplers — all AOT, all fixed-shape (k is frozen
+        at construction), so speculation adds zero serve-time compiles.
+        Returns the threaded target pool (spec_step donates it)."""
+        import jax
+
+        from ..ops.kv_cache import alloc_cache, scrub_pool
+
+        prog, dprog = self.program, self._draft_program
+        dparams = self._draft_params
+        s_n, pps, v_n = self.max_slots, prog.pages_per_slot, prog.vocab_size
+        k = self.speculate_k
+        dkp, dvp = alloc_cache(dprog.n_layers, self.total_pages,
+                               dprog.page_size, dprog.n_heads, dprog.d_head,
+                               kv_dtype=self._kv_dtype)
+
+        dp_jit = jax.jit(dprog.prefill, donate_argnums=(1, 2))
+        for b in self.prompt_buckets:
+            pf = _get(f"draft_prefill:{b}", lambda b=b: dp_jit.lower(
+                dparams, dkp, dvp, np.zeros((pps,), np.int32),
+                np.zeros((b,), np.int32), np.int32(1)).compile())
+            dkp, dvp, _ = pf(dparams, dkp, dvp, np.zeros((pps,), np.int32),
+                             np.zeros((b,), np.int32), np.int32(1))
+            self._compiled[("draft_prefill", b)] = pf
+        if self._prefix_on:
+            dpa_jit = jax.jit(dprog.prefill_at, donate_argnums=(1, 2))
+            for b in self.prompt_buckets:
+                pf = _get(f"draft_prefill_at:{b}",
+                          lambda b=b: dpa_jit.lower(
+                              dparams, dkp, dvp, np.zeros((pps,), np.int32),
+                              np.zeros((b,), np.int32), np.int32(1),
+                              np.int32(0)).compile())
+                dkp, dvp, _ = pf(dparams, dkp, dvp,
+                                 np.zeros((pps,), np.int32),
+                                 np.zeros((b,), np.int32), np.int32(1),
+                                 np.int32(0))
+                self._compiled[("draft_prefill_at", b)] = pf
+
+        dstep_c = _get("draft_step", lambda: jax.jit(
+            dprog.step, donate_argnums=(1, 2)).lower(
+                dparams, dkp, dvp, np.zeros((s_n, pps), np.int32),
+                np.zeros((s_n,), np.int32), np.zeros((s_n,), np.int32),
+                np.zeros((s_n,), bool)).compile())
+        dkp, dvp, dlgs = dstep_c(
+            dparams, dkp, dvp, np.zeros((s_n, pps), np.int32),
+            np.zeros((s_n,), np.int32), np.zeros((s_n,), np.int32),
+            np.zeros((s_n,), bool))
+        self._compiled[("draft_step",)] = dstep_c
+
+        spec_c = _get("spec_step", lambda: jax.jit(
+            prog.spec_step, donate_argnums=(1, 2)).lower(
+                params, kp, vp, np.zeros((s_n, pps), np.int32),
+                np.zeros((s_n, k + 1), np.int32), np.zeros((s_n,), np.int32),
+                np.zeros((s_n,), bool)).compile())
+        kp, vp, tlgs = spec_c(
+            params, kp, vp, np.zeros((s_n, pps), np.int32),
+            np.zeros((s_n, k + 1), np.int32), np.zeros((s_n,), np.int32),
+            np.zeros((s_n,), bool))
+        self._compiled[("spec_step",)] = spec_c
+
+        propose, accept = _make_spec_fns(v_n, k)
+        zt = np.zeros((s_n,), np.float32)
+        zk = np.zeros((s_n,), np.int32)
+        zp = np.ones((s_n,), np.float32)
+        zs = np.zeros((s_n,), np.uint32)
+        zj = np.zeros((s_n,), np.int32)
+        prop_c = _get("propose", lambda: jax.jit(propose).lower(
+            dlgs, zt, zk, zp, zs, zj).compile())
+        d_tok, d_probs = prop_c(dlgs, zt, zk, zp, zs, zj)
+        np.asarray(d_tok)
+        self._compiled[("propose",)] = prop_c
+        acc_c = _get("spec_accept", lambda: jax.jit(accept).lower(
+            tlgs, np.zeros((s_n, k), np.int32),
+            np.zeros((s_n, k, v_n), np.float32), zt, zk, zp, zs,
+            zj).compile())
+        nc, cm, fin = acc_c(tlgs, np.zeros((s_n, k), np.int32),
+                            np.zeros((s_n, k, v_n), np.float32),
+                            zt, zk, zp, zs, zj)
+        np.asarray(nc)
+        self._compiled[("spec_accept",)] = acc_c
+
+        def _dreset(dk, dv):
+            import jax.numpy as jnp
+            z = jax.tree_util.tree_map(jnp.zeros_like, (dk, dv))
+            return z[0], z[1]
+
+        def _dscrub(dk, dv, ids):
+            return scrub_pool(dk, ids), scrub_pool(dv, ids)
+
+        dreset_c = _get("draft_reset", lambda: jax.jit(
+            _dreset, donate_argnums=(0, 1)).lower(dkp, dvp).compile())
+        dkp, dvp = dreset_c(dkp, dvp)
+        self._compiled[("draft_reset",)] = dreset_c
+        dscrub_c = _get("draft_scrub", lambda: jax.jit(
+            _dscrub, donate_argnums=(0, 1)).lower(
+                dkp, dvp, np.zeros((pps,), np.int32)).compile())
+        dkp, dvp = dscrub_c(dkp, dvp, np.zeros((pps,), np.int32))
+        self._compiled[("draft_scrub",)] = dscrub_c
+
+        self._draft_cache = (dkp, dvp)
+        return kp, vp
 
     def save_warmup_bundle(self, path: str) -> str:
         """Export every serve-path executable as a warmup bundle
@@ -552,7 +881,10 @@ class DecodeEngine:
                     return
             try:
                 worked = self._admit_some()
-                worked = self._step_once() or worked
+                stepped = (self._spec_step_once()
+                           if self._draft_program is not None
+                           else self._step_once())
+                worked = stepped or worked
             except Exception as e:
                 obs_trace.instant("serve/replica_crash", cat="serve",
                                   kind="decode_step",
@@ -563,10 +895,111 @@ class DecodeEngine:
             if not worked:
                 self.batcher.wait_for_work(0.05)
 
+    # -- radix prefix cache (host-side trie; loop thread + _lock) ----------
+
+    def _iter_trie(self):
+        stack = list(self._prefix_root.children.values())
+        while stack:
+            nd = stack.pop()
+            stack.extend(nd.children.values())
+            yield nd
+
+    def _prefix_lookup(self, prompt: np.ndarray) -> List[_PrefixNode]:
+        """Longest page-aligned prefix match, capped at (n-1)//page_size
+        pages so the suffix prefill always has >= 1 real token (the
+        last prompt token's logits seed the first sample)."""
+        ps = self.program.page_size
+        cap = (int(prompt.shape[0]) - 1) // ps
+        node, nodes = self._prefix_root, []
+        for j in range(cap):
+            child = node.children.get(
+                tuple(int(x) for x in prompt[j * ps:(j + 1) * ps]))
+            if child is None:
+                break
+            nodes.append(child)
+            node = child
+        return nodes
+
+    def _prefix_insert(self, s: _Slot, now: float) -> None:
+        """Move the pages fully covered by ``s``'s prompt (beyond the
+        matched prefix) from the slot's private list into the trie,
+        refcount 1 (held by ``s`` until finish).  Runs on the loop
+        thread right after a FINITE first sampled token, so the trie
+        never holds rows from a poisoned prefill, and before any later
+        admission — a same-prompt request in the same admit batch hits.
+        Pages fully covered by the prompt are never written again (the
+        first generated token lands at position n_prompt), so inserted
+        pages are immutable from this point on."""
+        ps = self.program.page_size
+        prompt = s.spec.prompt
+        node = s.shared_nodes[-1] if s.shared_nodes else self._prefix_root
+        inserted = 0
+        for j in range(s.n_matched, int(prompt.shape[0]) // ps):
+            key = tuple(int(x) for x in prompt[j * ps:(j + 1) * ps])
+            if key in node.children:
+                # the match was suffix-capped below an existing node —
+                # our duplicate page stays private, stop extending
+                break
+            child = _PrefixNode(key, s.page_ids.pop(0), node)
+            child.refs = 1
+            child.last_used = now
+            node.children[key] = child
+            s.shared_nodes.append(child)
+            node = child
+            inserted += 1
+        if inserted:
+            self._trie_pages += inserted
+            self.metrics.inc("prefix_inserts", inserted)
+            self.metrics.shared_pages.set(self._trie_pages)
+
+    def _prefix_evict(self, need: int) -> int:
+        """LRU eviction of refcount-zero LEAF nodes (a refs-0 node's
+        children are refs-0 too, so leaves free first and parents become
+        evictable as their subtree drains).  Evicted pages return to the
+        free list WITHOUT a scrub: trie rows were validated finite at
+        insert, and garbage-but-finite freed pages are the pool-wide
+        convention.  ``last_used`` comes from the injectable engine
+        clock (GC201)."""
+        import heapq
+        heap = [(nd.last_used, nd.page_id, nd) for nd in self._iter_trie()
+                if nd.refs <= 0 and not nd.children]
+        heapq.heapify(heap)
+        freed = 0
+        while heap and freed < need:
+            _, _, nd = heapq.heappop(heap)
+            if nd.children or nd.refs > 0 or nd.detached:
+                continue
+            nd.parent.children.pop(nd.key, None)
+            nd.detached = True
+            self._trie_pages -= 1
+            self._free_pages.append(nd.page_id)
+            freed += 1
+            p = nd.parent
+            if p is not self._prefix_root and p.refs <= 0 and not p.children:
+                heapq.heappush(heap, (p.last_used, p.page_id, p))
+        if freed:
+            self.metrics.inc("prefix_evictions", freed)
+            self.metrics.shared_pages.set(self._trie_pages)
+        return freed
+
+    def _debug_page_state(self) -> dict:
+        """Diagnostic partition of page ids 1..total_pages-1: every page
+        is exactly one of free / slot-private / trie-resident (the
+        accounting invariant the hardening tests assert)."""
+        with self._lock:
+            return {
+                "free": sorted(self._free_pages),
+                "private": sorted(p for s in self._slots if s is not None
+                                  for p in s.page_ids),
+                "trie": sorted(nd.page_id for nd in self._iter_trie()),
+            }
+
     def _admit_some(self) -> bool:
         """Join queued requests to the running batch: allocate pages +
-        a slot, prefill, sample the first token (TTFT).  Stops at the
-        first request the pool cannot hold yet (FIFO order preserved)."""
+        a slot (attaching the longest matching prefix read-only when the
+        prefix cache is on), prefill, sample the first token (TTFT).
+        Stops at the first request the pool cannot hold yet (FIFO order
+        preserved)."""
         from ..ops.kv_cache import pages_for
 
         with self._lock:
@@ -586,21 +1019,52 @@ class DecodeEngine:
             spec = r.payload
             max_total = min(int(spec.prompt.shape[0]) + spec.max_new,
                             prog.max_len)
-            need = pages_for(max_total, prog.page_size)
+            need_total = pages_for(max_total, prog.page_size)
+            t_attach = self.clock()
             with self._lock:
-                if not free or len(self._free_pages) < need:
+                if not free:
+                    leftovers.append(r)
+                    continue
+                matched = (self._prefix_lookup(spec.prompt)
+                           if self._prefix_on else [])
+                m = len(matched)
+                need = need_total - m
+                if len(self._free_pages) < need:
+                    self._prefix_evict(need - len(self._free_pages))
+                if len(self._free_pages) < need:
+                    # no incref has happened yet, so a requeued request
+                    # holds nothing — re-admission matches afresh (the
+                    # no-double-decref-by-construction invariant)
                     leftovers.append(r)
                     continue
                 i = free.pop(0)
+                now = self.clock()
+                for nd in matched:
+                    nd.refs += 1
+                    nd.last_used = now
                 ids = [self._free_pages.popleft() for _ in range(need)]
                 self._page_table[i] = 0
-                self._page_table[i, :need] = ids
+                self._page_table[i, :m] = [nd.page_id for nd in matched]
+                self._page_table[i, m:m + need] = ids
                 slot = _Slot(r, self._serve_tag, ids, spec.max_new)
+                slot.shared_nodes = matched
+                slot.n_matched = m
                 self._slots[i] = slot
                 self.metrics.active_slots.set(
                     sum(1 for s in self._slots if s is not None))
                 self.metrics.pages_in_use.set(
                     self.total_pages - 1 - len(self._free_pages))
+            if self._prefix_on:
+                if m:
+                    self.metrics.inc("prefix_hits")
+                    self.metrics.inc("prefix_hit_tokens",
+                                     m * prog.page_size)
+                else:
+                    self.metrics.inc("prefix_misses")
+                obs_trace.complete_at(
+                    "serve/prefix_attach", t_attach, self.clock(),
+                    cat="serve", slot=i, matched_pages=m,
+                    matched_tokens=m * prog.page_size)
             self.metrics.inc("requests")
             self._prefill_slot(i)
             worked = True
@@ -618,18 +1082,45 @@ class DecodeEngine:
         s = self._slots[i]
         spec = s.spec
         n = s.n_prompt
-        bucket = self._bucket_for(n)
-        padded = np.zeros((bucket,), np.int32)
-        padded[:n] = spec.prompt
+        m = s.n_matched * self.program.page_size   # matched prefix tokens
         t0 = self.clock()
         kp, vp = self._cache
-        kp, vp, lg = self._compiled[("prefill", bucket)](
-            self._versions[s.tag], kp, vp, self._page_table[i], padded,
-            np.int32(n))
+        if m:
+            # prefix-cache hit: prefill ONLY the unmatched suffix; the
+            # shared pages already hold the prefix rows and the suffix
+            # rows attend over them (prefill_at) — same per-row math as
+            # a cold prefill, so the logits are bit-identical
+            suffix = n - m
+            bucket = self._bucket_for(suffix)
+            padded = np.zeros((bucket,), np.int32)
+            padded[:suffix] = spec.prompt[m:]
+            kp, vp, lg = self._compiled[("prefill_at", bucket)](
+                self._versions[s.tag], kp, vp, self._page_table[i], padded,
+                np.int32(suffix), np.int32(m))
+        else:
+            bucket = self._bucket_for(n)
+            padded = np.zeros((bucket,), np.int32)
+            padded[:n] = spec.prompt
+            kp, vp, lg = self._compiled[("prefill", bucket)](
+                self._versions[s.tag], kp, vp, self._page_table[i], padded,
+                np.int32(n))
         tok, fin = self._compiled[("sample1",)](
             lg, np.float32(spec.temperature), np.int32(spec.top_k),
             np.float32(spec.top_p), np.uint32(spec.seed), np.int32(0))
         self._cache = (kp, vp)
+        if self._draft_program is not None:
+            # mirror the prompt into the draft pool (same page ids, the
+            # draft's dims) so proposals start from the right state
+            dkp, dvp = self._draft_cache
+            if m:
+                dkp, dvp, _ = self._compiled[("draft_prefill_at", bucket)](
+                    self._draft_params, dkp, dvp, self._page_table[i],
+                    padded, np.int32(n - m), np.int32(m))
+            else:
+                dkp, dvp, _ = self._compiled[("draft_prefill", bucket)](
+                    self._draft_params, dkp, dvp, self._page_table[i],
+                    padded, np.int32(n))
+            self._draft_cache = (dkp, dvp)
         tok_h = int(np.asarray(tok))
         fin_h = bool(np.asarray(fin))
         lg_h = np.asarray(lg) if spec.echo_logits else None
@@ -639,6 +1130,12 @@ class DecodeEngine:
         self.metrics.inc("prefills")
         self.metrics.ttft.record((t1 - s.req.t_submit) * 1e3)
         s.t_first = t1
+        if self._prefix_on and fin_h:
+            # insert BEFORE recording the token so a same-prompt request
+            # admitted next hits; gated on a finite first sample so a
+            # poisoned prefill's rows never enter the trie
+            with self._lock:
+                self._prefix_insert(s, t1)
         self._record_token(i, tok_h, fin_h, lg_h, t1)
 
     def _step_once(self) -> bool:
@@ -714,6 +1211,129 @@ class DecodeEngine:
                         else None, t1)
         return True
 
+    def _spec_step_once(self) -> bool:
+        """One speculative round per distinct active version tag: k
+        sequential draft steps propose tokens, the target verifies all
+        k+1 rows in ONE fixed-shape ``spec_step`` dispatch
+        (``serve/spec_verify``), and seeded rejection sampling commits
+        1..k+1 tokens per slot.  Rejected rows' K/V garbage is always
+        overwritten before it can be unmasked (the next round's writes
+        start at the new position and cover the old speculative range).
+        After a FULL acceptance the draft pool is one row behind, so a
+        catch-up draft step writes the last proposal's row — without it
+        every fully-accepted round would degrade later proposals."""
+        s_n = self.max_slots
+        k = self.speculate_k
+        with self._lock:
+            tags: List[str] = []
+            for s in self._slots:
+                if s is not None and s.tag not in tags:
+                    tags.append(s.tag)
+            crash = self._crash_next
+            self._crash_next = False
+        if crash:
+            raise ReplicaCrashError("injected decode-batch crash (test hook)")
+        if not tags:
+            return False
+        for tag in tags:
+            toks_in = np.zeros((s_n,), np.int32)
+            pos = np.zeros((s_n,), np.int32)
+            act = np.zeros((s_n,), bool)
+            temps = np.zeros((s_n,), np.float32)
+            tks = np.zeros((s_n,), np.int32)
+            tps = np.ones((s_n,), np.float32)
+            seeds = np.zeros((s_n,), np.uint32)
+            steps = np.zeros((s_n,), np.int32)
+            group: List[int] = []
+            echo = False
+            with self._lock:
+                params = self._versions.get(tag)
+                if params is None:
+                    continue
+                for i, s in enumerate(self._slots):
+                    if s is None or s.tag != tag:
+                        continue
+                    group.append(i)
+                    toks_in[i] = s.last_token
+                    pos[i] = s.pos
+                    act[i] = True
+                    temps[i] = s.spec.temperature
+                    tks[i] = s.spec.top_k
+                    tps[i] = s.spec.top_p
+                    seeds[i] = s.spec.seed
+                    steps[i] = s.n_out
+                    echo = echo or s.logits is not None
+            if not group:
+                continue
+            t0 = self.clock()
+            dkp, dvp = self._draft_cache
+            cur = toks_in
+            d_toks_dev, d_probs_dev = [], []
+            for j in range(k):
+                dkp, dvp, dlgs = self._compiled[("draft_step",)](
+                    self._draft_params, dkp, dvp, self._page_table, cur,
+                    pos + j, act)
+                d_tok, d_prob = self._compiled[("propose",)](
+                    dlgs, temps, tks, tps, seeds, steps + j)
+                d_toks_dev.append(d_tok)
+                d_probs_dev.append(d_prob)
+                cur = d_tok
+            self._draft_cache = (dkp, dvp)
+            d_toks = np.stack([np.asarray(t) for t in d_toks_dev],
+                              1).astype(np.int32)          # [S, k]
+            spec_tokens = np.concatenate([toks_in[:, None], d_toks], 1)
+            kp, vp = self._cache
+            tv0 = self.clock()
+            kp, vp, lgs = self._compiled[("spec_step",)](
+                params, kp, vp, self._page_table, spec_tokens, pos, act)
+            n_commit, commit, fin = self._compiled[("spec_accept",)](
+                lgs, d_toks,
+                np.stack([np.asarray(p) for p in d_probs_dev], 1),
+                temps, tks, tps, seeds, steps)
+            self._cache = (kp, vp)
+            nc_h = np.asarray(n_commit)
+            cm_h = np.asarray(commit)
+            fin_h = np.asarray(fin)
+            lgs_h = np.asarray(lgs) if echo else None
+            t1 = self.clock()
+            obs_trace.complete_at("serve/spec_verify", tv0, t1, cat="serve",
+                                  n_active=len(group), k=k, model=tag)
+            self.metrics.inc("decode_steps")
+            self.metrics.step_time.record((t1 - t0) * 1e3)
+            self.metrics.inc("spec_steps")
+            self.metrics.inc("spec_proposed", k * len(group))
+            committed = 0
+            catchup = np.zeros((s_n,), bool)
+            cu_tok = np.zeros((s_n,), np.int32)
+            for i in group:
+                c = int(nc_h[i])
+                self.metrics.inc("spec_accepted", c - 1)
+                for j in range(c):
+                    with self._lock:
+                        s = self._slots[i]
+                    if s is None:      # stopped mid-commit (eos/max/...)
+                        break
+                    s.pos += 1
+                    committed += 1
+                    self._record_token(
+                        i, int(cm_h[i, j]), bool(fin_h[i]),
+                        lgs_h[i, j].copy() if (lgs_h is not None
+                                               and s.logits is not None)
+                        else None, t1)
+                with self._lock:
+                    alive = self._slots[i] is not None
+                if alive and c == k + 1:
+                    catchup[i] = True
+                    cu_tok[i] = d_toks[i, k - 1]
+            self.metrics.inc("spec_committed", committed)
+            if catchup.any():
+                dkp, dvp = self._draft_cache
+                dkp, dvp, _ = self._compiled[("draft_step",)](
+                    self._draft_params, dkp, dvp, self._page_table, cu_tok,
+                    pos + k, catchup)
+                self._draft_cache = (dkp, dvp)
+        return True
+
     # -- per-token bookkeeping + stop conditions ---------------------------
 
     def _record_token(self, i: int, token: int, finite: bool,
@@ -747,12 +1367,20 @@ class DecodeEngine:
 
     def _scrub_pages(self, page_ids: List[int]) -> None:
         """Zero freed pages that may hold non-finite rows — a NaN left
-        behind would poison the page's next tenant (0 * NaN = NaN)."""
+        behind would poison the page's next tenant (0 * NaN = NaN).
+        Only ever called with a slot's PRIVATE pages: shared prefix
+        pages are read-only to their holders and validated finite at
+        insert, so a scrub can never hit a page another request still
+        references — the no-scrub-while-shared discipline."""
         pps = self.program.pages_per_slot
         ids = np.full((pps,), page_ids[0], np.int32)
         ids[:len(page_ids)] = page_ids
         kp, vp = self._cache
         self._cache = self._compiled[("scrub",)](kp, vp, ids)
+        if self._draft_program is not None:
+            dkp, dvp = self._draft_cache
+            self._draft_cache = self._compiled[("draft_scrub",)](
+                dkp, dvp, ids)
 
     def _finish(self, i: int, now: float, reason: Optional[str] = None,
                 error: Optional[BaseException] = None) -> None:
@@ -762,6 +1390,12 @@ class DecodeEngine:
                 return
             self._slots[i] = None
             self._free_pages.extend(s.page_ids)
+            for nd in reversed(s.shared_nodes):
+                # decref, never free: trie pages stay resident for the
+                # next shared-prefix request until LRU eviction
+                nd.refs -= 1
+                nd.last_used = now
+            s.shared_nodes = []
             self._page_table[i] = 0
             live_tags = {sl.tag for sl in self._slots if sl is not None}
             live_tags.add(self._serve_tag)
@@ -804,11 +1438,21 @@ class DecodeEngine:
             self._slots = [None] * self.max_slots
             self._free_pages = deque(range(1, self.total_pages))
             self._page_table[:] = 0
+            # the prefix trie dies with the pool: slots are wiped WITHOUT
+            # decref and the trie is rebuilt empty, so a retried
+            # prefix-hit request re-matches from scratch — a crash-retry
+            # can never double-decref a shared page
+            self._prefix_root = _PrefixNode((), None, None)
+            self._trie_pages = 0
+            self.metrics.shared_pages.set(0)
             self.metrics.active_slots.set(0)
             self.metrics.pages_in_use.set(0)
         # the crash may have left non-finite rows anywhere — zero the pool
         kp, vp = self._cache
         self._cache = self._compiled[("reset",)](kp, vp)
+        if self._draft_program is not None:
+            dkp, dvp = self._draft_cache
+            self._draft_cache = self._compiled[("draft_reset",)](dkp, dvp)
         now = self.clock()
         for s in in_flight:
             r = s.req
@@ -837,6 +1481,10 @@ class DecodeEngine:
         snap["prompt_buckets"] = list(self.prompt_buckets)
         snap["max_slots"] = self.max_slots
         snap["total_pages"] = self.total_pages
+        snap["prefix_cache"] = self._prefix_on
+        snap["speculate_k"] = (self.speculate_k
+                               if self._draft_program is not None else 0)
+        snap["kv_dtype"] = self._kv_dtype or "float32"
         return snap
 
     def health_snapshot(self) -> dict:

@@ -237,7 +237,9 @@ class FleetMetrics:
 
 # every counter a fresh decode engine reports as zero (docs/SERVING.md
 # decode section: throughput set, then stop conditions, then resilience,
-# then the cold-start set)
+# then the cold-start set, then the decode-speed set — prefix cache and
+# speculation counters stay registered-at-zero when the features are off
+# so dashboards never see a key appear mid-flight)
 _DECODE_COUNTER_KEYS = (
     "requests", "tokens_out", "prefills", "decode_steps",
     "eos_stops", "max_token_stops", "deadline_stops",
@@ -245,6 +247,9 @@ _DECODE_COUNTER_KEYS = (
     "poison_isolated", "replica_crashes", "replica_respawns", "swaps",
     "warmup_seconds_total", "bundle_hits", "bundle_misses",
     "scale_ups", "scale_downs",
+    "prefix_hits", "prefix_misses", "prefix_inserts",
+    "prefix_evictions", "prefix_hit_tokens",
+    "spec_steps", "spec_proposed", "spec_accepted", "spec_committed",
 )
 
 
@@ -274,6 +279,8 @@ class DecodeMetrics:
         self.active_slots.set(0)
         self.pages_in_use = self.registry.gauge("pages_in_use")
         self.pages_in_use.set(0)
+        self.shared_pages = self.registry.gauge("shared_pages")
+        self.shared_pages.set(0)
         self._t0 = time.monotonic()
         self.global_name = get_registry().register_collector(
             "decode", self.snapshot, unique=True)
@@ -303,6 +310,10 @@ class DecodeMetrics:
             "counters": c,
             "active_slots": int(self.active_slots.value()),
             "pages_in_use": int(self.pages_in_use.value()),
+            "shared_pages": int(self.shared_pages.value()),
+            "accepted_tokens_per_step": round(
+                c["spec_committed"] / c["spec_steps"], 4)
+            if c.get("spec_steps") else None,
             "tokens_per_sec": round(c["tokens_out"] / elapsed, 2)
             if elapsed > 0 else None,
             "uptime_sec": round(elapsed, 3),

@@ -54,7 +54,11 @@ class UIServer:
         {"prompt_ids": [...], "max_tokens": ..., "temperature": ...,
         "top_k": ..., "top_p": ..., "seed": ...} → {"tokens": [...]})
         through a serving DecodeEngine, and export its TTFT/TPOT
-        histograms on /metrics."""
+        histograms on /metrics — including the decode-speed counters
+        (prefix_hits/misses/inserts/evictions, spec_steps/accepted/
+        committed, shared_pages, accepted_tokens_per_step), which are
+        present at zero when prefix caching / speculation are off so
+        dashboards never see keys appear mid-flight."""
         self._decode_engine = engine
         return self.attach_metrics(engine.metrics_snapshot)
 

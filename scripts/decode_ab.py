@@ -34,6 +34,27 @@ Speed gates (TPU only, reported everywhere):
   - ttft_ok: engine p99 TTFT <= baseline p99 TTFT.
 
 Last stdout line is the JSON result (the bench subprocess contract).
+
+``--speed-suite`` runs the decode-side optimization A/B instead (three
+gated arms over the same tiny model):
+
+  1. PREFIX — radix prefix cache: shared-prefix requests must show a
+     p50 TTFT strictly below equal-length cold prompts (suffix-only
+     prefill runs a smaller bucket, so the gate holds on every
+     platform), hit/hit-token counters must advance, and a prefix-hit
+     request's echoed logits stay BITWISE equal to the re-encode
+     oracle.
+  2. SPEC — speculative decoding: a self-draft control must accept
+     ~k+1 tokens/step (structural sanity of the acceptance rule); an
+     independent tiny draft at temperature 0 must produce BITWISE
+     identical tokens+logits to the plain engine with accepted
+     tokens/step >= 1.0; a crash injected mid-speculative-round must
+     strand nothing and retries must reproduce the plain tokens.
+  3. INT8 — int8 KV storage: an accuracy envelope (top-1 agreement of
+     int8-decoded tokens against the f32 re-encode oracle >= 0.80 —
+     int8 changes bits, so it is never held to the identity gates) and
+     an analytic sessions-at-fixed-HBM ratio (f32 pool bytes / int8
+     pool bytes >= 2.0).
 """
 
 from __future__ import annotations
@@ -189,14 +210,209 @@ def run_arm(submit, n_requests: int, interarrival_s: float, prompts,
     }
 
 
+def speed_suite(args) -> int:
+    """The ``--speed-suite`` arms: prefix cache, speculative decoding,
+    int8 KV storage — each independently gated (module docstring)."""
+    import jax
+
+    from deeplearning4j_tpu.ops.kv_cache import pool_nbytes
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+    from deeplearning4j_tpu.parallel.transformer import ShardedTransformerLM
+    from deeplearning4j_tpu.serving import DecodeEngine
+
+    platform = jax.devices()[0].platform
+    n_ttft = 8 if args.quick else 16
+    max_new = args.max_new
+    k = 3
+    buckets = (16, 128)   # two buckets cap warmup compiles; the hit
+    # arm's 8-token suffix prefills at 16 while equal-length cold
+    # prompts pay the full 128 bucket — the structural TTFT win.
+    mesh = build_mesh({"data": 1, "model": 1, "seq": 1, "pipe": 1},
+                      devices=jax.devices()[:1])
+    lm = ShardedTransformerLM(vocab_size=64, n_layers=2, d_model=64,
+                              n_heads=4, max_len=256, mesh=mesh, seed=7)
+
+    def make_engine(**kw):
+        return DecodeEngine(lm, max_slots=args.max_slots, page_size=8,
+                            default_max_new=max_new, max_queue=100_000,
+                            admission="block", prompt_buckets=buckets,
+                            **kw).load()
+
+    plain = make_engine()
+    prog = plain.program
+    re1 = jax.jit(prog.reencode).lower(
+        lm.params, np.zeros((1, prog.max_len), np.int32)).compile()
+
+    def oracle_rows(prompt, toks):
+        seq = np.zeros((1, prog.max_len), np.int32)
+        full = [int(x) for x in prompt] + [int(t) for t in toks]
+        seq[0, :len(full)] = full
+        return np.asarray(re1(lm.params, seq))[0]
+
+    def bits_match(prompt, res) -> bool:
+        ref = oracle_rows(prompt, res.tokens)
+        return all(np.array_equal(ref[len(prompt) + j - 1], res.logits[j])
+                   for j in range(len(res.tokens)))
+
+    rng = np.random.default_rng(0)
+
+    def gen(eng, prompt, **kw):
+        return eng.generate(prompt, max_new_tokens=max_new,
+                            temperature=0.0, **kw)
+
+    # ---- arm 1: radix prefix cache -----------------------------------
+    print("speed_suite: arm 1/3 prefix cache", file=sys.stderr)
+    pref = make_engine(prefix_cache=True)
+    ccs = {"plain": plain.compile_cache_size(),
+           "pref": pref.compile_cache_size()}
+    for _ in range(2):   # absorb first-dispatch jitter before timing
+        gen(pref, rng.integers(0, 64, size=128).astype(np.int32))
+    cold_ttfts = []
+    for _ in range(n_ttft):   # unique prefixes: the miss path
+        res = gen(pref, rng.integers(0, 64, size=128).astype(np.int32))
+        cold_ttfts.append(res.ttft_ms)
+    shared = rng.integers(0, 64, size=120).astype(np.int32)
+    sfx = [rng.integers(0, 64, size=8).astype(np.int32)
+           for _ in range(n_ttft + 1)]
+    gen(pref, np.concatenate([shared, sfx[0]]))   # seeds the trie
+    hits0 = pref.metrics_snapshot()["counters"]["prefix_hits"]
+    hit_ttfts: List[float] = []
+    p_bits = p_tokens = True
+    for s in sfx[1:]:   # same 128-token length as the cold arm
+        prompt = np.concatenate([shared, s])
+        res = gen(pref, prompt, echo_logits=True)
+        hit_ttfts.append(res.ttft_ms)
+        p_bits = p_bits and bits_match(prompt, res)
+        p_tokens = p_tokens and res.tokens == gen(plain, prompt).tokens
+    snap_p = pref.metrics_snapshot()
+    cp = snap_p["counters"]
+    prefix_zero = pref.compile_cache_size() == ccs["pref"]
+    pref.shutdown()
+    cold_p50 = _percentile(cold_ttfts, 0.50)
+    hit_p50 = _percentile(hit_ttfts, 0.50)
+    prefix = {
+        "ttft_cold_p50_ms": cold_p50, "ttft_hit_p50_ms": hit_p50,
+        "ttft_hit_over_cold": round(hit_p50 / max(cold_p50, 1e-9), 4),
+        "hits": cp["prefix_hits"], "hit_tokens": cp["prefix_hit_tokens"],
+        "inserts": cp["prefix_inserts"],
+        "evictions": cp["prefix_evictions"],
+        "shared_pages": snap_p["shared_pages"],
+        "bit_identical": p_bits, "tokens_match": p_tokens,
+        "zero_compiles": prefix_zero,
+        "ok": (hit_p50 < cold_p50 and p_bits and p_tokens and prefix_zero
+               and cp["prefix_hits"] - hits0 >= n_ttft
+               and cp["prefix_hit_tokens"] > 0),
+    }
+
+    # ---- arm 2: speculative decoding ---------------------------------
+    print("speed_suite: arm 2/3 speculative decoding", file=sys.stderr)
+    prompts = [rng.integers(0, 64, size=n).astype(np.int32)
+               for n in (9, 14, 20)]
+    eng_self = make_engine(draft_model=lm, speculate_k=k)
+    for p in prompts:   # self-draft: every proposal must be accepted
+        gen(eng_self, p)
+    self_aps = eng_self.metrics_snapshot()["accepted_tokens_per_step"]
+    eng_self.shutdown()
+
+    draft = ShardedTransformerLM(vocab_size=64, n_layers=1, d_model=32,
+                                 n_heads=2, max_len=256, mesh=mesh,
+                                 seed=11)
+    spec = make_engine(draft_model=draft, speculate_k=k)
+    ccs["spec"] = spec.compile_cache_size()
+    s_bits = s_tokens = True
+    plain_toks = {}
+    for p in prompts:
+        res = gen(spec, p, echo_logits=True)
+        s_bits = s_bits and bits_match(p, res)
+        plain_toks[p.tobytes()] = gen(plain, p).tokens
+        s_tokens = s_tokens and res.tokens == plain_toks[p.tobytes()]
+    aps = spec.metrics_snapshot()["accepted_tokens_per_step"]
+    crash_futs = [spec.generate_async(prompts[i % len(prompts)],
+                                      max_new_tokens=max_new,
+                                      temperature=0.0)
+                  for i in range(2 * args.max_slots)]
+    spec._crash_next = True
+    stranded = 0
+    retry_match = True
+    for i, fut in enumerate(crash_futs):
+        try:
+            res = fut.result(timeout=180)
+            retry_match = (retry_match and res.tokens
+                           == plain_toks[prompts[i % len(prompts)]
+                                         .tobytes()])
+        except Exception:
+            retry_match = False   # greedy retries must all succeed
+        if not fut.done():
+            stranded += 1
+    snap_s = spec.metrics_snapshot()
+    spec_zero = spec.compile_cache_size() == ccs["spec"]
+    spec.shutdown()
+    spec_arm = {
+        "k": k, "self_draft_accept_per_step": self_aps,
+        "accept_per_step": aps,
+        "bit_identical": s_bits, "tokens_match": s_tokens,
+        "stranded": stranded, "retry_match": retry_match,
+        "crash_retries": snap_s["counters"]["retries"],
+        "zero_compiles": spec_zero,
+        "ok": (s_bits and s_tokens and spec_zero and stranded == 0
+               and retry_match and aps is not None and aps >= 1.0
+               and self_aps is not None and self_aps >= float(k)),
+    }
+
+    # ---- arm 3: int8 KV storage --------------------------------------
+    print("speed_suite: arm 3/3 int8 KV storage", file=sys.stderr)
+    i8 = make_engine(kv_dtype="int8")
+    ccs["i8"] = i8.compile_cache_size()
+    agree = total = 0
+    for p in prompts + [rng.integers(0, 64, size=30).astype(np.int32)]:
+        res = gen(i8, p)
+        ref = oracle_rows(p, res.tokens)
+        for j, t in enumerate(res.tokens):
+            agree += int(int(np.argmax(ref[len(p) + j - 1])) == t)
+            total += 1
+    top1 = agree / max(total, 1)
+    bytes_f32 = (pool_nbytes(plain._cache[0])
+                 + pool_nbytes(plain._cache[1]))
+    bytes_i8 = pool_nbytes(i8._cache[0]) + pool_nbytes(i8._cache[1])
+    i8_zero = i8.compile_cache_size() == ccs["i8"]
+    i8.shutdown()
+    plain_zero = plain.compile_cache_size() == ccs["plain"]
+    plain.shutdown()
+    ratio = bytes_f32 / max(bytes_i8, 1)
+    int8_arm = {
+        "top1_agree": round(top1, 4), "tokens_scored": total,
+        "pool_bytes_f32": bytes_f32, "pool_bytes_int8": bytes_i8,
+        "sessions_at_fixed_hbm": round(ratio, 4),
+        "zero_compiles": i8_zero,
+        "ok": top1 >= 0.80 and ratio >= 2.0 and i8_zero,
+    }
+
+    result = {
+        "suite": "decode_speed", "platform": platform,
+        "quick": args.quick, "max_new": max_new, "n_ttft": n_ttft,
+        "prefix": prefix, "spec": spec_arm, "int8": int8_arm,
+        "plain_zero_compiles": plain_zero,
+        "ok": (prefix["ok"] and spec_arm["ok"] and int8_arm["ok"]
+               and plain_zero),
+    }
+    print(json.dumps(result))
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--speed-suite", action="store_true",
+                    help="run the prefix/speculative/int8 arms instead "
+                    "of the static-batch baseline A/B")
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--interarrival-ms", type=float, default=4.0)
     ap.add_argument("--max-slots", type=int, default=4)
     ap.add_argument("--max-new", type=int, default=12)
     args = ap.parse_args()
+
+    if args.speed_suite:
+        return speed_suite(args)
 
     import jax
 
