@@ -80,6 +80,15 @@ stderr, including:
     after promote/rollback, bounded post-fault p99 and shed rate, and
     chaos-off bit-identity with a single-host engine
     (docs/SERVING.md "Fleet serving")
+  - disagg_decode_ab: the disaggregated prefill/decode gate
+    (scripts/fleet_load_soak.py --disagg) — unified vs prefill-host ->
+    KV-page-handoff -> decode-host vs tensor-parallel decode arms,
+    hard-gated on temp-0 bit-identity across all three, decode-host
+    TPOT p99 <= 1.2x calm through a prompt burst that degrades the
+    unified arm, zero serve-time compiles on the decode host, and
+    exactly-once same-tokens delivery with clean page accounting
+    through a prefill-host kill (docs/SERVING.md "Disaggregated and
+    sharded decode")
   - decode_tokens_per_sec: the autoregressive-decode A/B gate
     (scripts/decode_ab.py) — static-batch full-re-encode decoding vs
     serving.DecodeEngine (paged KV-cache, bucketed prefill/decode split,
@@ -1208,6 +1217,79 @@ def bench_fleet_load():
             "wall_seconds": soak["wall_seconds"]}
 
 
+def bench_disagg_decode():
+    """Config 24: disaggregated prefill/decode A/B
+    (scripts/fleet_load_soak.py --disagg; CPU subprocess — the
+    role-split routing and KV-page handoff under test are host-side).
+    Three arms.  Identity: temp-0 outputs of a prefill-host -> KV-page
+    handoff -> decode-host pipeline AND a tensor-parallel sharded
+    decode engine are BIT-IDENTICAL to a unified single-host engine,
+    with the TP arm's KV pool holding 1/n of the pages per device.
+    Burst: a wall of long-prompt prefill requests degrades a unified
+    host's inter-token latency beyond 1.2x calm (prefill and step
+    share the loop) while the disaggregated decode host's TPOT p99
+    stays within 1.2x of calm AND serves zero new compiles.  Chaos: a
+    prefill host is killed mid-run; every future resolves exactly once
+    with the SAME tokens (seeded re-prefill elsewhere) and the decode
+    host's page accounting stays a clean free/private/trie partition.
+    The reported value is the disagg decode host's burst-phase TPOT
+    p99."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    script = os.path.join(_REPO, "scripts", "fleet_load_soak.py")
+    cmd = [sys.executable, script, "--disagg"] + \
+        (["--quick"] if QUICK else [])
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=1800, cwd=_REPO)
+    if p.returncode != 0:
+        raise RuntimeError(f"disagg soak failed (rc={p.returncode}): "
+                           f"{p.stdout[-500:]} {p.stderr[-1000:]}")
+    soak = json.loads(p.stdout.strip().splitlines()[-1])
+    if not soak.get("identity_disagg_bitwise"):
+        raise RuntimeError("disaggregated decode is no longer bit-"
+                           f"identical to the unified engine: {soak}")
+    if not soak.get("identity_tp_bitwise"):
+        raise RuntimeError("tensor-parallel decode is no longer bit-"
+                           f"identical to the unified engine: {soak}")
+    if not soak.get("unified_degraded"):
+        raise RuntimeError("burst arm no longer degrades the unified "
+                           f"host (A/B baseline lost): {soak}")
+    if not soak.get("disagg_tpot_ok"):
+        raise RuntimeError("disagg decode TPOT p99 gate FAILED under "
+                           f"the prefill burst: {soak}")
+    if not soak.get("decode_zero_compiles"):
+        raise RuntimeError("decode host compiled at serve time during "
+                           f"the burst: {soak}")
+    if (soak.get("chaos_disagg_stranded") != 0
+            or soak.get("chaos_disagg_double_delivered") != 0):
+        raise RuntimeError("prefill-host kill stranded/double-"
+                           f"delivered futures: {soak}")
+    if not soak.get("chaos_disagg_tokens_ok"):
+        raise RuntimeError("prefill-host kill retries changed tokens "
+                           f"(seeded determinism lost): {soak}")
+    if not soak.get("chaos_disagg_partition_ok"):
+        raise RuntimeError("decode host page accounting corrupt after "
+                           f"prefill-host kill: {soak}")
+    if not soak.get("disagg_ok"):
+        raise RuntimeError(f"disagg A/B gate FAILED: {soak}")
+    return {"metric": "disagg_decode_ab",
+            "value": soak["disagg_tpot_burst_p99_ms"], "unit": "ms tpot p99",
+            "platform": soak["platform"],
+            "identity_requests": soak["identity_requests"],
+            "identity_page_transfers": soak["identity_page_transfers"],
+            "identity_tp_shard_frac": soak["identity_tp_shard_frac"],
+            "unified_tpot_calm_p99_ms": soak["unified_tpot_calm_p99_ms"],
+            "unified_tpot_burst_p99_ms": soak["unified_tpot_burst_p99_ms"],
+            "disagg_tpot_calm_p99_ms": soak["disagg_tpot_calm_p99_ms"],
+            "chaos_disagg_requests": soak["chaos_disagg_requests"],
+            "chaos_disagg_retries": soak["chaos_disagg_retries"],
+            "identity_bitwise": True, "stranded": 0,
+            "double_delivered": 0, "decode_zero_compiles": True}
+
+
 def bench_chaos_recovery():
     """Config 11: chaos-tested fault recovery (scripts/chaos_soak.py; the
     subprocess mechanism, CPU — fault injection needs no accelerator).  A
@@ -1835,7 +1917,8 @@ def main() -> None:
                      ("quantized_serving_ab", bench_quantized_serving_ab),
                      ("continuous_batching_ab", bench_continuous_batching),
                      ("cold_start_ab", bench_cold_start),
-                     ("decode_speed_ab", bench_decode_speed)]:
+                     ("decode_speed_ab", bench_decode_speed),
+                     ("disagg_decode_ab", bench_disagg_decode)]:
         try:
             t0 = time.perf_counter()
             out = fn()

@@ -559,6 +559,8 @@ def cmd_serve(args) -> int:
         print(f"compile cache: {cache_dir}")
     if not args.fleet and not args.model:
         raise SystemExit("serve needs --model (or --fleet HOST:PORT,...)")
+    net = None
+    is_lm = False
     if args.fleet:
         if args.smoke:
             raise SystemExit("serve --smoke is incompatible with --fleet")
@@ -574,26 +576,40 @@ def cmd_serve(args) -> int:
               f"max_retries={args.max_retries}, "
               f"request_timeout={args.forward_timeout}")
     else:
-        reg = ModelRegistry()
-        name = args.name
-        version = reg.load(name, args.model, version=args.version)
-        reg.set_alias(name, "prod", version)
-        engine = Engine.from_registry(
-            reg, name, "prod", max_batch=args.max_batch, slo_ms=args.slo_ms,
-            replicas=args.replicas, max_queue=args.queue_cap,
-            admission=args.admission,
-            forward_timeout_s=args.forward_timeout,
-            max_retries=args.max_retries,
-            breaker_threshold=args.breaker_threshold)
-        # an explicit --warm-bundle wins; otherwise the registry's
-        # checkpoint provenance finds `<checkpoint>.warm` automatically
-        engine.load(warm_bundle=getattr(args, "warm_bundle", None))
-        print(f"serving {name} v{version} (alias 'prod'): "
-              f"max_batch={args.max_batch}, slo={args.slo_ms}ms, "
-              f"replicas={len(engine._replicas)}, "
-              f"admission={args.admission}, "
-              f"warmed buckets {engine.batcher.buckets}")
+        from .models.transformer import TransformerBlock
+        net = _load_model(args.model)
+        is_lm = any(isinstance(l, TransformerBlock) for l in net.conf.layers)
+        if is_lm:
+            # a transformer LM has no float /predict surface (the predict
+            # engine's warmup batches are float feature rows) — serve it
+            # decode-only: POST /generate below, /predict answers 503
+            engine = None
+        else:
+            reg = ModelRegistry()
+            name = args.name
+            version = reg.load(name, args.model, version=args.version)
+            reg.set_alias(name, "prod", version)
+            engine = Engine.from_registry(
+                reg, name, "prod", max_batch=args.max_batch,
+                slo_ms=args.slo_ms,
+                replicas=args.replicas, max_queue=args.queue_cap,
+                admission=args.admission,
+                forward_timeout_s=args.forward_timeout,
+                max_retries=args.max_retries,
+                breaker_threshold=args.breaker_threshold)
+            # an explicit --warm-bundle wins; otherwise the registry's
+            # checkpoint provenance finds `<checkpoint>.warm` automatically
+            engine.load(warm_bundle=getattr(args, "warm_bundle", None))
+            print(f"serving {name} v{version} (alias 'prod'): "
+                  f"max_batch={args.max_batch}, slo={args.slo_ms}ms, "
+                  f"replicas={len(engine._replicas)}, "
+                  f"admission={args.admission}, "
+                  f"warmed buckets {engine.batcher.buckets}")
     if args.smoke:
+        if engine is None:
+            raise SystemExit("serve --smoke needs a predict checkpoint "
+                             "(float feature inputs), not a transformer "
+                             "LM — use POST /generate instead")
         shape = engine._example_shape
         rng = np.random.default_rng(0)
         futs = [engine.output_async(
@@ -612,10 +628,15 @@ def cmd_serve(args) -> int:
     port = args.port
     if port == 9000 and os.environ.get(ENV_SERVE_PORT):
         port = int(os.environ[ENV_SERVE_PORT])
-    server = UIServer(port=port, host=args.host).attach_engine(engine)
+    server = UIServer(port=port, host=args.host)
+    if engine is not None:
+        server.attach_engine(engine)
     decode_eng = None
-    wants_decode = (getattr(args, "prefix_cache", False)
+    wants_decode = (is_lm
+                    or getattr(args, "prefix_cache", False)
                     or getattr(args, "speculate", None)
+                    or getattr(args, "decode_role", "unified")
+                    not in (None, "unified")
                     or getattr(args, "kv_dtype", None)
                     not in (None, "float32"))
     if wants_decode:
@@ -628,7 +649,8 @@ def cmd_serve(args) -> int:
         from .models.transformer import (TransformerBlock,
                                          TransformerDecodeAdapter)
         from .serving import DecodeEngine
-        net = _load_model(args.model)
+        if net is None:
+            net = _load_model(args.model)
         if not any(isinstance(l, TransformerBlock)
                    for l in net.conf.layers):
             raise SystemExit("--prefix-cache/--speculate/--kv-dtype need "
@@ -638,6 +660,7 @@ def cmd_serve(args) -> int:
                                   **opts).load()
         server.attach_decode_engine(decode_eng)
         print(f"decode engine on POST /generate: "
+              f"role={opts['role']}, "
               f"prefix_cache={opts['prefix_cache']}, "
               f"speculate_k={opts['speculate_k'] if opts['draft_model'] is not None else 0}, "
               f"kv_dtype={opts['kv_dtype'] or 'float32'}")
@@ -654,16 +677,20 @@ def cmd_serve(args) -> int:
         preempted = True
         # graceful drain: shed new admissions, let in-flight requests
         # finish inside the grace window, then hand the port back
-        engine.begin_drain()
+        for e in (engine, decode_eng):
+            if e is not None:
+                e.begin_drain()
         print(f"serve: preemption notice — draining "
               f"({handler.remaining_s:.1f}s grace)", flush=True)
-        while _serve_queue_depth(engine) > 0 and handler.remaining_s > 0.5:
+        drain_of = engine if engine is not None else decode_eng
+        while _serve_queue_depth(drain_of) > 0 and handler.remaining_s > 0.5:
             time.sleep(0.05)
     except KeyboardInterrupt:
         pass
     finally:
         server.stop()
-        engine.shutdown()
+        if engine is not None:
+            engine.shutdown()
         if decode_eng is not None:
             decode_eng.shutdown()
         if heartbeat is not None:
@@ -725,6 +752,7 @@ def _decode_opts(args) -> dict:
         "draft_model": draft,
         "speculate_k": k,
         "kv_dtype": None if kv in (None, "float32") else kv,
+        "role": getattr(args, "decode_role", None) or "unified",
     }
 
 
@@ -1215,6 +1243,14 @@ def build_parser() -> argparse.ArgumentParser:
                    "per-row-quantized pages + f32 scales (~4x "
                    "sessions at fixed HBM; changes bits — gated by "
                    "a top1-agree envelope, not the identity gates)")
+    v.add_argument("--decode-role", choices=("unified", "prefill", "decode"),
+                   default="unified",
+                   help="disaggregated serving role for the decode "
+                   "engine: 'prefill' hosts run prompt prefill and "
+                   "export KV pages as handoffs, 'decode' hosts attach "
+                   "handoffs and stream tokens; a FleetRouter routes "
+                   "the two stages (docs/SERVING.md 'Disaggregated "
+                   "and sharded decode')")
     v.set_defaults(fn=cmd_serve)
 
     g = sub.add_parser(

@@ -94,12 +94,21 @@ def block_kv_project(p: Dict[str, Array], h: Array,
             split_heads(u @ p["Wv"], n_heads))
 
 
-def block_finish(p: Dict[str, Array], h: Array, att_heads: Array) -> Array:
+def block_finish(p: Dict[str, Array], h: Array, att_heads: Array, *,
+                 psum_axis: Optional[str] = None) -> Array:
     """Second half of the pre-LN block: output projection + residual +
-    FFN.  Same math as the tail of ``block_apply`` (psum-free single-
-    device form); the decode prefill/step/re-encode paths all share it
-    so their per-position bits agree by construction."""
-    h = h + (merge_heads(att_heads) @ p["Wo"] + p["bo"])
+    FFN.  Same math as the tail of ``block_apply``; the decode
+    prefill/step/re-encode paths all share it so their per-position
+    bits agree by construction.  ``psum_axis``: the tensor-parallel
+    decode path (parallel/transformer.py) passes ``att_heads`` holding
+    only the LOCAL head group and a row-slice of ``Wo`` in ``p`` — the
+    partial output projections psum over that axis before bias +
+    residual.  Every shard runs the identical psum, so the per-shard
+    decode-vs-reencode bit contract holds layout-for-layout."""
+    m = merge_heads(att_heads) @ p["Wo"]
+    if psum_axis is not None:
+        m = jax.lax.psum(m, psum_axis)
+    h = h + (m + p["bo"])
     u = layer_norm(h, p["ln2_g"], p["ln2_b"])
     f = jax.nn.gelu(u @ p["W1"] + p["b1"])
     return h + f @ p["W2"] + p["b2"]

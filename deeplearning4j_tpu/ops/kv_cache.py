@@ -44,6 +44,7 @@ from typing import Any, Callable, NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .attention import _NEG_INF
 
@@ -225,6 +226,123 @@ def scrub_pool(pages: KVPool, ids: Array) -> KVPool:
     return jax.tree_util.tree_map(lambda a: a.at[:, ids].set(0), pages)
 
 
+# -- page transfer (disaggregated prefill/decode) --------------------------
+
+
+def gather_pages(pages: KVPool, ids: Array) -> KVPool:
+    """Extract page ids as a dense payload [n_layers, len(ids), ...] —
+    the device half of a prefill→decode page transfer.  Tree-aware like
+    ``scrub_pool`` (int8 pools carry values AND scales), so the payload
+    is bit-exact: f32 rows copy verbatim, int8 rows copy q and scale
+    verbatim (dequantization happens only at attention time on the
+    receiving host, same as locally).  Duplicate ids are harmless — the
+    fixed-shape extract executable pads with repeats."""
+    return jax.tree_util.tree_map(lambda a: a[:, ids], pages)
+
+
+def set_pages(pages: KVPool, ids: Array, payload: KVPool) -> KVPool:
+    """Scatter a gathered payload back at (generally DIFFERENT) page
+    ids — the attach half of a transfer after page-table remap.  Padding
+    and prefix-deduped entries must point at the scratch page with
+    all-zero payload rows: scratch is never read unmasked, so which
+    duplicate scatter wins there is immaterial."""
+    return jax.tree_util.tree_map(
+        lambda a, p: a.at[:, ids].set(p), pages, payload)
+
+
+class PageTransfer(NamedTuple):
+    """One request's extracted KV pages as a host-side transfer unit.
+
+    ``n_pages`` real pages (payload rows beyond it, if any, are
+    padding); ``k`` / ``v`` are numpy payloads shaped
+    [n_layers, n_pages, page_size, n_heads, d_head] — plain f32 arrays,
+    or :class:`QuantPages` of numpy arrays (int8 values + f32 row
+    scales) when the pool is int8.  ``pack_transfer`` /
+    ``unpack_transfer`` give the wire form; the round trip is bitwise
+    for f32 and exact on (q, scale) for int8."""
+
+    n_pages: int
+    k: Any
+    v: Any
+
+
+_TRANSFER_MAGIC = b"KVPX1\n"
+
+
+def _transfer_arrays(t: PageTransfer):
+    out = []
+    for name, side in (("k", t.k), ("v", t.v)):
+        if isinstance(side, QuantPages):
+            out.append((name + ".q", side.q))
+            out.append((name + ".scale", side.scale))
+        else:
+            out.append((name, side))
+    return out
+
+
+def transfer_nbytes(t: PageTransfer) -> int:
+    """Payload bytes a transfer puts on the wire (header excluded)."""
+    return int(sum(np.asarray(a).nbytes for _, a in _transfer_arrays(t)))
+
+
+def pack_transfer(t: PageTransfer) -> bytes:
+    """Serialize a :class:`PageTransfer`: a json header (names, dtypes,
+    shapes, page count) followed by the raw array bytes in header
+    order.  No pickling — the wire form is self-describing and safe to
+    unpack from an untrusted peer (``unpack_transfer`` validates)."""
+    import json
+    arrs = [(n, np.ascontiguousarray(np.asarray(a)))
+            for n, a in _transfer_arrays(t)]
+    header = json.dumps({
+        "n_pages": int(t.n_pages),
+        "arrays": [{"name": n, "dtype": a.dtype.name, "shape": a.shape}
+                   for n, a in arrs],
+    }).encode()
+    body = b"".join(a.tobytes() for _, a in arrs)
+    return (_TRANSFER_MAGIC + len(header).to_bytes(8, "big")
+            + header + body)
+
+
+def unpack_transfer(data: bytes) -> PageTransfer:
+    """Inverse of :func:`pack_transfer`.  Raises ``ValueError`` on any
+    truncated/corrupt input — the decode host fails the ONE request the
+    bad bytes belong to, before any page allocation, so its free-list
+    partition is untouched."""
+    import json
+    m = len(_TRANSFER_MAGIC)
+    if len(data) < m + 8 or data[:m] != _TRANSFER_MAGIC:
+        raise ValueError("not a KV page transfer (bad magic)")
+    hlen = int.from_bytes(data[m:m + 8], "big")
+    if len(data) < m + 8 + hlen:
+        raise ValueError("truncated page transfer (header)")
+    try:
+        header = json.loads(data[m + 8:m + 8 + hlen])
+        descs = header["arrays"]
+        n_pages = int(header["n_pages"])
+    except (ValueError, KeyError, TypeError) as e:
+        raise ValueError(f"corrupt page transfer header: {e}") from e
+    off = m + 8 + hlen
+    parts: dict = {}
+    for d in descs:
+        dt = np.dtype(d["dtype"])
+        shape = tuple(int(x) for x in d["shape"])
+        nbytes = int(dt.itemsize * math.prod(shape)) if shape else dt.itemsize
+        if len(data) < off + nbytes:
+            raise ValueError(f"truncated page transfer (array {d['name']})")
+        parts[d["name"]] = np.frombuffer(
+            data[off:off + nbytes], dtype=dt).reshape(shape)
+        off += nbytes
+
+    def _side(name):
+        if name in parts:
+            return parts[name]
+        if name + ".q" in parts and name + ".scale" in parts:
+            return QuantPages(parts[name + ".q"], parts[name + ".scale"])
+        raise ValueError(f"page transfer missing {name!r} payload")
+
+    return PageTransfer(n_pages=n_pages, k=_side("k"), v=_side("v"))
+
+
 # -- deterministic attention ----------------------------------------------
 
 
@@ -294,3 +412,7 @@ class DecodeProgram(NamedTuple):
     pages_per_slot: int
     prefill_at: Any = None
     spec_step: Any = None
+    # tensor-parallel degree of the program's executables: >1 means the
+    # fns are shard_map'd over the mesh's "data" axis (heads + page pool
+    # sharded, logits replicated) — see parallel/transformer.py
+    tp: int = 1

@@ -310,9 +310,20 @@ class ShardedTransformerLM:
         and the block math split (models/transformer.block_kv_project /
         block_finish), and uses ops/kv_cache.det_attention so the
         incremental logits are BIT-identical to ``reencode`` of the same
-        tokens (the ``continuous_batching_ab`` gate).  Single-program
-        serving only: requires an unsharded mesh (multi-chip decode —
-        sharded pages + collective attention — is the ROADMAP stretch).
+        tokens (the ``continuous_batching_ab`` gate).
+
+        On a multi-device mesh (all devices folded into the ``data``
+        axis) the program is TENSOR-PARALLEL: every entry point is
+        shard_map'd with attention heads split over ``data``, the page
+        pool sharded to match (each device holds 1/n of the KV bytes),
+        an explicit psum after the row-parallel output projection, and
+        logits replicated so the samplers see the full vocabulary.  All
+        shards run the identical psum in both the incremental and
+        re-encode paths, so the bit-identity contract holds PER SHARD
+        LAYOUT (an n-way program's bits match its own re-encode, not a
+        1-way program's).  Int8 KV stays single-device: its per-row
+        quantization scale is an amax over ALL heads, which a head
+        shard cannot compute locally (the engine enforces this).
         """
         from ..models.transformer import block_finish, block_kv_project
         from ..nn.layers.normalization import layer_norm
@@ -321,10 +332,19 @@ class ShardedTransformerLM:
             write_prefill, write_step, write_tokens,
         )
 
-        if int(np.prod(list(self.mesh.shape.values()))) != 1:
-            raise NotImplementedError(
-                "decode_program requires an unsharded (single-device) "
-                f"mesh; got {dict(self.mesh.shape)}")
+        n_dev = int(np.prod(list(self.mesh.shape.values())))
+        tp = 1
+        if n_dev != 1:
+            tp = int(self.mesh.shape.get("data", 1))
+            if tp != n_dev:
+                raise NotImplementedError(
+                    "sharded decode shards attention heads over the "
+                    "'data' axis only — fold all devices into data= "
+                    f"(got {dict(self.mesh.shape)})")
+            if self.n_heads % tp:
+                raise ValueError(
+                    f"n_heads {self.n_heads} not divisible by the decode "
+                    f"mesh's data={tp}")
         if self.compute_dtype is not None:
             raise NotImplementedError(
                 "decode_program serves the f32 params path; compute_dtype "
@@ -469,9 +489,188 @@ class ShardedTransformerLM:
             h = layer_norm(h, params["lnf_g"], params["lnf_b"])
             return h @ params["head"]
 
+        if tp > 1:
+            # tensor-parallel twins of the five entry points: identical
+            # per-row math, but each shard projects only its local head
+            # group (column-slices of Wq/Wk/Wv, the matching row-slice
+            # of Wo) against a pool shard holding those heads' pages,
+            # with ONE psum per layer restoring the full residual.  The
+            # FFN and the vocab head run replicated — post-psum h is
+            # identical on every shard, so the samplers' "gathered"
+            # logits come for free.
+            from jax.sharding import PartitionSpec
+            from ..ops.kv_cache import QuantPages
+            from ..utils.jax_compat import shard_map
+
+            mesh = self.mesh
+            hl = n_heads // tp
+            dh = d_model // n_heads
+            rep = PartitionSpec()
+
+            def _pool_spec(pool):
+                full = PartitionSpec(None, None, None, "data", None)
+                if isinstance(pool, QuantPages):
+                    return QuantPages(full, rep)
+                return full
+
+            def _local_blocks(params):
+                idx = jax.lax.axis_index("data")
+                out = []
+                for i in range(n_layers):
+                    bp = jax.tree_util.tree_map(
+                        lambda a: a[i], params["blocks"])
+                    lb = dict(bp)
+                    for w in ("Wq", "Wk", "Wv"):
+                        lb[w] = bp[w].reshape(d_model, tp, hl * dh)[:, idx]
+                    lb["Wo"] = bp["Wo"].reshape(tp, hl * dh, d_model)[idx]
+                    out.append(lb)
+                return out
+
+            def _prefill_sh(params, k_pages, v_pages, page_table_row,
+                            tokens, n_real):
+                tb = tokens.shape[0]
+                h = (params["embed"][tokens] + params["pos"][:tb])[None]
+                bias = jnp.where(
+                    jnp.arange(L, dtype=jnp.int32)[None, :]
+                    <= jnp.arange(tb, dtype=jnp.int32)[:, None],
+                    0.0, NEG_INF)[None, None]
+                pt = page_table_row[None]
+                for i, bp in enumerate(_local_blocks(params)):
+                    q, k, v = block_kv_project(bp, h, hl)
+                    k_pages = write_prefill(k_pages, i, page_table_row,
+                                            k.transpose(0, 2, 1, 3)[0])
+                    v_pages = write_prefill(v_pages, i, page_table_row,
+                                            v.transpose(0, 2, 1, 3)[0])
+                    k_all = gather_layer(
+                        k_pages, i, pt).transpose(0, 2, 1, 3)
+                    v_all = gather_layer(
+                        v_pages, i, pt).transpose(0, 2, 1, 3)
+                    h = block_finish(bp, h,
+                                     det_attention(q, k_all, v_all, bias),
+                                     psum_axis="data")
+                h = layer_norm(h, params["lnf_g"], params["lnf_b"])
+                return k_pages, v_pages, (h @ params["head"])[0, n_real - 1]
+
+            def _step_sh(params, k_pages, v_pages, page_table, tokens,
+                         positions, active):
+                h = (params["embed"][tokens]
+                     + params["pos"][positions])[:, None]
+                bias = jnp.where(
+                    jnp.arange(L, dtype=jnp.int32)[None, :]
+                    <= positions[:, None], 0.0, NEG_INF)[:, None, None, :]
+                pt = jnp.where(active[:, None], page_table, 0)
+                for i, bp in enumerate(_local_blocks(params)):
+                    q, k, v = block_kv_project(bp, h, hl)
+                    k_pages = write_step(k_pages, i, pt, positions,
+                                         k[:, :, 0])
+                    v_pages = write_step(v_pages, i, pt, positions,
+                                         v[:, :, 0])
+                    k_all = gather_layer(
+                        k_pages, i, pt).transpose(0, 2, 1, 3)
+                    v_all = gather_layer(
+                        v_pages, i, pt).transpose(0, 2, 1, 3)
+                    h = block_finish(bp, h,
+                                     det_attention(q, k_all, v_all, bias),
+                                     psum_axis="data")
+                h = layer_norm(h, params["lnf_g"], params["lnf_b"])
+                return k_pages, v_pages, (h @ params["head"])[:, 0]
+
+            def _prefill_at_sh(params, k_pages, v_pages, page_table_row,
+                               tokens, n_real, offset):
+                tb = tokens.shape[0]
+                pos_abs = offset + jnp.arange(tb, dtype=jnp.int32)
+                h = (params["embed"][tokens]
+                     + params["pos"][jnp.clip(pos_abs, 0,
+                                              pos_rows - 1)])[None]
+                bias = jnp.where(
+                    jnp.arange(L, dtype=jnp.int32)[None, :]
+                    <= pos_abs[:, None], 0.0, NEG_INF)[None, None]
+                pt = page_table_row[None]
+                for i, bp in enumerate(_local_blocks(params)):
+                    q, k, v = block_kv_project(bp, h, hl)
+                    k_pages = write_prefill(k_pages, i, page_table_row,
+                                            k.transpose(0, 2, 1, 3)[0],
+                                            offset)
+                    v_pages = write_prefill(v_pages, i, page_table_row,
+                                            v.transpose(0, 2, 1, 3)[0],
+                                            offset)
+                    k_all = gather_layer(
+                        k_pages, i, pt).transpose(0, 2, 1, 3)
+                    v_all = gather_layer(
+                        v_pages, i, pt).transpose(0, 2, 1, 3)
+                    h = block_finish(bp, h,
+                                     det_attention(q, k_all, v_all, bias),
+                                     psum_axis="data")
+                h = layer_norm(h, params["lnf_g"], params["lnf_b"])
+                return k_pages, v_pages, (h @ params["head"])[0, n_real - 1]
+
+            def _spec_step_sh(params, k_pages, v_pages, page_table, tokens,
+                              positions, active):
+                s_n, t_n = tokens.shape
+                pos_abs = positions[:, None] + jnp.arange(t_n,
+                                                          dtype=jnp.int32)
+                h = (params["embed"][tokens]
+                     + params["pos"][jnp.clip(pos_abs, 0, pos_rows - 1)])
+                bias = jnp.where(
+                    jnp.arange(L, dtype=jnp.int32)[None, None, :]
+                    <= pos_abs[:, :, None], 0.0, NEG_INF)[:, None]
+                pt = jnp.where(active[:, None], page_table, 0)
+                for i, bp in enumerate(_local_blocks(params)):
+                    q, k, v = block_kv_project(bp, h, hl)
+                    k_pages = write_tokens(k_pages, i, pt, positions,
+                                           k.transpose(0, 2, 1, 3))
+                    v_pages = write_tokens(v_pages, i, pt, positions,
+                                           v.transpose(0, 2, 1, 3))
+                    k_all = gather_layer(
+                        k_pages, i, pt).transpose(0, 2, 1, 3)
+                    v_all = gather_layer(
+                        v_pages, i, pt).transpose(0, 2, 1, 3)
+                    h = block_finish(bp, h,
+                                     det_attention(q, k_all, v_all, bias),
+                                     psum_axis="data")
+                h = layer_norm(h, params["lnf_g"], params["lnf_b"])
+                return k_pages, v_pages, h @ params["head"]
+
+            def _reencode_sh(params, tokens):
+                b, t = tokens.shape
+                h = params["embed"][tokens] + params["pos"][:t]
+                bias = jnp.where(
+                    jnp.arange(t, dtype=jnp.int32)[None, :]
+                    <= jnp.arange(t, dtype=jnp.int32)[:, None],
+                    0.0, NEG_INF)[None, None]
+                for bp in _local_blocks(params):
+                    q, k, v = block_kv_project(bp, h, hl)
+                    h = block_finish(bp, h, det_attention(q, k, v, bias),
+                                     psum_axis="data")
+                h = layer_norm(h, params["lnf_g"], params["lnf_b"])
+                return h @ params["head"]
+
+            def _wrap(body):
+                # the pool specs depend on the pool KIND, so the
+                # shard_map is built at trace time (inside the engine's
+                # jit) where the pytree is known
+                def fn(params, k_pages, v_pages, *rest):
+                    ks, vs = _pool_spec(k_pages), _pool_spec(v_pages)
+                    sm = shard_map(
+                        body, mesh=mesh,
+                        in_specs=(rep, ks, vs) + (rep,) * len(rest),
+                        out_specs=(ks, vs, rep))
+                    return sm(params, k_pages, v_pages, *rest)
+                return fn
+
+            prefill = _wrap(_prefill_sh)
+            step = _wrap(_step_sh)
+            prefill_at = _wrap(_prefill_at_sh)
+            spec_step = _wrap(_spec_step_sh)
+
+            def reencode(params, tokens):
+                return shard_map(_reencode_sh, mesh=mesh,
+                                 in_specs=(rep, rep),
+                                 out_specs=rep)(params, tokens)
+
         return DecodeProgram(
             prefill=prefill, step=step, reencode=reencode,
             n_layers=n_layers, n_heads=n_heads, d_head=d_model // n_heads,
             vocab_size=self.vocab_size, max_len=L, page_size=page_size,
             pages_per_slot=L // page_size,
-            prefill_at=prefill_at, spec_step=spec_step)
+            prefill_at=prefill_at, spec_step=spec_step, tp=tp)

@@ -24,7 +24,9 @@ remains as a thin back-compat shim over this engine).  Pieces:
                split, iteration-level continuous batching, seeded
                deterministic sampling, per-request stop conditions,
                crash-retry/poison-isolation/hot-swap decode-shaped;
-               TTFT + time-per-output-token first-class (DecodeMetrics)
+               TTFT + time-per-output-token first-class (DecodeMetrics);
+               prefill/decode disaggregation (role=..., PrefillHandoff
+               KV-page transfer) + tensor-parallel sharded decode
   warmcache.py zero-cold-start: process-wide JAX persistent compile
                cache (DL4J_TPU_COMPILE_CACHE / --compile-cache) +
                warmup bundles (serialized AOT executables next to the
@@ -44,7 +46,7 @@ from .batcher import (
     ADMISSION_POLICIES, ContinuousBatcher, DeadlineExceededError,
     DynamicBatcher, OverloadedError, pow2_buckets,
 )
-from .decode import DecodeEngine, GenerationResult
+from .decode import DecodeEngine, GenerationResult, PrefillHandoff
 from .engine import (
     Engine, PoisonInputError, ReplicaCrashError, ReplicaHungError,
     ServingUnavailableError,
@@ -63,7 +65,8 @@ __all__ = [
     "DecodeEngine", "DecodeMetrics", "DynamicBatcher", "Engine",
     "FleetHost", "FleetMetrics", "FleetRouter", "FleetTimeoutError",
     "GenerationResult", "HttpHost", "LatencyHistogram", "ModelRegistry",
-    "OverloadedError", "PoisonInputError", "ReplicaAutoscaler",
+    "OverloadedError", "PoisonInputError", "PrefillHandoff",
+    "ReplicaAutoscaler",
     "ReplicaCrashError", "ReplicaHungError", "ServingMetrics",
     "ServingUnavailableError", "bundle_path_for", "device_fingerprint",
     "enable_compile_cache", "load_bundle", "pow2_buckets", "save_bundle",

@@ -94,6 +94,7 @@ TTFT and time-per-output-token are first-class (``DecodeMetrics``,
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -138,6 +139,39 @@ class _GenSpec:
     top_p: float
     seed: int
     echo_logits: bool
+
+
+@dataclass
+class PrefillHandoff:
+    """The complete baton a ``role="prefill"`` host passes to a
+    ``role="decode"`` host: the request spec, the first sampled token
+    (TTFT is paid on the prefill side), and the prompt's KV pages packed
+    with ``ops.kv_cache.pack_transfer`` — bit-exact f32 bytes or the
+    int8+scale pair, so the decode host continues the EXACT sequence a
+    unified engine would have produced.  ``logits0`` carries the prefill
+    logits row only when the request asked ``echo_logits``."""
+
+    prompt: np.ndarray
+    max_new: int
+    temperature: float
+    top_k: int
+    top_p: float
+    seed: int
+    echo_logits: bool
+    first_token: int
+    finite: bool
+    n_pages: int
+    pages: bytes
+    logits0: Optional[np.ndarray]
+    model_tag: str
+
+
+@dataclass(frozen=True)
+class _HandoffSpec(_GenSpec):
+    """``_GenSpec`` + the inbound transfer — what a decode-role host's
+    batcher queues for ``continue_async``."""
+
+    handoff: Any = None
 
 
 class _Slot:
@@ -346,15 +380,30 @@ class DecodeEngine:
                  clock=time.monotonic, tag: str = "v0",
                  metrics: Optional[DecodeMetrics] = None,
                  prefix_cache: bool = False, draft_model=None,
-                 speculate_k: int = 4, kv_dtype: Optional[str] = None):
+                 speculate_k: int = 4, kv_dtype: Optional[str] = None,
+                 role: str = "unified"):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         if kv_dtype not in (None, "f32", "float32", "int8", "i8"):
             raise ValueError(f"kv_dtype {kv_dtype!r} not supported "
                              "(float32 or int8)")
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(f"role {role!r} not supported "
+                             "(unified, prefill, or decode)")
+        if role != "unified" and draft_model is not None:
+            raise ValueError(
+                "speculative decoding is unified-role only — the draft "
+                "pool's state never crosses a page handoff")
+        self.role = role
+        self._mesh = getattr(model, "mesh", None)
         self.program = model.decode_program(page_size=page_size,
                                             max_len=max_len)
         prog = self.program
+        if getattr(prog, "tp", 1) > 1 and kv_dtype in ("int8", "i8"):
+            raise ValueError(
+                "int8 KV + tensor-parallel decode is unsupported: the "
+                "per-row quantization scale is an amax over ALL heads "
+                "and cannot be computed inside one head shard")
         self._prefix_on = bool(prefix_cache)
         if self._prefix_on and prog.prefill_at is None:
             raise ValueError(
@@ -461,7 +510,9 @@ class DecodeEngine:
         kp, vp = alloc_cache(prog.n_layers, self.total_pages, prog.page_size,
                              prog.n_heads, prog.d_head,
                              kv_dtype=self._kv_dtype)
-        bundle = load_bundle(warm_bundle) if warm_bundle else {}
+        bundle_mesh = self._mesh if getattr(prog, "tp", 1) > 1 else None
+        bundle = (load_bundle(warm_bundle, mesh=bundle_mesh)
+                  if warm_bundle else {})
         hits = misses = 0
 
         def _get(key, build):
@@ -475,63 +526,76 @@ class DecodeEngine:
 
         t0 = self.clock()
         with obs_trace.span("serve/warmup", cat="serve", kind="decode",
-                            tag=self._serve_tag):
-            step_c = _get("step", lambda: jax.jit(
-                prog.step, donate_argnums=(1, 2)).lower(
+                            tag=self._serve_tag, role=self.role):
+            lgs = None
+            if self.role != "prefill":
+                # decode step + batch sampler — a prefill-role host never
+                # steps, so its warmup (and bundle) skips them entirely
+                step_c = _get("step", lambda: jax.jit(
+                    prog.step, donate_argnums=(1, 2)).lower(
+                        params, kp, vp, np.zeros((s_n, pps), np.int32),
+                        np.zeros((s_n,), np.int32),
+                        np.zeros((s_n,), np.int32),
+                        np.zeros((s_n,), bool)).compile())
+                kp, vp, lgs = step_c(
                     params, kp, vp, np.zeros((s_n, pps), np.int32),
                     np.zeros((s_n,), np.int32), np.zeros((s_n,), np.int32),
-                    np.zeros((s_n,), bool)).compile())
-            kp, vp, lgs = step_c(
-                params, kp, vp, np.zeros((s_n, pps), np.int32),
-                np.zeros((s_n,), np.int32), np.zeros((s_n,), np.int32),
-                np.zeros((s_n,), bool))
-            self._compiled[("step",)] = step_c
+                    np.zeros((s_n,), bool))
+                self._compiled[("step",)] = step_c
 
             lg1 = None
-            prefill_jit = jax.jit(prog.prefill, donate_argnums=(1, 2))
-            for b in self.prompt_buckets:
-                pf = _get(f"prefill:{b}", lambda b=b: prefill_jit.lower(
-                    params, kp, vp, np.zeros((pps,), np.int32),
-                    np.zeros((b,), np.int32), np.int32(1)).compile())
-                kp, vp, lg1 = pf(params, kp, vp, np.zeros((pps,), np.int32),
-                                 np.zeros((b,), np.int32), np.int32(1))
-                self._compiled[("prefill", b)] = pf
-
-            if self._prefix_on:
-                # suffix prefill per bucket — only prefix-cache HITS use
-                # these, so the cold path's executables (and bits) are
-                # untouched when every request misses
-                pa_jit = jax.jit(prog.prefill_at, donate_argnums=(1, 2))
+            if self.role != "decode":
+                prefill_jit = jax.jit(prog.prefill, donate_argnums=(1, 2))
                 for b in self.prompt_buckets:
-                    pf = _get(f"prefill_at:{b}", lambda b=b: pa_jit.lower(
+                    pf = _get(f"prefill:{b}", lambda b=b: prefill_jit.lower(
                         params, kp, vp, np.zeros((pps,), np.int32),
-                        np.zeros((b,), np.int32), np.int32(1),
-                        np.int32(0)).compile())
+                        np.zeros((b,), np.int32), np.int32(1)).compile())
                     kp, vp, lg1 = pf(params, kp, vp,
                                      np.zeros((pps,), np.int32),
-                                     np.zeros((b,), np.int32), np.int32(1),
-                                     np.int32(0))
-                    self._compiled[("prefill_at", b)] = pf
+                                     np.zeros((b,), np.int32), np.int32(1))
+                    self._compiled[("prefill", b)] = pf
+
+                if self._prefix_on:
+                    # suffix prefill per bucket — only prefix-cache HITS
+                    # use these, so the cold path's executables (and
+                    # bits) are untouched when every request misses
+                    pa_jit = jax.jit(prog.prefill_at, donate_argnums=(1, 2))
+                    for b in self.prompt_buckets:
+                        pf = _get(f"prefill_at:{b}",
+                                  lambda b=b: pa_jit.lower(
+                                      params, kp, vp,
+                                      np.zeros((pps,), np.int32),
+                                      np.zeros((b,), np.int32), np.int32(1),
+                                      np.int32(0)).compile())
+                        kp, vp, lg1 = pf(params, kp, vp,
+                                         np.zeros((pps,), np.int32),
+                                         np.zeros((b,), np.int32),
+                                         np.int32(1), np.int32(0))
+                        self._compiled[("prefill_at", b)] = pf
 
             one, batch = _make_samplers(v_n)
-            s1 = _get("sample1", lambda: jax.jit(one).lower(
-                lg1, np.float32(0), np.int32(0), np.float32(1), np.uint32(0),
-                np.int32(0)).compile())
-            tok, _ = s1(lg1, np.float32(0), np.int32(0), np.float32(1),
-                        np.uint32(0), np.int32(0))
-            np.asarray(tok)
-            self._compiled[("sample1",)] = s1
-            sb = _get("sample", lambda: jax.jit(batch).lower(
-                lgs, np.zeros((s_n,), np.float32), np.zeros((s_n,), np.int32),
-                np.ones((s_n,), np.float32), np.zeros((s_n,), np.uint32),
-                np.zeros((s_n,), np.int32)).compile())
-            toks, _ = sb(lgs, np.zeros((s_n,), np.float32),
-                         np.zeros((s_n,), np.int32),
-                         np.ones((s_n,), np.float32),
-                         np.zeros((s_n,), np.uint32),
-                         np.zeros((s_n,), np.int32))
-            np.asarray(toks)
-            self._compiled[("sample",)] = sb
+            if self.role != "decode":
+                s1 = _get("sample1", lambda: jax.jit(one).lower(
+                    lg1, np.float32(0), np.int32(0), np.float32(1),
+                    np.uint32(0), np.int32(0)).compile())
+                tok, _ = s1(lg1, np.float32(0), np.int32(0), np.float32(1),
+                            np.uint32(0), np.int32(0))
+                np.asarray(tok)
+                self._compiled[("sample1",)] = s1
+            if self.role != "prefill":
+                sb = _get("sample", lambda: jax.jit(batch).lower(
+                    lgs, np.zeros((s_n,), np.float32),
+                    np.zeros((s_n,), np.int32),
+                    np.ones((s_n,), np.float32),
+                    np.zeros((s_n,), np.uint32),
+                    np.zeros((s_n,), np.int32)).compile())
+                toks, _ = sb(lgs, np.zeros((s_n,), np.float32),
+                             np.zeros((s_n,), np.int32),
+                             np.ones((s_n,), np.float32),
+                             np.zeros((s_n,), np.uint32),
+                             np.zeros((s_n,), np.int32))
+                np.asarray(toks)
+                self._compiled[("sample",)] = sb
 
             from ..ops.kv_cache import scrub_pool
 
@@ -555,6 +619,37 @@ class DecodeEngine:
             kp, vp = scrub_c(kp, vp, np.zeros((pps,), np.int32))
             self._compiled[("scrub",)] = scrub_c
 
+            if self.role == "prefill":
+                # page export: gather one slot's pages out of the pool
+                # (read-only — the pool stays donated to the serve path)
+                from ..ops.kv_cache import gather_pages
+
+                def _extract(k, v, ids):
+                    return gather_pages(k, ids), gather_pages(v, ids)
+
+                ex_c = _get("extract", lambda: jax.jit(_extract).lower(
+                    kp, vp, np.zeros((pps,), np.int32)).compile())
+                jax.block_until_ready(
+                    ex_c(kp, vp, np.zeros((pps,), np.int32)))
+                self._compiled[("extract",)] = ex_c
+            if self.role == "decode":
+                # page attach: scatter an inbound transfer's rows into
+                # freshly-allocated pages in ONE donated dispatch
+                from ..ops.kv_cache import set_pages
+
+                def _attach(k, v, ids, kpay, vpay):
+                    return set_pages(k, ids, kpay), set_pages(v, ids, vpay)
+
+                zk_pay = self._zero_payload(kp)
+                zv_pay = self._zero_payload(vp)
+                at_c = _get("attach", lambda: jax.jit(
+                    _attach, donate_argnums=(0, 1)).lower(
+                        kp, vp, np.zeros((pps,), np.int32),
+                        zk_pay, zv_pay).compile())
+                kp, vp = at_c(kp, vp, np.zeros((pps,), np.int32),
+                              zk_pay, zv_pay)
+                self._compiled[("attach",)] = at_c
+
             if self._draft_program is not None:
                 kp, vp = self._load_spec(_get, params, kp, vp)
         self.metrics.inc("bundle_hits", hits)
@@ -562,6 +657,8 @@ class DecodeEngine:
         self.metrics.inc("warmup_seconds_total", self.clock() - t0)
 
         self._cache = (kp, vp)
+        with self._lock:
+            self._refresh_pool_gauges_locked()
         self._loaded = True
         self._start_loop()
         self._supervisor = threading.Thread(
@@ -674,17 +771,31 @@ class DecodeEngine:
         self._draft_cache = (dkp, dvp)
         return kp, vp
 
+    def _zero_payload(self, pool):
+        """A zero host-side payload with the shape
+        ``gather_pages(pool, ids)`` produces for a full pages-per-slot id
+        vector — the AOT lowering specimen for the attach executable
+        (handles both the f32 pool and the int8 QuantPages pair)."""
+        import jax
+        pps = self.program.pages_per_slot
+        return jax.tree_util.tree_map(
+            lambda a: np.zeros((a.shape[0], pps) + tuple(a.shape[2:]),
+                               a.dtype), pool)
+
     def save_warmup_bundle(self, path: str) -> str:
         """Export every serve-path executable as a warmup bundle
         (serving/warmcache.py) so a fresh process — a scaled-up decode
         host, a respawn — deserializes in milliseconds via
-        ``load(warm_bundle=path)`` instead of paying the XLA compiles."""
+        ``load(warm_bundle=path)`` instead of paying the XLA compiles.
+        Sharded (tp > 1) engines pin the mesh topology into the bundle
+        fingerprint — a differently-meshed process recompiles."""
         from .warmcache import save_bundle
         if not self._loaded:
             raise RuntimeError("load() the engine before bundling")
         entries = {":".join(str(p) for p in key): exe
                    for key, exe in self._compiled.items()}
-        return save_bundle(path, self._serve_tag, entries)
+        mesh = self._mesh if getattr(self.program, "tp", 1) > 1 else None
+        return save_bundle(path, self._serve_tag, entries, mesh=mesh)
 
     def compile_cache_size(self) -> int:
         """Executables backing the serve path.  Must not grow after
@@ -709,6 +820,11 @@ class DecodeEngine:
         running decode batch at the next step boundary."""
         if not self._loaded:
             raise RuntimeError("DecodeEngine.load() must run before generate")
+        if self.role == "decode":
+            raise RuntimeError(
+                "decode-role host accepts page handoffs (continue_async), "
+                "not raw prompts — route prompts at a prefill or unified "
+                "host")
         prog = self.program
         prompt = np.asarray(prompt_ids, np.int32).reshape(-1)
         if prompt.shape[0] < 1 or prompt.shape[0] > self.max_prompt:
@@ -739,6 +855,45 @@ class DecodeEngine:
     def generate(self, prompt_ids, **kw) -> GenerationResult:
         """Blocking ``generate_async``."""
         return self.generate_async(prompt_ids, **kw).result()
+
+    def continue_async(self, handoff: PrefillHandoff, *,
+                       slo_ms: Optional[float] = None,
+                       deadline: Optional[float] = None) -> Future:
+        """Enqueue the DECODE stage of a disaggregated generation:
+        attach the prefill host's exported KV pages, then stream tokens
+        from the already-sampled first token.  Only valid on a
+        ``role="decode"`` engine.  Resolves to the same
+        ``GenerationResult`` a unified engine would produce — seeded
+        counter-based sampling continues at step 1, so the token
+        sequence is bit-identical."""
+        if not self._loaded:
+            raise RuntimeError("DecodeEngine.load() must run before "
+                               "continue_async")
+        if self.role != "decode":
+            raise RuntimeError(
+                "continue_async needs a role='decode' engine "
+                f"(this one is {self.role!r})")
+        prog = self.program
+        prompt = np.asarray(handoff.prompt, np.int32).reshape(-1)
+        n = int(prompt.shape[0])
+        if n < 1 or n >= prog.max_len:
+            raise ValueError(
+                f"handoff prompt length {n} outside [1, {prog.max_len})")
+        if prompt.min() < 0 or prompt.max() >= prog.vocab_size:
+            raise ValueError(f"prompt ids outside [0, {prog.vocab_size})")
+        if not 0 <= int(handoff.first_token) < prog.vocab_size:
+            raise ValueError(
+                f"handoff first_token {handoff.first_token} outside "
+                f"[0, {prog.vocab_size})")
+        max_new = max(1, min(int(handoff.max_new), prog.max_len - n))
+        spec = _HandoffSpec(
+            prompt=prompt, max_new=max_new,
+            temperature=float(handoff.temperature),
+            top_k=int(handoff.top_k), top_p=float(handoff.top_p),
+            seed=int(handoff.seed),
+            echo_logits=bool(handoff.echo_logits), handoff=handoff)
+        return self.batcher.submit_request(spec, slo_ms=slo_ms,
+                                           deadline=deadline)
 
     # -- hot-swap ----------------------------------------------------------
 
@@ -875,6 +1030,19 @@ class DecodeEngine:
                 self._start_loop()
 
     def _loop(self, gen: int) -> None:
+        if self.role == "prefill":
+            # Prefill hosts are throughput-oriented: drop the loop
+            # thread to lowest scheduling priority so a co-located
+            # decode-role host keeps its inter-token latency through
+            # prompt bursts (TTFT of queued prefills is the explicit
+            # trade).  On a dedicated prefill machine there is no
+            # competitor and this changes nothing; a thread may always
+            # raise its own nice value on Linux.
+            try:
+                os.setpriority(os.PRIO_PROCESS,
+                               threading.get_native_id(), 19)
+            except (AttributeError, OSError):  # pragma: no cover
+                pass
         while True:
             with self._lock:
                 if self._shutdown or gen != self._generation:
@@ -1017,9 +1185,26 @@ class DecodeEngine:
                 leftovers.append(r)
                 continue
             spec = r.payload
-            max_total = min(int(spec.prompt.shape[0]) + spec.max_new,
-                            prog.max_len)
-            need_total = pages_for(max_total, prog.page_size)
+            handoff = getattr(spec, "handoff", None)
+            transfer = None
+            if handoff is not None:
+                try:
+                    # validate BEFORE any allocation: a corrupt transfer
+                    # fails typed with the free list untouched
+                    transfer = self._check_handoff(spec, handoff)
+                except ValueError as e:
+                    self.metrics.inc("errors")
+                    _fail_safe(r.future, e)
+                    continue
+            if self.role == "prefill":
+                # a prefill host never decodes — the slot only needs the
+                # prompt's pages, exported and freed at handoff
+                need_total = pages_for(int(spec.prompt.shape[0]),
+                                       prog.page_size)
+            else:
+                max_total = min(int(spec.prompt.shape[0]) + spec.max_new,
+                                prog.max_len)
+                need_total = pages_for(max_total, prog.page_size)
             t_attach = self.clock()
             with self._lock:
                 if not free:
@@ -1054,6 +1239,7 @@ class DecodeEngine:
                     sum(1 for s in self._slots if s is not None))
                 self.metrics.pages_in_use.set(
                     self.total_pages - 1 - len(self._free_pages))
+                self._refresh_pool_gauges_locked()
             if self._prefix_on:
                 if m:
                     self.metrics.inc("prefix_hits")
@@ -1066,11 +1252,44 @@ class DecodeEngine:
                     cat="serve", slot=i, matched_pages=m,
                     matched_tokens=m * prog.page_size)
             self.metrics.inc("requests")
-            self._prefill_slot(i)
+            if transfer is not None:
+                self._attach_handoff(i, transfer)
+            elif self.role == "prefill":
+                self._prefill_export(i)
+            else:
+                self._prefill_slot(i)
             worked = True
         for r in reversed(leftovers):
             self.batcher.requeue_front(r)
         return worked
+
+    def _check_handoff(self, spec, handoff):
+        """Unpack + shape-check an inbound transfer against THIS pool's
+        layout (layers / page dims / kv dtype).  Raises ``ValueError``
+        on any mismatch or corruption — called before page allocation so
+        failure leaves the free list and page table untouched."""
+        import jax
+
+        from ..ops.kv_cache import pages_for, unpack_transfer
+
+        transfer = unpack_transfer(handoff.pages)
+        want = pages_for(int(spec.prompt.shape[0]), self.program.page_size)
+        if transfer.n_pages != want:
+            raise ValueError(
+                f"handoff carries {transfer.n_pages} pages; a prompt of "
+                f"{int(spec.prompt.shape[0])} tokens needs {want}")
+        kp, _ = self._cache
+        ref = jax.tree_util.tree_leaves(kp)
+        got = jax.tree_util.tree_leaves(transfer.k)
+        if len(ref) != len(got) or any(
+                tuple(g.shape[2:]) != tuple(a.shape[2:])
+                or g.dtype != a.dtype or g.shape[0] != a.shape[0]
+                or g.shape[1] != transfer.n_pages
+                for g, a in zip(got, ref)):
+            raise ValueError(
+                "handoff page payload does not match this engine's pool "
+                "layout (n_layers / page dims / kv_dtype)")
+        return transfer
 
     def _bucket_for(self, n: int) -> int:
         for b in self.prompt_buckets:
@@ -1138,6 +1357,151 @@ class DecodeEngine:
                 self._prefix_insert(s, t1)
         self._record_token(i, tok_h, fin_h, lg_h, t1)
 
+    def _attach_handoff(self, i: int, transfer) -> None:
+        """Decode-stage admission: scatter the prefill host's exported
+        page payload into this slot's freshly-allocated private pages
+        (rows below a local prefix match are deduped — they target the
+        scratch page and the shared pages serve those rows), then record
+        the already-sampled first token.  One AOT dispatch; position and
+        sampling-step bookkeeping land exactly where a local prefill
+        would have left them, so the continuation is bit-identical."""
+        s = self._slots[i]
+        h = s.spec.handoff
+        pps = self.program.pages_per_slot
+        m = s.n_matched
+        p_pro = transfer.n_pages
+        t0 = self.clock()
+        ids = np.zeros((pps,), np.int32)        # scratch: write discarded
+        ids[m:p_pro] = self._page_table[i][m:p_pro]
+
+        def _pad(side):
+            import jax
+
+            def one(a):
+                full = np.zeros((a.shape[0], pps) + tuple(a.shape[2:]),
+                                a.dtype)
+                full[:, m:p_pro] = a[:, m:p_pro]
+                return full
+            return jax.tree_util.tree_map(one, side)
+
+        kp, vp = self._cache
+        kp, vp = self._compiled[("attach",)](
+            kp, vp, ids, _pad(transfer.k), _pad(transfer.v))
+        self._cache = (kp, vp)
+        t1 = self.clock()
+        obs_trace.complete_at("serve/prefill", t0, t1, cat="serve", slot=i,
+                              bucket=0, prompt_tokens=s.n_prompt,
+                              model=s.tag, attached_pages=p_pro - m)
+        self.metrics.inc("prefills")
+        self.metrics.inc("handoffs_in")
+        self.metrics.inc("pages_attached", p_pro - m)
+        if m:
+            self.metrics.inc("pages_deduped", m)
+        self.metrics.ttft.record((t1 - s.req.t_submit) * 1e3)
+        s.t_first = t1
+        fin_h = bool(h.finite)
+        if self._prefix_on and fin_h:
+            with self._lock:
+                self._prefix_insert(s, t1)
+        lg_h = (np.asarray(h.logits0, np.float32)
+                if s.spec.echo_logits and h.logits0 is not None else None)
+        self._record_token(i, int(h.first_token), fin_h, lg_h, t1)
+
+    def _prefill_export(self, i: int) -> None:
+        """Prefill-role terminal: run the standard prefill + first-token
+        sample, then EXPORT the slot — gather the prompt's KV pages into
+        a packed transfer, resolve the future with a
+        ``PrefillHandoff``, and free the slot immediately (a prefill
+        host never decodes).  A poisoned prefill is isolated HERE and
+        never crosses the wire."""
+        import jax
+
+        from ..ops.kv_cache import PageTransfer, pack_transfer, pages_for
+
+        s = self._slots[i]
+        spec = s.spec
+        n = s.n_prompt
+        m = s.n_matched * self.program.page_size
+        t0 = self.clock()
+        kp, vp = self._cache
+        if m:
+            suffix = n - m
+            bucket = self._bucket_for(suffix)
+            padded = np.zeros((bucket,), np.int32)
+            padded[:suffix] = spec.prompt[m:]
+            kp, vp, lg = self._compiled[("prefill_at", bucket)](
+                self._versions[s.tag], kp, vp, self._page_table[i], padded,
+                np.int32(suffix), np.int32(m))
+        else:
+            bucket = self._bucket_for(n)
+            padded = np.zeros((bucket,), np.int32)
+            padded[:n] = spec.prompt
+            kp, vp, lg = self._compiled[("prefill", bucket)](
+                self._versions[s.tag], kp, vp, self._page_table[i], padded,
+                np.int32(n))
+        tok, fin = self._compiled[("sample1",)](
+            lg, np.float32(spec.temperature), np.int32(spec.top_k),
+            np.float32(spec.top_p), np.uint32(spec.seed), np.int32(0))
+        self._cache = (kp, vp)
+        tok_h = int(np.asarray(tok))
+        fin_h = bool(np.asarray(fin))
+        t1 = self.clock()
+        obs_trace.complete_at("serve/prefill", t0, t1, cat="serve", slot=i,
+                              bucket=bucket, prompt_tokens=n, model=s.tag)
+        self.metrics.inc("prefills")
+        self.metrics.ttft.record((t1 - s.req.t_submit) * 1e3)
+        s.t_first = t1
+        if not fin_h:
+            self.metrics.inc("poison_isolated")
+            self._scrub_pages(s.page_ids)
+            self._finish(i, t1, error=PoisonInputError(
+                f"prefill produced non-finite logits (slot {i}) — "
+                "handoff suppressed, request isolated"))
+            return
+        if self._prefix_on:
+            with self._lock:
+                self._prefix_insert(s, t1)
+        p_pro = pages_for(n, self.program.page_size)
+        k_pages, v_pages = self._compiled[("extract",)](
+            kp, vp, self._page_table[i])
+        k_np = jax.tree_util.tree_map(
+            lambda a: np.asarray(a)[:, :p_pro].copy(), k_pages)
+        v_np = jax.tree_util.tree_map(
+            lambda a: np.asarray(a)[:, :p_pro].copy(), v_pages)
+        payload = pack_transfer(PageTransfer(n_pages=p_pro, k=k_np, v=v_np))
+        handoff = PrefillHandoff(
+            prompt=spec.prompt, max_new=s.max_new,
+            temperature=spec.temperature, top_k=spec.top_k,
+            top_p=spec.top_p, seed=spec.seed,
+            echo_logits=spec.echo_logits, first_token=tok_h, finite=True,
+            n_pages=p_pro, pages=payload,
+            logits0=np.asarray(lg).copy() if spec.echo_logits else None,
+            model_tag=s.tag)
+        self.metrics.inc("handoffs_out")
+        self.metrics.inc("pages_exported", p_pro)
+        now = self.clock()
+        with self._lock:
+            self._slots[i] = None
+            self._free_pages.extend(s.page_ids)
+            for nd in reversed(s.shared_nodes):
+                nd.refs -= 1
+                nd.last_used = now
+            s.shared_nodes = []
+            self._page_table[i] = 0
+            live_tags = {sl.tag for sl in self._slots if sl is not None}
+            live_tags.add(self._serve_tag)
+            for t in [t for t in self._versions if t not in live_tags]:
+                del self._versions[t]
+            self.metrics.active_slots.set(
+                sum(1 for sl in self._slots if sl is not None))
+            self.metrics.pages_in_use.set(
+                self.total_pages - 1 - len(self._free_pages))
+            self._refresh_pool_gauges_locked()
+        _set_safe(s.req.future, handoff)
+        obs_trace.complete_at("serve/request", s.req.t_submit, now,
+                              cat="serve", kind="prefill_handoff",
+                              tokens=1, finish="handoff")
+
     def _step_once(self) -> bool:
         """One decode step per distinct active version tag (same
         executable, that tag's params, that tag's slots active) — the
@@ -1197,6 +1561,11 @@ class DecodeEngine:
             t1 = self.clock()
             obs_trace.complete_at("serve/decode_step", t0, t1, cat="serve",
                                   n_active=len(group), model=tag)
+            if getattr(self.program, "tp", 1) > 1:
+                obs_trace.complete_at(
+                    "serve/shard_step", t0, t1, cat="serve",
+                    n_active=len(group), shards=int(self.program.tp),
+                    model=tag)
             self.metrics.inc("decode_steps")
             self.metrics.step_time.record((t1 - t0) * 1e3)
             for i in group:
@@ -1405,6 +1774,7 @@ class DecodeEngine:
                 sum(1 for sl in self._slots if sl is not None))
             self.metrics.pages_in_use.set(
                 self.total_pages - 1 - len(self._free_pages))
+            self._refresh_pool_gauges_locked()
         if error is not None:
             self.metrics.inc("errors")
             _fail_safe(s.req.future, error)
@@ -1447,6 +1817,7 @@ class DecodeEngine:
             self.metrics.shared_pages.set(0)
             self.metrics.active_slots.set(0)
             self.metrics.pages_in_use.set(0)
+            self._refresh_pool_gauges_locked()
         # the crash may have left non-finite rows anywhere — zero the pool
         kp, vp = self._cache
         self._cache = self._compiled[("reset",)](kp, vp)
@@ -1471,20 +1842,33 @@ class DecodeEngine:
 
     # -- observability / shutdown ------------------------------------------
 
+    def _refresh_pool_gauges_locked(self) -> None:
+        """Keep the free-capacity gauges live — the fleet router scores
+        decode sinks by them (docs/SERVING.md "Disaggregated and
+        sharded decode").  Caller holds ``self._lock``."""
+        self.metrics.free_pages.set(len(self._free_pages))
+        self.metrics.free_slots.set(
+            sum(1 for s in self._slots if s is None))
+
     def metrics_snapshot(self) -> dict:
         snap = self.metrics.snapshot()
         with self._lock:
             snap["model"] = self._serve_tag
             snap["versions"] = sorted(self._versions)
             snap["queue_depth"] = self.batcher.qsize()
+            snap["free_pages"] = len(self._free_pages)
+            snap["free_slots"] = sum(1 for s in self._slots if s is None)
         snap["compile_cache_size"] = self.compile_cache_size()
         snap["prompt_buckets"] = list(self.prompt_buckets)
         snap["max_slots"] = self.max_slots
         snap["total_pages"] = self.total_pages
+        snap["pages_per_slot"] = self.program.pages_per_slot
         snap["prefix_cache"] = self._prefix_on
         snap["speculate_k"] = (self.speculate_k
                                if self._draft_program is not None else 0)
         snap["kv_dtype"] = self._kv_dtype or "float32"
+        snap["role"] = self.role
+        snap["tp"] = int(getattr(self.program, "tp", 1))
         return snap
 
     def health_snapshot(self) -> dict:

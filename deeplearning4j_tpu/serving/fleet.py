@@ -71,6 +71,7 @@ import numpy as np
 
 from ..obs import trace as obs_trace
 from .batcher import DeadlineExceededError, OverloadedError
+from .decode import PrefillHandoff
 from .engine import (PoisonInputError, ServingUnavailableError, _fail_safe,
                      _set_safe)
 from .metrics import FleetMetrics
@@ -121,12 +122,51 @@ class FleetHost:
         self.last_error: Optional[str] = None
         self.cached_queue_depth = 0    # from the host's /metrics snapshot
         self.depth_read_at: Optional[float] = None
+        self.cached_free_slots: Optional[int] = None   # decode-pool gauges,
+        self.cached_free_pages: Optional[int] = None   # same poll cadence
+        self.cached_pps = 0            # pages a full-length request needs
 
     def supports(self, kind: str) -> bool:
         return (self.decode if kind == "decode" else self.engine) is not None
 
     def engine_for(self, kind: str):
         return self.decode if kind == "decode" else self.engine
+
+    def decode_role(self) -> str:
+        """``unified`` | ``prefill`` | ``decode`` — engines predating
+        disaggregation default to unified."""
+        if self.decode is None:
+            return "unified"
+        return getattr(self.decode, "role", "unified")
+
+    def read_decode_pressure(self) -> None:
+        """Refresh the decode engine's free-capacity gauges (free slots
+        + free KV pages) from its own /metrics snapshot."""
+        if self.decode is None:
+            return
+        try:
+            snap = self.decode.metrics_snapshot()
+        except Exception as exc:
+            self.last_error = f"{type(exc).__name__}: {exc}"
+            return
+        fs, fp = snap.get("free_slots"), snap.get("free_pages")
+        self.cached_free_slots = int(fs) if fs is not None else None
+        self.cached_free_pages = int(fp) if fp is not None else None
+        self.cached_pps = int(snap.get("pages_per_slot", 0) or 0)
+
+    def decode_pressure(self) -> int:
+        """Score penalty from the host's own pool gauges: +1 when no
+        decode slot is free (the next admit waits a step), +1 when the
+        free list cannot hold one more full-length request.  Hosts that
+        never reported gauges (HTTP hosts on an old build) score 0 —
+        the pre-disaggregation ordering is unchanged."""
+        p = 0
+        if self.cached_free_slots is not None and self.cached_free_slots <= 0:
+            p += 1
+        if (self.cached_free_pages is not None and self.cached_pps
+                and self.cached_free_pages < self.cached_pps):
+            p += 1
+        return p
 
     def read_queue_depth(self) -> int:
         """The host's own occupancy signal: ``queue_depth`` out of its
@@ -357,7 +397,10 @@ class FleetRouter:
             snap["hosts"] = {
                 hid: {"state": h.state, "inflight": h.inflight,
                       "queue_depth": h.cached_queue_depth,
-                      "failures": h.failures}
+                      "failures": h.failures,
+                      "role": h.decode_role(),
+                      "free_slots": h.cached_free_slots,
+                      "free_pages": h.cached_free_pages}
                 for hid, h in self._hosts.items()}
             snap["queue_depth"] = sum(
                 h.inflight for h in self._hosts.values())
@@ -385,26 +428,34 @@ class FleetRouter:
         self._dispatch(spec)
         return fut
 
-    def _pick_host_locked(self, spec) -> Optional[FleetHost]:
+    def _pick_host_locked(self, spec,
+                          sink: bool = False) -> Optional[FleetHost]:
+        # disaggregated decode routes in two stages: a raw prompt goes
+        # to a prefill/unified host (sink=False — decode-role hosts
+        # cannot prefill), a PrefillHandoff to a decode-role sink
         cands = [h for h in self._hosts.values()
-                 if h.state == "up" and h.supports(spec.kind)]
+                 if h.state == "up" and h.supports(spec.kind)
+                 and (spec.kind != "decode"
+                      or (h.decode_role() == "decode") == sink)]
         if not cands:
             return None
         if spec.session is not None:
             host = self._ring_lookup_locked(spec.session, spec.kind,
-                                            spec.tried)
+                                            spec.tried, sink)
             if host is not None:
                 self.metrics.inc("affinity_routed")
                 return host
         fresh = [h for h in cands if h.host_id not in spec.tried] or cands
         score = {h.host_id: h.inflight + h.cached_queue_depth
+                 + (h.decode_pressure() if spec.kind == "decode" else 0)
                  for h in fresh}
         best = min(score[h.host_id] for h in fresh)
         tied = [h for h in fresh if score[h.host_id] == best]
         self._rr += 1
         return tied[self._rr % len(tied)]
 
-    def _ring_lookup_locked(self, key, kind, tried) -> Optional[FleetHost]:
+    def _ring_lookup_locked(self, key, kind, tried,
+                            sink: bool = False) -> Optional[FleetHost]:
         if not self._ring:
             return None
         h = _hash64(str(key))
@@ -419,6 +470,8 @@ class FleetRouter:
                 seen.add(hid)
                 host = self._hosts[hid]
                 if (host.state == "up" and host.supports(kind)
+                        and (kind != "decode"
+                             or (host.decode_role() == "decode") == sink)
                         and (allow_tried or hid not in tried)):
                     return host
         return None
@@ -481,11 +534,64 @@ class FleetRouter:
                     self.metrics.inc("late_discards")
                 return
             if exc is None:
-                self._deliver(attempt, inner.result())
+                result = inner.result()
+                if (attempt.spec.kind == "decode"
+                        and isinstance(result, PrefillHandoff)):
+                    # stage 1 of a disaggregated generation: the
+                    # prefill host handed back KV pages, not tokens
+                    self._dispatch_decode_stage(attempt, result)
+                else:
+                    self._deliver(attempt, result)
             else:
                 self._handle_failure(attempt.spec, host, exc)
         except BaseException as exc:
             _fail_safe(attempt.spec.future, exc)
+
+    def _dispatch_decode_stage(self, attempt, handoff) -> None:
+        """Stage 2 of a disaggregated generation: transfer the
+        ``PrefillHandoff``'s packed KV pages to a ``role="decode"`` sink
+        and chain its future to the caller's.  A failed (or absent) sink
+        re-enters ``_handle_failure``, whose retry restarts from stage 1
+        — seeded counter-based sampling makes the re-run bit-identical,
+        so at-most-once delivery still holds via ``_set_safe``."""
+        spec = attempt.spec
+        t0 = self.clock()
+        with self._lock:
+            attempt.host.failures = 0      # stage 1 succeeded
+            sink = self._pick_host_locked(spec, sink=True)
+            if sink is not None:
+                sink.inflight += 1
+                self._aid += 1
+                timeout_at = (self.clock() + self.request_timeout_s
+                              if self.request_timeout_s else None)
+                a2 = _Attempt(self._aid, spec, sink, self.clock(),
+                              timeout_at)
+                self._outstanding[a2.aid] = a2
+        if sink is None:
+            self.metrics.inc("shed")
+            _fail_safe(spec.future, OverloadedError(
+                "no decode-role sink host up for the prefill handoff"))
+            return
+        self.metrics.inc("dispatched")
+        try:
+            inner = sink.decode.continue_async(handoff, slo_ms=spec.slo_ms)
+        except BaseException as exc:
+            with self._lock:
+                sink.inflight = max(0, sink.inflight - 1)
+                a2.settled = True
+                self._outstanding.pop(a2.aid, None)
+                self._idle_cv.notify_all()
+            self._handle_failure(spec, sink, exc)
+            return
+        self.metrics.inc("disagg_requests")
+        self.metrics.inc("page_transfers")
+        self.metrics.inc("transfer_bytes", len(handoff.pages))
+        obs_trace.complete_at(
+            "fleet/page_transfer", t0, self.clock(), cat="fleet",
+            src=attempt.host.host_id, dst=sink.host_id,
+            pages=int(handoff.n_pages), nbytes=len(handoff.pages))
+        inner.add_done_callback(
+            lambda f, a=a2: self._on_inner_done(a, f))
 
     def _deliver(self, attempt, result) -> None:
         spec, host = attempt.spec, attempt.host
@@ -586,6 +692,7 @@ class FleetRouter:
             hosts = [h for h in self._hosts.values() if h.state != "down"]
         for h in hosts:
             depth = h.read_queue_depth()
+            h.read_decode_pressure()
             with self._lock:
                 h.cached_queue_depth = depth
                 h.depth_read_at = now

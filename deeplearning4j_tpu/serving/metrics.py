@@ -181,6 +181,7 @@ _FLEET_COUNTER_KEYS = (
     "timeouts", "late_discards", "affinity_routed",
     "host_failures", "host_down", "host_up",
     "drains", "preempt_drains", "rolling_swaps", "swap_hosts", "rollbacks",
+    "disagg_requests", "page_transfers", "transfer_bytes",
 )
 
 
@@ -250,6 +251,8 @@ _DECODE_COUNTER_KEYS = (
     "prefix_hits", "prefix_misses", "prefix_inserts",
     "prefix_evictions", "prefix_hit_tokens",
     "spec_steps", "spec_proposed", "spec_accepted", "spec_committed",
+    "handoffs_out", "handoffs_in",
+    "pages_exported", "pages_attached", "pages_deduped",
 )
 
 
@@ -281,6 +284,10 @@ class DecodeMetrics:
         self.pages_in_use.set(0)
         self.shared_pages = self.registry.gauge("shared_pages")
         self.shared_pages.set(0)
+        self.free_pages = self.registry.gauge("free_pages")
+        self.free_pages.set(0)
+        self.free_slots = self.registry.gauge("free_slots")
+        self.free_slots.set(0)
         self._t0 = time.monotonic()
         self.global_name = get_registry().register_collector(
             "decode", self.snapshot, unique=True)
@@ -311,6 +318,8 @@ class DecodeMetrics:
             "active_slots": int(self.active_slots.value()),
             "pages_in_use": int(self.pages_in_use.value()),
             "shared_pages": int(self.shared_pages.value()),
+            "free_pages": int(self.free_pages.value()),
+            "free_slots": int(self.free_slots.value()),
             "accepted_tokens_per_step": round(
                 c["spec_committed"] / c["spec_steps"], 4)
             if c.get("spec_steps") else None,

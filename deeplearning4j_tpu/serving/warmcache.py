@@ -55,6 +55,41 @@ BUNDLE_SUFFIX = ".warm"
 _enabled_dir: Optional[str] = None
 
 
+def _harden_cache_writes() -> None:
+    """Make jax's file-system compile-cache writes atomic.
+
+    jax's LRU file cache ``put`` is a bare ``write_bytes`` (its
+    filelock only engages when eviction is on), so a process killed
+    mid-write — exactly what a preempted or chaos-killed fleet worker
+    is — strands a HALF-WRITTEN executable that a later process
+    deserializes as garbage and crashes on.  Route every put through
+    write-to-temp + ``os.replace`` in the same directory: an entry is
+    either absent or complete, never partial.  Identical concurrent
+    writers are benign (same HLO key ⇒ same bytes; last rename wins).
+    """
+    try:
+        from jax._src import lru_cache as _lru
+    except Exception:
+        # best-effort: a jax without this private module keeps stock
+        # writes — the cache still works, just unhardened
+        return
+    if getattr(_lru.LRUCache.put, "_dl4j_atomic", False):
+        return
+
+    def _atomic_put(self, key, val):
+        if not key:
+            raise ValueError("key cannot be empty")
+        cache_path = self.path / f"{key}{_lru._CACHE_SUFFIX}"
+        if cache_path.exists():
+            return
+        tmp = self.path / f"{key}.tmp.{os.getpid()}"
+        tmp.write_bytes(val)
+        os.replace(tmp, cache_path)
+
+    _atomic_put._dl4j_atomic = True
+    _lru.LRUCache.put = _atomic_put
+
+
 def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
     """Enable the JAX persistent compilation cache process-wide.
 
@@ -71,6 +106,7 @@ def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
     d = os.path.abspath(d)
     if _enabled_dir == d:
         return d
+    _harden_cache_writes()
     os.makedirs(d, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", d)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
@@ -90,19 +126,30 @@ def enable_compile_cache(cache_dir: Optional[str] = None) -> Optional[str]:
     return d
 
 
-def device_fingerprint() -> str:
+def device_fingerprint(mesh: Optional[Any] = None) -> str:
     """Identity of the device set an AOT executable is valid for.
 
     Serialized executables are XLA programs compiled for specific
     hardware; loading one on a different backend/topology is undefined.
     The fingerprint pins backend platform, device kind, device count,
     and the jax version that produced the serialization format.
+
+    ``mesh`` (optional) appends a ``mesh(axis=size,...)`` component for
+    executables compiled against a named mesh — sharded-decode programs
+    are partitioned per mesh topology, so a bundle built on
+    ``data=2`` must never load into a ``data=4`` (or unmeshed) process.
+    Omitting it keeps the historical 4-field format, so single-device
+    bundles stay loadable across this change.
     """
     devs = jax.devices()
     kind = devs[0].device_kind if devs else "none"
-    return "|".join(
-        [jax.default_backend(), str(kind), str(len(devs)), jax.__version__]
-    )
+    parts = [jax.default_backend(), str(kind), str(len(devs)),
+             jax.__version__]
+    if mesh is not None:
+        axes = ",".join(f"{n}={int(s)}"
+                        for n, s in dict(mesh.shape).items())
+        parts.append(f"mesh({axes})")
+    return "|".join(parts)
 
 
 def bundle_path_for(checkpoint_path: str) -> str:
@@ -110,7 +157,8 @@ def bundle_path_for(checkpoint_path: str) -> str:
     return str(checkpoint_path) + BUNDLE_SUFFIX
 
 
-def save_bundle(path: str, tag: str, entries: Dict[str, Any]) -> str:
+def save_bundle(path: str, tag: str, entries: Dict[str, Any],
+                mesh: Optional[Any] = None) -> str:
     """Serialize AOT ``entries`` ({key: compiled executable}) to ``path``.
 
     Zip layout mirrors the checkpoint serializer: a ``meta.json``
@@ -118,7 +166,9 @@ def save_bundle(path: str, tag: str, entries: Dict[str, Any]) -> str:
     per-entry sha256 integrity digests, plus one pickled
     ``(payload, in_tree, out_tree)`` blob per executable.  Written
     atomically (tmp + rename) so a crash mid-save never leaves a
-    half-bundle where a valid one was.
+    half-bundle where a valid one was.  ``mesh``: pass the named mesh
+    the executables were partitioned over (sharded decode) so the
+    fingerprint pins its topology; None for single-device programs.
     """
     from jax.experimental import serialize_executable as _se
 
@@ -132,7 +182,7 @@ def save_bundle(path: str, tag: str, entries: Dict[str, Any]) -> str:
     meta = {
         "format_version": BUNDLE_FORMAT_VERSION,
         "tag": tag,
-        "fingerprint": device_fingerprint(),
+        "fingerprint": device_fingerprint(mesh),
         "jax_version": jax.__version__,
         "entries": names,
         "integrity": {e: hashlib.sha256(b).hexdigest() for e, b in blobs.items()},
@@ -150,14 +200,18 @@ class _BundleMiss(Exception):
     """Internal: a specific reason the bundle can't be used."""
 
 
-def load_bundle(path: Optional[str], tag: Optional[str] = None) -> Dict[str, Any]:
+def load_bundle(path: Optional[str], tag: Optional[str] = None,
+                mesh: Optional[Any] = None) -> Dict[str, Any]:
     """Load a warmup bundle; return {} on ANY miss, never raise.
 
     An absent file is the normal cold-start case and stays silent.  An
     existing-but-unusable bundle (truncated/corrupt zip, integrity or
     fingerprint or tag or jax-version mismatch, undeserializable entry)
     emits exactly one ``RuntimeWarning`` naming the reason, then returns
-    {} so the caller compiles as if no bundle existed.
+    {} so the caller compiles as if no bundle existed.  ``mesh`` must
+    match what the bundle was saved with (the fingerprint carries the
+    mesh topology component) — a differently-meshed bundle falls back
+    to compile under the same one-warning contract.
     """
     if not path or not os.path.exists(path):
         return {}
@@ -176,7 +230,7 @@ def load_bundle(path: Optional[str], tag: Optional[str] = None) -> Dict[str, Any
                 raise _BundleMiss(
                     f"jax {meta.get('jax_version')!r} != {jax.__version__!r}"
                 )
-            fp = device_fingerprint()
+            fp = device_fingerprint(mesh)
             if meta.get("fingerprint") != fp:
                 raise _BundleMiss(
                     f"device fingerprint {meta.get('fingerprint')!r} != {fp!r}"

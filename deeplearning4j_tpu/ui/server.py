@@ -153,6 +153,14 @@ class UIServer:
         if self._decode_engine is None:
             return 503, {"error": "no decode engine attached",
                          "error_class": "unavailable"}
+        if getattr(self._decode_engine, "role", "unified") == "prefill":
+            # a prefill-role host emits page-handoff batons, not tokens —
+            # only a FleetRouter can route those to a decode-role sink
+            return 409, {"error": "this host is a prefill-role engine; "
+                                  "its output is a KV-page handoff, not "
+                                  "tokens — send /generate traffic to a "
+                                  "fleet router or a unified/decode host",
+                         "error_class": "prefill_role"}
         try:
             payload = json.loads(body)
             res = self._decode_engine.generate(

@@ -52,6 +52,14 @@ Gates (consumed by bench.py ``fleet_load_chaos``):
     reports rolled_back with the killed host down
   - orphans == 0: after shutdown the router carries zero in-flight
 
+A separate ``--disagg`` mode (bench config ``disagg_decode_ab``) runs
+ONLY the disaggregated prefill/decode arm: temp-0 token bit-identity
+across unified / disaggregated / tensor-parallel serving shapes, the
+prefill-burst TPOT A/B (the burst stalls a unified host's decode loop
+but not a disaggregated decode host), a prefill-host kill with
+exactly-once delivery + decode free-list partition gates, and the
+zero-serve-time-compiles check on the decode host.
+
 Last stdout line is the JSON result (the bench subprocess contract).
 """
 
@@ -70,6 +78,15 @@ from typing import Iterator, List, Optional, Tuple
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# the --disagg arm's tensor-parallel identity leg needs >= 2 devices;
+# force the virtual-device split BEFORE jax imports (same trick as
+# tests/conftest.py)
+if "--disagg" in sys.argv and "xla_force_host_platform_device_count" \
+        not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=2"
+                               ).strip()
 
 QUICK = "--quick" in sys.argv or os.environ.get("BENCH_QUICK", "0") == "1"
 
@@ -579,17 +596,286 @@ def run_scale_arm(n_requests: int) -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# the --disagg arm: disaggregated prefill/decode + tensor-parallel decode
+# (bench config ``disagg_decode_ab``)
+# ---------------------------------------------------------------------------
+
+def _disagg_lm(max_len: int, tp: bool = False):
+    """A tiny seeded transformer LM; ``tp=True`` builds it over a
+    2-device data mesh (decode_program shards heads over it)."""
+    import jax
+
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+    from deeplearning4j_tpu.parallel.transformer import ShardedTransformerLM
+
+    devs = jax.devices()[:2] if tp else jax.devices()[:1]
+    mesh = build_mesh({"data": len(devs), "pipe": 1, "model": 1}, devs)
+    return ShardedTransformerLM(vocab_size=48, n_layers=2, d_model=32,
+                                n_heads=2, max_len=max_len, mesh=mesh,
+                                seed=11)
+
+
+def _disagg_engine(lm, role="unified", max_slots=4, page_size=8):
+    from deeplearning4j_tpu.serving import DecodeEngine
+    return DecodeEngine(lm, max_slots=max_slots, page_size=page_size,
+                        default_max_new=8, max_queue=100_000,
+                        admission="shed", role=role).load()
+
+
+def run_disagg_identity(n_requests: int) -> dict:
+    """Temp-0 token bit-identity across the three serving shapes:
+    unified single host, disaggregated prefill→decode through the
+    router, and a tensor-parallel (2-shard) unified engine."""
+    import jax
+
+    from deeplearning4j_tpu.serving import FleetHost, FleetRouter
+
+    max_len = 64
+    rng = np.random.default_rng(5)
+    prompts = [rng.integers(0, 48, size=int(rng.integers(3, 24))).tolist()
+               for _ in range(n_requests)]
+
+    lm = _disagg_lm(max_len)
+    uni = _disagg_engine(lm)
+    ref = [uni.generate(p, max_new_tokens=8, seed=i).tokens
+           for i, p in enumerate(prompts)]
+    uni.shutdown()
+
+    pre = _disagg_engine(lm, role="prefill")
+    dec = _disagg_engine(lm, role="decode")
+    router = FleetRouter([FleetHost("pre0", decode=pre),
+                          FleetHost("dec0", decode=dec)], max_retries=2)
+    got = [router.generate(p, max_new_tokens=8, seed=i).tokens
+           for i, p in enumerate(prompts)]
+    rsnap = router.metrics_snapshot()
+    router.shutdown(shutdown_hosts=True)
+
+    tp_ok = True
+    tp_shard_frac = None
+    if len(jax.devices()) >= 2:
+        lm2 = _disagg_lm(max_len, tp=True)
+        e2 = _disagg_engine(lm2)
+        got_tp = [e2.generate(p, max_new_tokens=8, seed=i).tokens
+                  for i, p in enumerate(prompts)]
+        kp, _ = e2._cache
+        shard = kp.sharding.shard_shape(kp.shape)
+        tp_shard_frac = (int(np.prod(shard)) / int(np.prod(kp.shape)))
+        tp_ok = (got_tp == ref and abs(tp_shard_frac - 0.5) < 1e-9)
+        e2.shutdown()
+
+    return {"identity_requests": n_requests,
+            "identity_disagg_bitwise": bool(got == ref),
+            "identity_tp_bitwise": bool(tp_ok),
+            "identity_tp_shard_frac": tp_shard_frac,
+            "identity_page_transfers": rsnap["counters"]["page_transfers"],
+            "identity_ok": bool(got == ref and tp_ok
+                                and rsnap["counters"]["page_transfers"]
+                                == n_requests)}
+
+
+def _tpot_phases(submit, n_probe: int, burst_prompts, max_new: int,
+                 seed0: int):
+    """Run the calm and burst TPOT phases against one serving shape.
+    ``submit(prompt, max_new, seed)`` returns a generation future.
+    Probes are short-prompt long-decode requests; the burst is a wall
+    of long-prompt prefill-heavy requests injected while the second
+    probe wave is mid-decode."""
+    probe_prompt = [3, 1, 4, 1]
+    for f in [submit(probe_prompt, max_new, seed0 + 300 + i)
+              for i in range(n_probe)]:   # discarded ramp wave
+        f.result(timeout=120)
+    calm = [submit(probe_prompt, max_new, seed0 + i)
+            for i in range(n_probe)]
+    tpot_calm = [f.result(timeout=120).tpot_ms for f in calm]
+
+    probes = [submit(probe_prompt, max_new, seed0 + 100 + i)
+              for i in range(n_probe)]
+    time.sleep(0.05)           # probes admitted + decoding when it hits
+    burst = [submit(p, 1, seed0 + 200 + i)
+             for i, p in enumerate(burst_prompts)]
+    tpot_burst = [f.result(timeout=120).tpot_ms for f in probes]
+    for f in burst:
+        f.result(timeout=120)
+    calm_v = [t for t in tpot_calm if t is not None]
+    burst_v = [t for t in tpot_burst if t is not None]
+    return {"tpot_calm_p99_ms": round(_p99(calm_v), 3),
+            "tpot_burst_p99_ms": round(_p99(burst_v), 3),
+            "tpot_calm_ms": [round(t, 3) for t in calm_v],
+            "tpot_burst_ms": [round(t, 3) for t in burst_v]}
+
+
+def run_disagg_burst(n_probe: int, n_burst: int) -> dict:
+    """The headline A/B: a prefill burst on a unified host stalls
+    co-batched decodes (prefill and step share the loop); the same
+    burst against a disaggregated pair lands on the prefill host while
+    the decode host keeps stepping.  Gate: disagg TPOT p99 under burst
+    stays within 1.2x of its calm p99, while the unified arm degrades
+    beyond that."""
+    from deeplearning4j_tpu.serving import FleetHost, FleetRouter
+
+    max_len = 256
+    rng = np.random.default_rng(9)
+    burst_prompts = [rng.integers(0, 48, size=180).tolist()
+                     for _ in range(n_burst)]
+    max_new = 160              # probes decode throughout the burst
+
+    lm = _disagg_lm(max_len)
+
+    # Wall-clock gates on a noisy shared box: one bounded re-measure
+    # before declaring the A/B broken (same policy as the latency
+    # gates in the main soak arms).
+    out = {}
+    for attempt in range(2):
+        # Slots > probe count so burst prefills co-batch with live
+        # decodes on the unified host instead of queueing behind the
+        # probes.
+        uni = _disagg_engine(lm, max_slots=2 * n_probe)
+        uni_router = FleetRouter([FleetHost("u0", decode=uni)],
+                                 max_retries=2)
+        u = _tpot_phases(
+            lambda p, mn, s: uni_router.generate_async(
+                p, max_new_tokens=mn, seed=s),
+            n_probe, burst_prompts, max_new, seed0=0)
+        uni_router.shutdown(shutdown_hosts=True)
+
+        pre = _disagg_engine(lm, role="prefill", max_slots=2 * n_probe)
+        dec = _disagg_engine(lm, role="decode", max_slots=2 * n_probe)
+        dis_router = FleetRouter([FleetHost("pre0", decode=pre),
+                                  FleetHost("dec0", decode=dec)],
+                                 max_retries=2)
+        ccs_before = dec.compile_cache_size()
+        d = _tpot_phases(
+            lambda p, mn, s: dis_router.generate_async(
+                p, max_new_tokens=mn, seed=s),
+            n_probe, burst_prompts, max_new, seed0=0)
+        ccs_after = dec.compile_cache_size()
+        dis_router.shutdown(shutdown_hosts=True)
+
+        out = {
+            "burst_requests": n_burst, "probe_requests": 2 * n_probe,
+            "burst_attempts": attempt + 1,
+            "unified_tpot_calm_p99_ms": u["tpot_calm_p99_ms"],
+            "unified_tpot_burst_p99_ms": u["tpot_burst_p99_ms"],
+            "disagg_tpot_calm_p99_ms": d["tpot_calm_p99_ms"],
+            "disagg_tpot_burst_p99_ms": d["tpot_burst_p99_ms"],
+            "decode_compiles_before": ccs_before,
+            "decode_compiles_after": ccs_after,
+        }
+        out["unified_degraded"] = bool(
+            u["tpot_burst_p99_ms"] > 1.2 * u["tpot_calm_p99_ms"])
+        out["disagg_tpot_ok"] = bool(
+            d["tpot_burst_p99_ms"] <= 1.2 * d["tpot_calm_p99_ms"])
+        out["decode_zero_compiles"] = bool(ccs_before == ccs_after)
+        out["burst_ok"] = bool(out["unified_degraded"]
+                               and out["disagg_tpot_ok"]
+                               and out["decode_zero_compiles"])
+        if out["burst_ok"]:
+            break
+    return out
+
+
+def run_disagg_chaos(n_requests: int) -> dict:
+    """Kill a prefill host mid-run: every submitted future must still
+    resolve exactly once, retried requests must land the SAME tokens
+    (seeded sampling), and the decode host's page accounting must stay
+    a clean free/private/trie partition."""
+    from deeplearning4j_tpu.serving import FleetHost, FleetRouter
+
+    max_len = 64
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, 48, size=int(rng.integers(3, 24))).tolist()
+               for _ in range(n_requests)]
+
+    lm = _disagg_lm(max_len)
+    uni = _disagg_engine(lm)
+    ref = [uni.generate(p, max_new_tokens=8, seed=i).tokens
+           for i, p in enumerate(prompts)]
+    uni.shutdown()
+
+    pre0 = _disagg_engine(lm, role="prefill")
+    pre1 = _disagg_engine(lm, role="prefill")
+    dec = _disagg_engine(lm, role="decode")
+    router = FleetRouter([FleetHost("pre0", decode=pre0),
+                          FleetHost("pre1", decode=pre1),
+                          FleetHost("dec0", decode=dec)], max_retries=3)
+    resolutions: dict = {}
+    lock = threading.Lock()
+    futs = []
+    for i, p in enumerate(prompts):
+        f = router.generate_async(p, max_new_tokens=8, seed=i)
+
+        def cb(fut, rid=i):
+            with lock:
+                resolutions[rid] = resolutions.get(rid, 0) + 1
+        f.add_done_callback(cb)
+        futs.append(f)
+        if i == n_requests // 3:
+            # the kill: one prefill host dies with traffic in flight —
+            # its engine fails every future, the router re-routes
+            pre0.shutdown()
+            router.mark_host_down("pre0", reason="chaos-kill")
+    results = []
+    for f in futs:
+        try:
+            results.append(f.result(timeout=120))
+        except Exception as exc:  # typed failure still counts as resolved
+            results.append(exc)
+    tokens_ok = all(not isinstance(r, Exception) and r.tokens == ref[i]
+                    for i, r in enumerate(results))
+    stranded = sum(1 for f in futs if not f.done())
+    double = sum(1 for c in resolutions.values() if c > 1)
+    st = dec._debug_page_state()
+    partition_ok = (sorted(st["free"] + st["private"] + st["trie"])
+                    == list(range(1, dec.total_pages)))
+    snap = router.metrics_snapshot()
+    router.shutdown(shutdown_hosts=True)
+    return {"chaos_disagg_requests": n_requests,
+            "chaos_disagg_stranded": int(stranded),
+            "chaos_disagg_double_delivered": int(double),
+            "chaos_disagg_tokens_ok": bool(tokens_ok),
+            "chaos_disagg_partition_ok": bool(partition_ok),
+            "chaos_disagg_retries": snap["counters"]["retries"],
+            "chaos_disagg_ok": bool(stranded == 0 and double == 0
+                                    and tokens_ok and partition_ok)}
+
+
+def run_disagg_arm(quick: bool) -> dict:
+    out = {}
+    out.update(run_disagg_identity(6 if quick else 16))
+    out.update(run_disagg_burst(n_probe=4 if quick else 6,
+                                n_burst=8 if quick else 12))
+    out.update(run_disagg_chaos(12 if quick else 24))
+    out["disagg_ok"] = bool(out["identity_ok"] and out["burst_ok"]
+                            and out["chaos_disagg_ok"])
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--requests", type=int, default=None,
                     help="chaos-arm request count")
     ap.add_argument("--scale-requests", type=int, default=None)
+    ap.add_argument("--disagg", action="store_true",
+                    help="run ONLY the disaggregated prefill/decode arm "
+                    "(bench config disagg_decode_ab)")
     args = ap.parse_args()
 
     import jax
 
     quick = args.quick or QUICK
+
+    if args.disagg:
+        print(f"fleet_load_soak --disagg: "
+              f"platform={jax.devices()[0].platform}, "
+              f"devices={len(jax.devices())}", file=sys.stderr)
+        out = {"config": "disagg_decode_ab",
+               "platform": jax.devices()[0].platform, "quick": quick}
+        out.update(run_disagg_arm(quick))
+        print(json.dumps(out), flush=True)
+        return 0 if out["disagg_ok"] else 2
+
     n_chaos = args.requests or (240 if quick else 600)
     n_off = 60 if quick else 150
     n_scale = args.scale_requests or (50_000 if quick else 1_000_000)

@@ -21,7 +21,107 @@ import jax  # noqa: E402  (may already be imported by sitecustomize — fine)
 
 jax.config.update("jax_platforms", "cpu")
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _repo_root)
+
+import pytest  # noqa: E402
+
+# Persistent compile cache, scoped to an allowlist of test modules.
+#
+# Compilation dominates the suite's wall clock: every DecodeEngine /
+# fleet host load AOT-compiles ~10 executables, and the trainer tests
+# re-jit the same tiny models across modules and runs.  Pointing the
+# repo's own warmcache.enable_compile_cache at a gitignored dir under
+# the repo makes repeat runs (and the tier-1 verify) hit warm
+# executables — measured ~3x faster on test_decode and
+# test_pipeline_1f1b, 2.5x on test_parallelism_4d, bit-identical by
+# construction (the cache stores serialized XLA executables keyed by
+# HLO).  The serving executables are the same ones PR 15's warmup
+# bundles serialize/deserialize in production, so their reload path is
+# battle-tested.
+#
+# Allowlisted, not suite-wide, deliberately: on this jaxlib build SOME
+# trainer-side executables (two-tier compression, the chaos-guarded
+# train step) segfault nondeterministically at execution time when
+# reloaded from the on-disk cache — reproduced with clean,
+# fully-written entries.  Every module below was validated by a
+# fresh-cache cold run followed by a fully-warm rerun; the unsafe
+# modules run with the cache off.
+# (enable_compile_cache also hardens jax's cache writes to temp+rename
+# — this suite SIGKILLs workers mid-step, and a stranded half-written
+# entry would otherwise deserialize as garbage.)
+_CACHE_SAFE_MODULES = {
+    "test_attention",
+    "test_backend_parity",
+    "test_data_records",
+    "test_decode",
+    "test_decode_speed",
+    "test_disagg",
+    "test_examples",
+    "test_fit_batches",
+    "test_fleet",
+    "test_graph_recurrent",
+    "test_lstm_kernel",
+    "test_moe",
+    "test_parallelism_4d",
+    "test_pipeline_1f1b",
+    "test_regularizers_solvers",
+    "test_serving_resilience",
+    "test_ulysses",
+    "test_updaters_bf16",
+    "test_zoo",
+}
+# test_warmcache is deliberately absent: it exercises the warmup-bundle
+# machinery itself, and on this jaxlib serialize_executable on an
+# executable that was RELOADED from the compile cache emits a payload
+# with dangling fusion symbols ("Symbols not found" at deserialize) —
+# bundles must be built from cold-compiled executables.
+# test_multichip_scale is absent too: its subprocesses re-run the same
+# program at DIFFERENT device counts (8 -> 16), and a warm reload
+# across that boundary trained wrong (silent bad numerics, not a
+# crash) on this jaxlib.
+_CACHE_DIR = (os.environ.get("DL4J_TPU_COMPILE_CACHE")
+              or os.path.join(_repo_root, ".cache", "jax-compile"))
+
+
+def _cache_on():
+    from deeplearning4j_tpu.serving.warmcache import enable_compile_cache
+    enable_compile_cache(_CACHE_DIR)
+    # jax reads these natively at import, so plain-jax subprocesses the
+    # allowlisted modules spawn (example scripts) warm up too
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = _CACHE_DIR
+    os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"] = "0"
+
+
+def _cache_off():
+    from deeplearning4j_tpu.serving import warmcache
+    # also un-export the env vars so trainer-side worker subprocesses
+    # (chaos / launcher) never self-enable on the unsafe executables
+    os.environ.pop(warmcache.ENV_VAR, None)
+    os.environ.pop("JAX_COMPILATION_CACHE_DIR", None)
+    os.environ.pop("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", None)
+    if warmcache._enabled_dir is None:
+        return
+    jax.config.update("jax_compilation_cache_dir", None)
+    warmcache._enabled_dir = None
+    try:
+        from jax.experimental.compilation_cache import (
+            compilation_cache as _cc,
+        )
+        _cc.reset_cache()
+    except Exception:
+        pass
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _scoped_compile_cache(request):
+    name = request.module.__name__.rpartition(".")[2]
+    if name in _CACHE_SAFE_MODULES:
+        _cache_on()
+    else:
+        _cache_off()
+    yield
+    _cache_off()
 
 
 def pytest_configure(config):
