@@ -89,6 +89,17 @@ stderr, including:
     exactly-once same-tokens delivery with clean page accounting
     through a prefill-host kill (docs/SERVING.md "Disaggregated and
     sharded decode")
+  - train_promote_loop: the production-flywheel gate
+    (scripts/train_promote_soak.py) — a PromotionPipeline drives six
+    train -> eval -> register -> canary -> roll generations against a
+    live 3-host fleet under open-loop traffic with chaos at every
+    stage (device loss mid-train, NaN params, a regressed generation,
+    a host kill mid-roll, a controller crash at the canary), hard-
+    gated on three promotions with monotone eval, lineage-target
+    rollback (never version-1), the eval/canary gates each catching
+    their regression, crash-resume without retraining, zero dropped/
+    stranded/version-mixed requests, and zero serve-time compiles
+    (docs/LIFECYCLE.md)
   - decode_tokens_per_sec: the autoregressive-decode A/B gate
     (scripts/decode_ab.py) — static-batch full-re-encode decoding vs
     serving.DecodeEngine (paged KV-cache, bucketed prefill/decode split,
@@ -1290,6 +1301,91 @@ def bench_disagg_decode():
             "double_delivered": 0, "decode_zero_compiles": True}
 
 
+def bench_train_promote():
+    """Config 25: the train→promote flywheel gate
+    (scripts/train_promote_soak.py; CPU subprocess — the lifecycle
+    control flow under test is host-side).  A PromotionPipeline drives
+    six train → eval → register → canary → roll generations against a
+    live 3-host fleet under concurrent open-loop traffic, with chaos at
+    every stage boundary: device-loss faults mid-train (recovered), a
+    NaN-params generation (the EVAL gate must catch it), a regressed
+    generation (the CANARY must reject it on prediction divergence), a
+    host killed mid-roll (survivors roll back, the pipeline re-aliases
+    to the LINEAGE target — never version−1), and a controller crash at
+    the canary stage (a fresh pipeline resumes from the journal without
+    retraining).  HARD gates: exactly three promoted generations with
+    monotone (non-increasing) eval losses, both rollbacks land on the
+    lineage-selected ancestor, zero dropped/stranded/double-delivered
+    requests, zero unmatched responses and zero version mixing inside
+    steady windows, zero serve-time compiles (warm bundles cover fleet
+    birth, canary warm, every roll and every rollback), and the
+    crash-resume completes with exactly one training run for the
+    interrupted generation.  The reported value is promoted generations
+    per wall-minute."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    script = os.path.join(_REPO, "scripts", "train_promote_soak.py")
+    cmd = [sys.executable, script] + (["--quick"] if QUICK else [])
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=1800, cwd=_REPO)
+    if p.returncode != 0:
+        raise RuntimeError(f"train_promote_soak failed (rc={p.returncode}): "
+                           f"{p.stdout[-500:]} {p.stderr[-1000:]}")
+    soak = json.loads(p.stdout.strip().splitlines()[-1])
+    if soak.get("promoted_generations") != [1, 2, 6]:
+        raise RuntimeError("flywheel promoted the wrong generations "
+                           f"(want [1, 2, 6]): {soak}")
+    if not soak.get("monotone_eval"):
+        raise RuntimeError(f"promoted eval losses are not monotone: {soak}")
+    if not soak.get("nan_caught_by_eval"):
+        raise RuntimeError("the EVAL gate missed the NaN-params "
+                           f"generation: {soak}")
+    if not soak.get("canary_rejected_regression"):
+        raise RuntimeError("the canary promoted the regressed "
+                           f"generation: {soak}")
+    if not soak.get("midroll_kill_rolled_back"):
+        raise RuntimeError("mid-roll host kill did not roll the "
+                           f"generation back: {soak}")
+    if not soak.get("rollbacks_hit_lineage_target") \
+            or not soak.get("lineage_chain_ok"):
+        raise RuntimeError("rollback missed the lineage target "
+                           f"(or picked version-1): {soak}")
+    if not soak.get("resume_ok"):
+        raise RuntimeError("controller crash-resume gate FAILED "
+                           f"(retrained or stalled): {soak}")
+    if soak.get("stranded") != 0 or soak.get("double_delivered") != 0 \
+            or soak.get("errors"):
+        raise RuntimeError(f"flywheel dropped/duplicated traffic: {soak}")
+    if soak.get("unmatched_versions") != 0 \
+            or soak.get("window_violations") != 0 \
+            or not soak.get("window_samples"):
+        raise RuntimeError(f"version-mixing gate FAILED: {soak}")
+    if soak.get("serve_time_bundle_misses") != 0 \
+            or not soak.get("compile_cache_stable"):
+        raise RuntimeError("serve-time compile gate FAILED (a fleet "
+                           f"host missed its warm bundle): {soak}")
+    if not soak.get("fleet_converged") or not soak.get("soak_ok"):
+        raise RuntimeError(f"train_promote_loop gate FAILED: {soak}")
+    n_promoted = len(soak["promoted_generations"])
+    return {"metric": "train_promote_loop",
+            "value": round(n_promoted / (soak["wall_seconds"] / 60.0), 2),
+            "unit": "promotions/min",
+            "platform": soak["platform"],
+            "generations": len(soak["generations"]),
+            "promoted": n_promoted,
+            "promoted_losses": soak["promoted_losses"],
+            "requests": soak["n_submitted"],
+            "window_samples": soak["window_samples"],
+            "p99_ms": soak["p99_ms"],
+            "bundle_hits": soak["bundle_hits"],
+            "stranded": 0, "double_delivered": 0,
+            "serve_time_bundle_misses": 0,
+            "wall_seconds": soak["wall_seconds"]}
+
+
 def bench_chaos_recovery():
     """Config 11: chaos-tested fault recovery (scripts/chaos_soak.py; the
     subprocess mechanism, CPU — fault injection needs no accelerator).  A
@@ -1918,7 +2014,8 @@ def main() -> None:
                      ("continuous_batching_ab", bench_continuous_batching),
                      ("cold_start_ab", bench_cold_start),
                      ("decode_speed_ab", bench_decode_speed),
-                     ("disagg_decode_ab", bench_disagg_decode)]:
+                     ("disagg_decode_ab", bench_disagg_decode),
+                     ("train_promote_loop", bench_train_promote)]:
         try:
             t0 = time.perf_counter()
             out = fn()

@@ -19,6 +19,8 @@ configs).  Usage:
     python -m deeplearning4j_tpu launch --nprocs 2 --devices-per-proc 4 \\
         -- train --zoo lenet --data mnist --elastic-dir ckpts
     python -m deeplearning4j_tpu summary --model model.zip
+    python -m deeplearning4j_tpu flywheel --generations 3 \\
+        --eval-threshold 3.0 --canary 1.0 --chaos nan,regression
 
 ``--data`` accepts a built-in name (mnist / cifar10 / iris / emnist /
 svhn / uci) or a .npz file with arrays ``x`` and ``y`` (one-hot or class
@@ -997,6 +999,238 @@ def cmd_check(args) -> int:
     return analysis_main(argv)
 
 
+def cmd_flywheel(args) -> int:
+    """Headless train→eval→canary→fleet-promote flywheel on a synthetic
+    task (docs/LIFECYCLE.md): a PromotionPipeline drives --generations
+    lifecycle rounds against an in-process registry + fleet, with
+    optional chaos kinds fired on successive generations after the
+    bootstrap.  One JSON line per generation; the journal makes a
+    killed run resumable (re-run with the same --journal)."""
+    import os
+    import tempfile
+    import threading
+    import time
+
+    from .datasets import DataSet
+    from .datasets.iterators import ListDataSetIterator
+    from .earlystopping import DataSetLossCalculator
+    from .nn.conf.inputs import InputType
+    from .nn.layers import Dense, OutputLayer
+    from .nn.multilayer import MultiLayerNetwork, NeuralNetConfiguration
+    from .nn.updaters import Sgd
+    from .parallel import (ChaosInjector, ElasticTrainer, FaultKind,
+                           FaultSchedule)
+    from .serving import (Engine, EvalGate, FleetRouter, ModelRegistry,
+                          PromotionPipeline)
+    from .utils.serializer import load_model
+
+    chaos_plan = [c.strip() for c in (args.chaos or "").split(",")
+                  if c.strip()]
+    known = {"device_loss", "nan", "regression", "host_kill", "crash"}
+    bad = set(chaos_plan) - known
+    if bad:
+        print(f"unknown --chaos kind(s): {sorted(bad)} "
+              f"(known: {sorted(known)})", file=sys.stderr)
+        return 2
+    # chaos kinds fire one per generation, starting at gen 2: the
+    # bootstrap generation always runs clean (there is nothing to roll
+    # back to before the first promote)
+    chaos_at = {i + 2: kind for i, kind in enumerate(chaos_plan)}
+
+    rng = np.random.default_rng(args.seed)
+    teacher = rng.standard_normal((12, 3)).astype(np.float32)
+
+    def data(n, seed):
+        r = np.random.default_rng(seed)
+        x = r.standard_normal((n, 12)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[np.argmax(x @ teacher, axis=1)]
+        return DataSet(features=x, labels=y)
+
+    def mlp(seed, lr=0.05):
+        conf = (NeuralNetConfiguration.builder().seed(seed)
+                .updater(Sgd(lr=lr))
+                .layer(Dense(n_out=16, activation="tanh"))
+                .layer(OutputLayer(n_out=3, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(12)).build())
+        net = MultiLayerNetwork(conf)
+        net.init()
+        return net
+
+    train_ds, eval_ds = data(96, args.seed + 1), data(48, args.seed + 2)
+    workdir = args.workdir or tempfile.mkdtemp(prefix="flywheel_")
+    os.makedirs(workdir, exist_ok=True)
+    journal = args.journal or os.path.join(workdir, "flywheel.jsonl")
+    reg = ModelRegistry()
+    router = FleetRouter(max_retries=3)
+    killable = {"host": None}
+
+    def train_fn(gen):
+        kind = chaos_at.get(gen)
+        if kind == "nan":
+            net = mlp(args.seed + gen)
+            import jax
+            net.params = jax.tree_util.tree_map(
+                lambda a: np.full(np.shape(a), np.nan, np.float32),
+                net.params)
+            return {"model": net, "run_id": f"flywheel-g{gen}"}
+        labels = train_ds.labels
+        if kind == "regression":
+            net = mlp(args.seed + gen, lr=0.1)
+            labels = np.roll(labels, 1, axis=1)   # confidently wrong
+        elif gen == 1:
+            net = mlp(args.seed, lr=0.08)
+        else:
+            net = load_model(reg.checkpoint_path("flywheel", args.alias))
+        trainee = net
+        if kind == "device_loss":
+            trainee = ChaosInjector(net, FaultSchedule.scripted(
+                {3: FaultKind.DEVICE_LOSS}))
+        tr = ElasticTrainer(trainee,
+                            checkpoint_dir=os.path.join(workdir,
+                                                        f"gen{gen}"),
+                            checkpoint_every=2, sync_every=1,
+                            run_id=f"flywheel-g{gen}")
+        shuffled = train_ds.features, labels
+        idx = np.random.default_rng(args.seed + 10 * gen).permutation(
+            shuffled[0].shape[0])
+        batches = ListDataSetIterator(
+            [DataSet(features=shuffled[0][idx][i:i + 24],
+                     labels=shuffled[1][idx][i:i + 24])
+             for i in range(0, shuffled[0].shape[0], 24)])
+        tr.fit(batches, epochs=1 if kind == "regression" else args.epochs)
+        return tr
+
+    class _Crash(Exception):
+        pass
+
+    crash_armed = {g for g, k in chaos_at.items() if k == "crash"}
+
+    def stage_hook(stage, gen):
+        if stage == "CANARY" and gen in crash_armed:
+            crash_armed.discard(gen)
+            raise _Crash(f"controller crash injected at gen {gen}")
+
+    thresholds = {}
+    if args.max_divergence is not None:
+        thresholds["max_divergence"] = args.max_divergence
+
+    def make_pipe():
+        return PromotionPipeline(
+            reg, router, "flywheel", train_fn,
+            EvalGate(DataSetLossCalculator(eval_ds),
+                     threshold=args.eval_threshold),
+            alias=args.alias, journal_path=journal,
+            canary_frac=args.canary, canary_window=args.canary_window,
+            canary_timeout_s=args.canary_timeout_s,
+            canary_thresholds=thresholds, stage_hook=stage_hook)
+
+    pipe = make_pipe()
+    resumed = pipe.resume()
+    if resumed["completed"] or resumed["partial"] is not None:
+        print(f"resumed from journal: completed={resumed['completed']} "
+              f"partial={resumed['partial']}", file=sys.stderr)
+
+    stop = threading.Event()
+    traffic = None
+    dropped = [0]
+    try:
+        while len(pipe.completed) < args.generations:
+            gen_no = max(pipe.completed, default=0) + 1
+            if chaos_at.get(gen_no) == "host_kill" \
+                    and killable["host"] is not None:
+                killable["host"].kill_on_swap = True
+            try:
+                rec = pipe.run_generation()
+            except _Crash as exc:
+                print(f"# {exc} — resuming from the journal",
+                      file=sys.stderr)
+                pipe = make_pipe()
+                pipe.resume()
+                rec = pipe.run_generation()
+            print(json.dumps(rec))
+            if args.hosts > 0 and not router.hosts():
+                # fleet birth after the bootstrap promote: every host
+                # loads straight from the registry's warm bundle
+                kw = dict(max_batch=8, slo_ms=30_000.0, replicas=1,
+                          admission="block")
+                h0 = Engine.from_registry(reg, "flywheel", args.alias,
+                                          **kw)
+                h0.load()
+                router.add_host("h0", engine=h0)
+                v, model = reg.resolve("flywheel", args.alias)
+                for i in range(1, args.hosts):
+                    eng = Engine(model, **kw)
+                    eng.swap_model(model, tag=f"flywheel:v{v}")
+                    eng.load()
+                    host = _KillableEngine(eng)
+                    killable["host"] = host
+                    router.add_host(f"h{i}", engine=host)
+
+                def loop():   # canary mirror windows need live traffic
+                    probes = [rng.standard_normal((r, 12)).astype(
+                        np.float32) for r in (1, 2, 4)]
+                    i = 0
+                    while not stop.is_set():
+                        try:
+                            router.output(probes[i % 3], slo_ms=30_000.0)
+                        except Exception:
+                            dropped[0] += 1   # reported in final stats;
+                            # expected inside chaos windows (host_kill)
+                        i += 1
+                        time.sleep(0.002)
+                traffic = threading.Thread(target=loop, daemon=True)
+                traffic.start()
+    finally:
+        stop.set()
+        if traffic is not None:
+            traffic.join(timeout=10)
+        router.shutdown(shutdown_hosts=True)
+    print(json.dumps({"stats": pipe.stats(),
+                      "alias": reg.resolve("flywheel", args.alias)[0],
+                      "traffic_dropped": dropped[0],
+                      "journal": journal}))
+    return 0
+
+
+class _KillableEngine:
+    """cmd_flywheel's --chaos host_kill seam: dies the moment a rolling
+    swap touches it (scripts/train_promote_soak.py carries the full
+    version)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.kill_on_swap = False
+        self.killed = False
+
+    def output_async(self, x, slo_ms=None):
+        from .serving import ServingUnavailableError
+        if self.killed:
+            raise ServingUnavailableError("host killed (chaos)")
+        return self.inner.output_async(x, slo_ms=slo_ms)
+
+    def swap_model(self, model, tag=None, warm_bundle=None):
+        if self.kill_on_swap or self.killed:
+            self.killed = True
+            raise RuntimeError("host killed mid-roll (chaos)")
+        return self.inner.swap_model(model, tag, warm_bundle=warm_bundle)
+
+    @property
+    def current_tag(self):
+        return self.inner.current_tag
+
+    def metrics_snapshot(self):
+        return self.inner.metrics_snapshot()
+
+    def health_snapshot(self):
+        if self.killed:
+            return {"status": "unready", "ready": False}
+        return self.inner.health_snapshot()
+
+    def shutdown(self, timeout: float = 5.0):
+        self.inner.shutdown(timeout=timeout)
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="deeplearning4j_tpu",
                                 description=__doc__.split("\n")[0])
@@ -1309,6 +1543,50 @@ def build_parser() -> argparse.ArgumentParser:
                    help="why the baselined findings are accepted")
     c.add_argument("--show-suppressed", action="store_true")
     c.set_defaults(fn=cmd_check)
+
+    fw = sub.add_parser(
+        "flywheel", help="continuous train→eval→canary→fleet-promote "
+        "lifecycle on a synthetic task (docs/LIFECYCLE.md): repeated "
+        "PromotionPipeline generations with lineage-aware rollback, a "
+        "crash-resumable journal, and optional per-generation chaos")
+    fw.add_argument("--generations", type=int, default=3, metavar="K",
+                    help="lifecycle generations to complete (default 3)")
+    fw.add_argument("--eval-threshold", type=float, default=3.0,
+                    help="eval-gate loss ceiling; non-finite scores "
+                    "always fail (default 3.0)")
+    fw.add_argument("--canary", type=float, default=1.0, metavar="FRAC",
+                    help="fraction of live batches mirrored to the "
+                    "canary (default 1.0)")
+    fw.add_argument("--canary-window", type=int, default=4,
+                    help="mirrored batches per canary decision "
+                    "(default 4)")
+    fw.add_argument("--canary-timeout-s", type=float, default=60.0,
+                    help="canary window deadline; an unfilled window "
+                    "is a rejection (default 60)")
+    fw.add_argument("--max-divergence", type=float, default=None,
+                    help="canary prediction-divergence ceiling "
+                    "(mean abs diff vs the incumbent; default off)")
+    fw.add_argument("--hosts", type=int, default=2,
+                    help="fleet hosts; host 0 is the subscribed canary "
+                    "engine, the rest roll via rolling_swap; 0 = no "
+                    "fleet, alias-only promotion (default 2)")
+    fw.add_argument("--chaos", default="", metavar="KIND[,KIND...]",
+                    help="chaos kinds fired one per generation starting "
+                    "at gen 2: device_loss (mid-train, recovered), nan "
+                    "(eval gate catches), regression (canary rejects), "
+                    "host_kill (mid-roll, lineage rollback), crash "
+                    "(controller dies at CANARY, journal resume)")
+    fw.add_argument("--workdir",
+                    help="checkpoint/journal directory (default: fresh "
+                    "temp dir)")
+    fw.add_argument("--journal",
+                    help="journal path override — reuse one to resume "
+                    "a killed run (default: <workdir>/flywheel.jsonl)")
+    fw.add_argument("--alias", default="prod")
+    fw.add_argument("--epochs", type=int, default=3,
+                    help="training epochs per generation (default 3)")
+    fw.add_argument("--seed", type=int, default=12345)
+    fw.set_defaults(fn=cmd_flywheel)
     return p
 
 

@@ -22,7 +22,7 @@ import logging
 import os
 import re
 import time
-from typing import Any, Callable, List, Optional
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import jax
 
@@ -117,6 +117,11 @@ class CheckpointManager:
         self._suffix = f".h{self.process_id}" if role == "per_host" else ""
         self._executor = None
         self._pending = None
+        # checkpoint -> registry provenance: which (name, version) a
+        # checkpoint was registered as (serving/lifecycle.py stamps this
+        # at REGISTER time); persisted as a sidecar so the mapping
+        # survives the controller, like the checkpoints themselves
+        self.registered: Dict[str, Tuple[str, int]] = {}
         # wall clock of the most recent completed (deflate) write — the
         # preemption handler's estimate of whether another deflate pass
         # still fits the remaining grace budget (parallel/preemption.py)
@@ -124,6 +129,7 @@ class CheckpointManager:
         os.makedirs(directory, exist_ok=True)
         if self.is_writer:
             self._clean_stale_tmp()
+        self._load_provenance()
 
     @property
     def is_writer(self) -> bool:
@@ -158,6 +164,52 @@ class CheckpointManager:
     def _path(self, step: int) -> str:
         return os.path.join(self.directory,
                             f"checkpoint_{step:010d}{self._suffix}.zip")
+
+    # -- checkpoint -> registry provenance ---------------------------------
+
+    _PROVENANCE_FILE = "registry_provenance.json"
+
+    def _provenance_path(self) -> str:
+        return os.path.join(self.directory, self._PROVENANCE_FILE)
+
+    def _load_provenance(self) -> None:
+        import json
+        prov = self._provenance_path()
+        if not os.path.exists(prov):
+            return
+        try:
+            with open(prov) as f:
+                raw = json.load(f)
+            self.registered = {k: (str(v[0]), int(v[1]))
+                               for k, v in raw.items()}
+        except Exception as exc:  # an unreadable sidecar must not take
+            # down checkpointing itself — provenance is advisory metadata
+            logger.warning("unreadable %s (%s) — starting with empty "
+                           "registry provenance", self._PROVENANCE_FILE, exc)
+            self.registered = {}
+
+    def note_registered(self, path: str, name: str, version: int) -> None:
+        """Record that checkpoint ``path`` was registered as
+        ``(name, version)`` in a model registry — the lifecycle
+        controller's REGISTER stage calls this so "which checkpoint
+        produced which serving version" is answerable from the
+        checkpoint store itself.  Persisted as an atomic sidecar
+        (``registry_provenance.json``) with the same crash discipline
+        as the checkpoints."""
+        import json
+        self.registered[os.path.basename(str(path))] = (str(name),
+                                                        int(version))
+        prov = self._provenance_path()
+        tmp = f"{prov}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({k: list(v) for k, v in self.registered.items()}, f,
+                      indent=2, sort_keys=True)
+        os.replace(tmp, prov)
+
+    def registered_version(self, path: str) -> Optional[Tuple[str, int]]:
+        """The ``(registry name, version)`` checkpoint ``path`` was
+        registered as, or None if it never reached a registry."""
+        return self.registered.get(os.path.basename(str(path)))
 
     def save(self, net, step: int) -> Optional[str]:
         if not self.is_writer:
@@ -403,10 +455,18 @@ class ElasticTrainer:
                  clock: Callable[[], float] = time.monotonic,
                  membership_check: Optional[Callable[[], None]] = None,
                  checkpoint_role: str = "auto",
-                 preemption=None):
+                 preemption=None,
+                 run_id: Optional[str] = None):
         import random
+        import uuid
 
         self.trainer = trainer
+        # stable identity of THIS training run, stamped into registry
+        # lineage by the promotion pipeline (docs/LIFECYCLE.md) — pass
+        # one explicitly to correlate relaunched workers of the same
+        # logical run (the launcher's relaunch keeps the id; a fresh
+        # controller generates one)
+        self.run_id = run_id or uuid.uuid4().hex[:12]
         self.ckpt = CheckpointManager(checkpoint_dir, keep_last,
                                       role=checkpoint_role)
         self.checkpoint_every = max(1, checkpoint_every)
@@ -442,6 +502,10 @@ class ElasticTrainer:
         # write lands — surfaced through the heartbeat so the launcher's
         # pod-liveness report can answer "how much work would we lose"
         self.last_checkpoint_step = -1
+        # ...and its path: the lifecycle pipeline reads
+        # `final_checkpoint_path` after fit() to register the run's
+        # durable artifact without parsing checkpoint filenames
+        self.last_checkpoint_path: Optional[str] = None
         self.restarts = 0        # consecutive-failure budget (resets)
         self.total_restarts = 0  # lifetime count, for observability
         self.recovery_seconds = 0.0  # total wall clock spent in recovery
@@ -473,7 +537,8 @@ class ElasticTrainer:
 
     def recovery_stats(self) -> dict:
         """Structured recovery counters (the registry collector view)."""
-        return {"global_step": self.global_step,
+        return {"run_id": self.run_id,
+                "global_step": self.global_step,
                 "restarts": self.restarts,
                 "total_restarts": self.total_restarts,
                 "recovery_seconds": round(self.recovery_seconds, 3),
@@ -485,6 +550,15 @@ class ElasticTrainer:
         is not the writer — the durable step is unknown here)."""
         if path is not None and step > self.last_checkpoint_step:
             self.last_checkpoint_step = step
+            self.last_checkpoint_path = str(path)
+
+    @property
+    def final_checkpoint_path(self) -> Optional[str]:
+        """The newest checkpoint known durable on disk for this run —
+        after ``fit()`` returns, the run's final artifact (``fit``
+        always lands a last checkpoint).  None before any write landed
+        on this host (non-writer hosts never observe a path)."""
+        return self.last_checkpoint_path
 
     @staticmethod
     def _default_loader(path: str):
@@ -512,7 +586,9 @@ class ElasticTrainer:
         net.iteration = model.iteration
         self.global_step = step
         # the checkpoint just loaded is by definition durable on disk
-        self.last_checkpoint_step = max(self.last_checkpoint_step, step)
+        if step >= self.last_checkpoint_step:
+            self.last_checkpoint_step = step
+            self.last_checkpoint_path = self.ckpt._path(step)
         logger.info("restored checkpoint @ step %d", step)
 
     def resume(self) -> int:

@@ -34,6 +34,11 @@ remains as a thin back-compat shim over this engine).  Pieces:
   autoscale.py load-driven replica autoscaling controller (hysteresis +
                cooldown + bounds, injectable clock); actuated by the
                engine supervisor loops via PR-7 birth/retire machinery
+  lifecycle.py the production flywheel: PromotionPipeline runs
+               TRAIN → EVAL → REGISTER → CANARY → ROLL repeatedly with
+               lineage-provenance registration, warm-bundle-at-save,
+               bounded retries/deadlines, a crash-resumable journal,
+               and lineage-aware regression rollback (docs/LIFECYCLE.md)
 
 Reference lineage: DL4J's ParallelInference BATCHED mode + the model-
 server role; design cf. the serving sections of "TensorFlow: A system
@@ -52,22 +57,31 @@ from .engine import (
     ServingUnavailableError,
 )
 from .fleet import FleetHost, FleetRouter, FleetTimeoutError, HttpHost
+from .lifecycle import (
+    EvalGate, PipelineJournal, PipelineStageError, PromotionPipeline,
+    StageDeadlineError, data_fingerprint, weights_sha,
+)
 from .metrics import (DecodeMetrics, FleetMetrics, LatencyHistogram,
                       ServingMetrics)
-from .registry import ModelRegistry
+from .registry import CanaryRejectedError, ModelRegistry
 from .warmcache import (
     bundle_path_for, device_fingerprint, enable_compile_cache, load_bundle,
     save_bundle,
 )
 
 __all__ = [
-    "ADMISSION_POLICIES", "ContinuousBatcher", "DeadlineExceededError",
+    "ADMISSION_POLICIES", "CanaryRejectedError", "ContinuousBatcher",
+    "DeadlineExceededError",
     "DecodeEngine", "DecodeMetrics", "DynamicBatcher", "Engine",
+    "EvalGate",
     "FleetHost", "FleetMetrics", "FleetRouter", "FleetTimeoutError",
     "GenerationResult", "HttpHost", "LatencyHistogram", "ModelRegistry",
-    "OverloadedError", "PoisonInputError", "PrefillHandoff",
-    "ReplicaAutoscaler",
+    "OverloadedError", "PipelineJournal", "PipelineStageError",
+    "PoisonInputError", "PrefillHandoff",
+    "PromotionPipeline", "ReplicaAutoscaler",
     "ReplicaCrashError", "ReplicaHungError", "ServingMetrics",
-    "ServingUnavailableError", "bundle_path_for", "device_fingerprint",
+    "ServingUnavailableError", "StageDeadlineError", "bundle_path_for",
+    "data_fingerprint", "device_fingerprint",
     "enable_compile_cache", "load_bundle", "pow2_buckets", "save_bundle",
+    "weights_sha",
 ]

@@ -353,6 +353,17 @@ class FleetRouter:
                     return _tag_of(h.engine or h.decode)
         return ""
 
+    def tags(self, kind: str = "predict") -> Dict[str, str]:
+        """Current model tag per non-down host — the fleet-consistency
+        view (``current_tag`` reads only the FIRST up host, which lies
+        mid-roll or after a canary host self-swapped ahead of the
+        fleet).  A promotion controller re-rolls exactly when some up
+        host's tag differs from the target."""
+        with self._lock:
+            hosts = [h for h in self._hosts.values()
+                     if h.state != "down" and h.supports(kind)]
+        return {h.host_id: _tag_of(h.engine_for(kind)) for h in hosts}
+
     def health_snapshot(self) -> dict:
         with self._lock:
             hosts = list(self._hosts.values())

@@ -30,12 +30,50 @@ is returned and recorded in :meth:`canary_history`.
 Checkpoints load through ``utils/serializer.load_model`` and therefore
 accept every supported FORMAT_VERSION (1-4), including v4 integrity
 digests — a corrupt file raises instead of serving garbage.
+
+Lineage (docs/LIFECYCLE.md): ``register``/``load`` accept a
+``lineage=`` provenance record — which run produced the version, which
+data slice it trained/evaluated on, its eval scores, the parent
+version it continued from, and a content hash of its weights.  Records
+are immutable alongside the version itself and drive
+:meth:`rollback_target`: rollback re-aliases to the last
+*eval-passing* ancestor on the parent chain, not merely version−1
+(version−1 may be a registered-for-audit failure).
 """
 
 from __future__ import annotations
 
 import threading
 from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+class CanaryRejectedError(RuntimeError):
+    """``set_alias(..., canary=frac, raise_on_reject=True)`` failed to
+    promote: at least one subscribed engine voted rollback (or its
+    decision window never filled).  Carries the full decision
+    ``record`` (the same dict :meth:`ModelRegistry.canary_history`
+    keeps) so callers — the PromotionPipeline above all — get a
+    programmatic rejection signal instead of fishing the history."""
+
+    def __init__(self, record: dict):
+        reasons = [r for d in record.get("decisions", ())
+                   for r in d.get("reasons", ())]
+        super().__init__(
+            f"canary rejected {record.get('name')} "
+            f"v{record.get('from')} -> v{record.get('to')} on alias "
+            f"{record.get('alias')!r}: {'; '.join(reasons) or 'no votes'}")
+        self.record = record
+        self.name = record.get("name")
+        self.alias = record.get("alias")
+        self.incumbent = record.get("from")
+        self.candidate = record.get("to")
+        self.reasons = reasons
+
+
+#: lineage-record fields every record carries (absent inputs become None)
+LINEAGE_FIELDS = ("run_id", "data_fingerprint", "parent_version",
+                  "eval_score", "eval_passed", "weights_sha",
+                  "checkpoint_path")
 
 
 class ModelRegistry:
@@ -54,13 +92,22 @@ class ModelRegistry:
         # disk — the provenance serving uses to find warmup bundles
         # (serving/warmcache.py: `<checkpoint>.warm` next to the zip)
         self._paths: Dict[Tuple[str, int], str] = {}
+        # (name, version) -> lineage provenance record (immutable, like
+        # the version itself); see LINEAGE_FIELDS / docs/LIFECYCLE.md
+        self._lineage: Dict[Tuple[str, int], dict] = {}
 
     # -- registration ------------------------------------------------------
 
-    def register(self, name: str, model, version: Optional[int] = None) -> int:
+    def register(self, name: str, model, version: Optional[int] = None,
+                 lineage: Optional[dict] = None) -> int:
         """Register an in-memory model; returns its version number
         (monotonically assigned when not given).  Re-registering an
-        existing (name, version) is an error — versions are immutable."""
+        existing (name, version) is an error — versions are immutable.
+
+        ``lineage`` attaches an immutable provenance record (see
+        LINEAGE_FIELDS); unknown extra keys are preserved.  A version
+        may be registered with ``eval_passed=False`` purely as an audit
+        trail — :meth:`rollback_target` skips such versions."""
         with self._lock:
             versions = self._models.setdefault(name, {})
             if version is None:
@@ -70,10 +117,69 @@ class ModelRegistry:
                 raise ValueError(f"{name} v{version} already registered — "
                                  "versions are immutable; register a new one")
             versions[version] = model
+            if lineage is not None:
+                rec = {k: None for k in LINEAGE_FIELDS}
+                rec.update(lineage)
+                rec["name"] = name
+                rec["version"] = version
+                self._lineage[(name, version)] = rec
             return version
 
+    def lineage(self, name: str,
+                version: Optional[int] = None):
+        """Provenance records for ``name``: the single record for
+        ``version`` (None if that version has no lineage), or — with
+        ``version=None`` — every recorded lineage, version-ascending."""
+        with self._lock:
+            if version is not None:
+                rec = self._lineage.get((name, int(version)))
+                return dict(rec) if rec is not None else None
+            return [dict(self._lineage[(n, v)])
+                    for (n, v) in sorted(self._lineage)
+                    if n == name]
+
+    def rollback_target(self, name: str,
+                        version: Optional[int] = None) -> Optional[int]:
+        """The version a failed promotion of ``version`` (default: the
+        newest registered) should roll back to: the nearest
+        *eval-passing* ancestor, following the lineage
+        ``parent_version`` chain first, then falling back to a
+        descending scan of older versions.  Versions registered for
+        audit with ``eval_passed=False`` (a NaN run, a gate failure)
+        are never rollback targets — rollback is principled, not
+        version−1.  None when no eval-passing ancestor exists (e.g.
+        the very first generation failed)."""
+        with self._lock:
+            versions = self._models.get(name)
+            if not versions:
+                raise KeyError(f"no model named {name!r} registered")
+            start = max(versions) if version is None else int(version)
+
+            def passing(v: int) -> bool:
+                rec = self._lineage.get((name, v))
+                return bool(rec is not None and rec.get("eval_passed"))
+
+            # the parent chain: provenance-driven, survives version-number
+            # gaps left by audit registrations
+            seen = set()
+            rec = self._lineage.get((name, start))
+            cur = rec.get("parent_version") if rec is not None else None
+            while cur is not None and cur not in seen:
+                seen.add(cur)
+                cur = int(cur)
+                if cur in versions and passing(cur):
+                    return cur
+                nxt = self._lineage.get((name, cur))
+                cur = nxt.get("parent_version") if nxt is not None else None
+            # chain exhausted / absent: newest eval-passing older version
+            for v in sorted(versions, reverse=True):
+                if v < start and passing(v):
+                    return v
+            return None
+
     def load(self, name: str, path: str,
-             version: Optional[int] = None) -> int:
+             version: Optional[int] = None,
+             lineage: Optional[dict] = None) -> int:
         """Load a checkpoint zip (serializer FORMAT_VERSION 1-4) and
         register it.  The checkpoint path is recorded as provenance —
         on the registry (:meth:`checkpoint_path`) AND stamped on the
@@ -84,7 +190,11 @@ class ModelRegistry:
 
         model = load_model(path)
         model._checkpoint_path = str(path)
-        version = self.register(name, model, version=version)
+        if lineage is not None:
+            lineage = dict(lineage)
+            lineage.setdefault("checkpoint_path", str(path))
+        version = self.register(name, model, version=version,
+                                lineage=lineage)
         with self._lock:
             self._paths[(name, version)] = str(path)
         return version
@@ -151,7 +261,8 @@ class ModelRegistry:
                   canary: Optional[float] = None,
                   canary_window: int = 32,
                   canary_timeout_s: float = 60.0,
-                  canary_thresholds: Optional[Dict[str, Any]] = None):
+                  canary_thresholds: Optional[Dict[str, Any]] = None,
+                  raise_on_reject: bool = False):
         """Atomically move ``alias`` to ``version`` and hot-swap every
         subscribed engine (synchronously — returns after old versions
         drained).  Returns the alias's previous version (None if new).
@@ -167,6 +278,12 @@ class ModelRegistry:
         votes promote; on any rollback vote the alias stays put.
         Returns the decision record (also kept in
         :meth:`canary_history`) instead of the previous version.
+
+        ``raise_on_reject=True`` turns a failed canary into a typed
+        :class:`CanaryRejectedError` (record attached) instead of a
+        record the caller must inspect — the programmatic rejection
+        signal promotion controllers key rollback off.  A promoted
+        canary (and the non-canary path) is unaffected.
         """
         with self._lock:
             if name not in self._models:
@@ -214,6 +331,8 @@ class ModelRegistry:
                 for (cb, _), d in zip(canary_pairs, decisions):
                     if d.get("promote"):
                         cb(prev, incumbent_model)
+            if not promoted and raise_on_reject:
+                raise CanaryRejectedError(record)
             return record
         if prev != version:
             # callbacks run OUTSIDE the registry lock: an engine's swap

@@ -1,0 +1,638 @@
+"""Train→promote flywheel soak: the PromotionPipeline under chaos and
+concurrent open-loop serving traffic (bench config ``train_promote_loop``).
+
+One registry + one 3-host fleet (h0 doubles as the subscribed canary
+engine; h1/h2 are rolled by ``rolling_swap``) serve live traffic for the
+whole run while the pipeline drives six generations end-to-end:
+
+  gen1  bootstrap: train hard from scratch → eval → register (lineage +
+        warm bundle at save time) → promote.  The fleet is then built
+        FROM the registry: every host's initial load warms from v1's
+        warm bundle — zero compiles even at fleet birth.
+  gen2  fine-tune under TRAINING chaos: scripted device-loss faults
+        (worker preemption) mid-train; ElasticTrainer recovers from
+        checkpoint and the generation still promotes (canary + roll
+        under live traffic).
+  gen3  NaN-params run: the EVAL gate catches the non-finite score
+        before the version ever reaches a canary; it is registered as
+        an eval_passed=False audit record only.
+  gen4  deliberately-regressed run (fresh random weights, plausible
+        loss): passes the loose eval gate, and the CANARY must reject
+        it (prediction divergence) — typed CanaryRejectedError, alias
+        never moves.  Its lineage rollback target is v2, NOT
+        version−1 (v3, the NaN audit record).
+  gen5  good fine-tune, but a host is killed MID-ROLL: the fleet rolls
+        survivors back, the pipeline re-aliases to the lineage target
+        (v2) and the canary host follows — no version mixing past the
+        generation's end.
+  gen6  controller CRASH mid-flywheel (at the CANARY stage, after
+        REGISTER journaled): a fresh PromotionPipeline over the same
+        journal resumes gen6 without retraining and promotes through
+        the surviving hosts.
+
+Gates (consumed by bench.py ``train_promote_loop``):
+  - outcomes: gens 1/2/6 PROMOTED (K=3 train→promote generations),
+    gen3 eval-rolled-back, gen4 canary-rejected, gen5 roll-rolled-back
+  - monotone eval: promoted generations' eval losses never increase
+  - lineage rollback: gens 4 and 5 roll back to v2 — the last
+    eval-passing PROMOTED ancestor — never to version−1
+  - traffic: zero dropped (no errors), zero stranded futures, zero
+    double deliveries, zero unmatched/ambiguous responses, and inside
+    every steady window every response matches the promoted version
+  - zero serve-time compiles: every fleet host's warmup-bundle misses
+    stay 0 for the entire soak (initial load included) and per-host
+    compile cache size never grows
+  - crash-resume: the journal resume completes gen6 with the train_fn
+    called exactly once for it
+
+Last stdout line is the JSON result (the bench subprocess contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+QUICK = "--quick" in sys.argv or os.environ.get("BENCH_QUICK", "0") == "1"
+
+EVAL_LOSS_THRESHOLD = 3.0       # loose: catches NaN/catastrophe, not gen4
+MAX_DIVERGENCE = 0.07           # canary: fine-tunes sit far below,
+                                # a fresh-weights regression far above
+SLO_MS = 30_000.0
+EPS = 0.08                      # steady-window margin (s)
+
+
+def _mlp(seed=7, lr=0.05):
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import (
+        MultiLayerNetwork, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.updaters import Sgd
+
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(lr=lr))
+            .layer(Dense(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(12)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def _teacher_data(n, seed=0):
+    """Learnable 3-class data from a fixed linear teacher — SGD on it
+    reliably decreases mcxent loss, which the monotone-eval gate needs."""
+    from deeplearning4j_tpu.datasets import DataSet
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 12)).astype(np.float32)
+    teacher = np.asarray(np.random.default_rng(1234).standard_normal((12, 3)),
+                         np.float32)
+    y = np.eye(3, dtype=np.float32)[np.argmax(x @ teacher, axis=1)]
+    return DataSet(features=x, labels=y)
+
+
+def _batches(ds, batch, seed):
+    """Per-generation shuffled minibatch list (a list, so ElasticTrainer
+    can re-iterate it across epochs).  Distinct seeds keep sibling
+    fine-tunes (gen5 vs gen6, both starting from v2) on different
+    trajectories — the response classifier must never see two versions
+    with identical weights."""
+    from deeplearning4j_tpu.datasets import DataSet
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    idx = np.random.default_rng(seed).permutation(ds.features.shape[0])
+    x, y = ds.features[idx], ds.labels[idx]
+    return ListDataSetIterator(
+        [DataSet(features=x[i:i + batch], labels=y[i:i + batch])
+         for i in range(0, x.shape[0], batch)])
+
+
+# ---------------------------------------------------------------------------
+# traffic harness
+# ---------------------------------------------------------------------------
+
+class _Ledger:
+    """One record per submission, always — the stranded / at-most-once
+    / version gates all read from here (scripts/fleet_load_soak.py)."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.records: List[dict] = []
+        self.n_submitted = 0
+        self.n_done = 0
+        self.resolutions: Dict[int, int] = {}
+
+    def submit(self, router, rid, probe_idx, x):
+        t_submit = time.monotonic()
+        fut = router.output_async(x, slo_ms=SLO_MS)
+        with self.lock:
+            self.n_submitted += 1
+
+        def cb(f, rid=rid, probe_idx=probe_idx, t_submit=t_submit):
+            t = time.monotonic()
+            exc = f.exception()
+            rec = {"rid": rid, "probe": probe_idx, "t_submit": t_submit,
+                   "t_done": t, "latency_ms": (t - t_submit) * 1e3,
+                   "error": type(exc).__name__ if exc is not None else None,
+                   "out": None if exc is not None else np.asarray(f.result())}
+            with self.lock:
+                self.records.append(rec)
+                self.n_done += 1
+                self.resolutions[rid] = self.resolutions.get(rid, 0) + 1
+        fut.add_done_callback(cb)
+
+    def drain(self, timeout):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self.lock:
+                if self.n_done >= self.n_submitted:
+                    return True
+            time.sleep(0.02)
+        return False
+
+
+class _Windows:
+    """Steady-fleet windows: opened when a generation reaches its
+    terminal state (every up host serves the promoted/rolled-back-to
+    version), closed the moment the NEXT canary or roll begins.  Any
+    response submitted inside a window must match the window's version
+    — the version-mixing gate.  gen3 (eval-failed, fleet untouched)
+    opens no new window and closes none: the incumbent window spans it,
+    asserting the NaN run changed nothing."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.spans: List[dict] = []
+        self.open: Optional[dict] = None
+
+    def open_steady(self, expect_version, gen):
+        with self.lock:
+            if self.open is None:
+                self.open = {"t0": time.monotonic() + EPS,
+                             "expect": expect_version, "gen": gen}
+
+    def close(self):
+        with self.lock:
+            if self.open is not None:
+                self.open["t1"] = time.monotonic() - EPS
+                if self.open["t1"] > self.open["t0"]:
+                    self.spans.append(self.open)
+                self.open = None
+
+    def finish(self):
+        self.close()
+        with self.lock:
+            return list(self.spans)
+
+
+class _KillableHost:
+    """Engine wrapper for the mid-roll host kill: the moment a rolling
+    swap touches it, it dies; a killed host fails all traffic (the
+    router's retry path re-places it on survivors)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.kill_on_swap = False
+        self.killed = False
+
+    def output_async(self, x, slo_ms=None):
+        from deeplearning4j_tpu.serving import ServingUnavailableError
+        if self.killed:
+            raise ServingUnavailableError("host killed (chaos)")
+        return self.inner.output_async(x, slo_ms=slo_ms)
+
+    def swap_model(self, model, tag=None, warm_bundle=None):
+        if self.kill_on_swap or self.killed:
+            self.killed = True
+            raise RuntimeError("host killed mid-roll (chaos)")
+        return self.inner.swap_model(model, tag, warm_bundle=warm_bundle)
+
+    @property
+    def current_tag(self):
+        return self.inner.current_tag
+
+    def metrics_snapshot(self):
+        return self.inner.metrics_snapshot()
+
+    def health_snapshot(self):
+        if self.killed:
+            return {"status": "unready", "ready": False}
+        return self.inner.health_snapshot()
+
+    def compile_cache_size(self):
+        return self.inner.compile_cache_size()
+
+    def shutdown(self, timeout: float = 5.0):
+        self.inner.shutdown(timeout=timeout)
+
+
+def _classify(out, refs_for_probe):
+    """Which version produced this response?  Nearest reference with a
+    separation requirement: a response within 1e-4 of MORE than one
+    version's reference is 'ambiguous' — sibling fine-tunes must stay
+    numerically separable or the gate fails loudly."""
+    if out is None:
+        return None
+    close = []
+    for v, ref in refs_for_probe.items():
+        if out.shape == ref.shape:
+            d = float(np.max(np.abs(out - ref)))
+            if math.isfinite(d) and d < 1e-4:
+                close.append(v)
+    if len(close) == 1:
+        return close[0]
+    return "ambiguous" if close else None
+
+
+# ---------------------------------------------------------------------------
+# the soak
+# ---------------------------------------------------------------------------
+
+class _ControllerCrash(Exception):
+    """Simulated pipeline-controller kill (raised from the stage hook,
+    which runs OUTSIDE the stage retry machinery — like SIGKILL, the
+    journal line for the interrupted stage is never written)."""
+
+
+def run_soak(quick: bool) -> dict:
+    import jax  # noqa: F401  (platform report only)
+
+    from deeplearning4j_tpu.earlystopping import DataSetLossCalculator
+    from deeplearning4j_tpu.parallel import (
+        ChaosInjector, ElasticTrainer, FaultKind, FaultSchedule,
+    )
+    from deeplearning4j_tpu.serving import (
+        Engine, EvalGate, FleetRouter, ModelRegistry, PromotionPipeline,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="train_promote_soak_")
+    train = _teacher_data(96 if not quick else 64, seed=5)
+    eval_ds = _teacher_data(48, seed=6)
+    epochs_boot = 4 if not quick else 3
+    epochs_ft = 3 if not quick else 2
+
+    reg = ModelRegistry()
+    router = FleetRouter(max_retries=3, breaker_threshold=5)
+    train_calls: Dict[int, int] = {}
+    nan_gen, regress_gen, kill_gen, crash_gen = 3, 4, 5, 6
+
+    def train_fn(gen):
+        train_calls[gen] = train_calls.get(gen, 0) + 1
+        ckpt_dir = os.path.join(tmp, f"gen{gen}")
+        if gen == nan_gen:
+            # a run whose params went NaN: registered in-memory as the
+            # audit record the eval gate flags (no checkpoint → no
+            # bundle, and it must never need one)
+            import jax as _jax
+            net = _mlp(seed=31)
+            net.params = _jax.tree_util.tree_map(
+                lambda a: np.full(np.shape(a), np.nan, np.float32),
+                net.params)
+            return {"model": net, "run_id": f"run-g{gen}"}
+        if gen == regress_gen:
+            # deliberately regressed: briefly trained on label-ROTATED
+            # data — its eval loss is plausible (under the loose gate)
+            # but its predictions lean toward the wrong classes, so it
+            # diverges hard from the incumbent → the canary's job
+            net = _mlp(seed=99, lr=0.1)
+        elif gen == 1:
+            net = _mlp(seed=7, lr=0.08)
+        else:
+            # fine-tune the current prod version from its checkpoint
+            from deeplearning4j_tpu.utils.serializer import load_model
+            net = load_model(reg.checkpoint_path("m", "prod"))
+        if gen == 2:
+            # worker preemption mid-train, twice: ElasticTrainer must
+            # recover from checkpoint and still deliver the generation
+            sched = FaultSchedule.scripted({3: FaultKind.DEVICE_LOSS,
+                                            7: FaultKind.DEVICE_LOSS})
+            trainee = ChaosInjector(net, sched)
+        else:
+            trainee = net
+        tr = ElasticTrainer(trainee, checkpoint_dir=ckpt_dir,
+                            checkpoint_every=2, sync_every=1,
+                            run_id=f"run-g{gen}")
+        if gen == regress_gen:
+            from deeplearning4j_tpu.datasets import DataSet
+            wrong = DataSet(features=train.features,
+                            labels=np.roll(train.labels, 1, axis=1))
+            tr.fit(_batches(wrong, 24, seed=1000 + gen), epochs=2)
+        else:
+            ep = epochs_boot if gen == 1 else epochs_ft
+            tr.fit(_batches(train, 24, seed=1000 + gen), epochs=ep)
+        if gen == 2:
+            stats = tr.recovery_stats()
+            assert stats["total_restarts"] >= 1, \
+                f"training chaos never fired: {stats}"
+        return tr
+
+    windows = _Windows()
+    crash = {"armed": False, "fired": False}
+    timeline: List[dict] = []
+
+    def stage_hook(stage, gen):
+        timeline.append({"t": time.monotonic(), "stage": stage, "gen": gen})
+        if stage in ("CANARY", "ROLL"):
+            windows.close()
+        if stage == "CANARY" and gen == crash_gen and crash["armed"]:
+            crash["armed"] = False
+            crash["fired"] = True
+            raise _ControllerCrash("pipeline controller killed")
+
+    def make_pipe():
+        return PromotionPipeline(
+            reg, router, "m", train_fn,
+            EvalGate(DataSetLossCalculator(eval_ds),
+                     threshold=EVAL_LOSS_THRESHOLD),
+            journal_path=os.path.join(tmp, "pipeline.jsonl"),
+            canary_frac=1.0, canary_window=4 if quick else 6,
+            canary_timeout_s=60.0,
+            canary_thresholds={"max_divergence": MAX_DIVERGENCE,
+                               "p99_factor": 10.0},
+            stage_retries=1, drain_timeout_s=30.0,
+            data_slice=train, stage_hook=stage_hook)
+
+    pipe = make_pipe()
+
+    # -- gen1: bootstrap promote (no fleet hosts yet, no traffic) ----------
+    g1 = pipe.run_generation()
+    assert g1["outcome"] == "PROMOTED", g1
+    v1 = g1["version"]
+
+    # -- fleet birth FROM the registry: warm bundles all the way down ------
+    print("soak: building 3-host fleet from registry (bundle warm)",
+          file=sys.stderr)
+    engine_kw = dict(max_batch=8, slo_ms=SLO_MS, replicas=1,
+                     max_queue=100_000, admission="block")
+    h0 = Engine.from_registry(reg, "m", "prod", **engine_kw)   # canary host
+    h0.load()
+    plain = []
+    for _ in range(2):
+        m = reg.resolve("m", "prod")[1]
+        eng = Engine(m, **engine_kw)
+        eng.swap_model(m, tag=f"m:v{v1}")   # pre-load: tag fix, no compile
+        eng.load()                          # warms v1 from its bundle
+        plain.append(eng)
+    killable = _KillableHost(plain[1])
+    router.add_host("h0", engine=h0)
+    router.add_host("h1", engine=plain[0])
+    router.add_host("h2", engine=killable)
+    engines = {"h0": h0, "h1": plain[0], "h2": plain[1]}
+
+    def serve_compile_counters():
+        out = {}
+        for hid, e in engines.items():
+            c = e.metrics.snapshot()["counters"]
+            out[hid] = {"bundle_misses": c.get("bundle_misses", 0),
+                        "bundle_hits": c.get("bundle_hits", 0),
+                        "cache": e.compile_cache_size()}
+        return out
+
+    base_compiles = serve_compile_counters()
+
+    # -- open-loop traffic for the rest of the soak ------------------------
+    rng = np.random.default_rng(42)
+    probes = [rng.standard_normal((r, 12)).astype(np.float32)
+              for r in (1, 2, 4) * 4]
+    ledger = _Ledger()
+    stop = threading.Event()
+
+    def open_loop():
+        rid = 0
+        while not stop.is_set():
+            pi = rid % len(probes)
+            ledger.submit(router, rid, pi, probes[pi])
+            rid += 1
+            time.sleep(float(rng.exponential(0.004)))
+
+    submitter = threading.Thread(target=open_loop, daemon=True)
+    t_start = time.monotonic()
+    submitter.start()
+    windows.open_steady(v1, gen=1)
+
+    # -- gens 2..5 under traffic + chaos -----------------------------------
+    reports = {1: g1}
+    print("soak: gen2 (training chaos) …", file=sys.stderr)
+    reports[2] = pipe.run_generation()
+    v2 = reports[2]["version"]
+    windows.open_steady(v2, gen=2)
+
+    print("soak: gen3 (NaN eval gate) …", file=sys.stderr)
+    reports[3] = pipe.run_generation()
+
+    print("soak: gen4 (canary must reject) …", file=sys.stderr)
+    reports[4] = pipe.run_generation()
+    windows.open_steady(v2, gen=4)
+
+    print("soak: gen5 (host kill mid-roll) …", file=sys.stderr)
+    killable.kill_on_swap = True
+    reports[5] = pipe.run_generation()
+    windows.open_steady(v2, gen=5)
+
+    # -- gen6: controller crash at CANARY, resume from the journal ---------
+    print("soak: gen6 (controller crash + resume) …", file=sys.stderr)
+    crash["armed"] = True
+    crashed = False
+    try:
+        pipe.run_generation()
+    except _ControllerCrash:
+        crashed = True
+    pipe2 = make_pipe()
+    resume_state = pipe2.resume()
+    reports[6] = pipe2.run_generation()
+    v6 = reports[6]["version"]
+    windows.open_steady(v6, gen=6)
+
+    # tail traffic on the final version, then stop
+    time.sleep(0.5)
+    spans = windows.finish()
+    stop.set()
+    submitter.join(timeout=30)
+    all_done = ledger.drain(timeout=60)
+    wall_s = time.monotonic() - t_start
+    final_tags = router.tags()
+    final_hosts = router.hosts()
+    end_compiles = serve_compile_counters()
+    alias_final = reg.resolve("m", "prod")[0]
+    journal_stages = [
+        (r.get("gen"), r.get("stage"))
+        for r in pipe2.journal.replay() if r.get("gen") == crash_gen]
+    router.shutdown(shutdown_hosts=True)
+
+    # -- classification + gates -------------------------------------------
+    refs = {}
+    for v in reg.versions("m"):
+        model = reg.resolve("m", v)[1]
+        refs[v] = [np.asarray(model.output(p)) for p in probes]
+    with ledger.lock:
+        records = list(ledger.records)
+        n_submitted = ledger.n_submitted
+        resolutions = dict(ledger.resolutions)
+    stranded = max(0, n_submitted - len(records))
+    double = sum(1 for c in resolutions.values() if c > 1)
+    errors: Dict[str, int] = {}
+    for r in records:
+        if r["error"] is not None:
+            errors[r["error"]] = errors.get(r["error"], 0) + 1
+    ok_recs = [r for r in records if r["error"] is None]
+    for r in ok_recs:
+        r["version"] = _classify(
+            r["out"], {v: refs[v][r["probe"]] for v in refs})
+    unmatched = sum(1 for r in ok_recs
+                    if r["version"] in (None, "ambiguous"))
+    window_violations = 0
+    window_samples = 0
+    for span in spans:
+        exp = span["expect"]
+        for r in ok_recs:
+            if span["t0"] <= r["t_submit"] <= span["t1"]:
+                window_samples += 1
+                if r["version"] != exp:
+                    window_violations += 1
+
+    promoted = [g for g in sorted(reports) if reports[g]["outcome"]
+                == "PROMOTED"]
+    losses = [reports[g]["eval_score"] for g in promoted]
+    monotone = all(losses[i + 1] <= losses[i] + 1e-9
+                   for i in range(len(losses) - 1))
+    serve_compiles = sum(
+        end_compiles[h]["bundle_misses"] for h in end_compiles)
+    cache_stable = all(
+        end_compiles[h]["cache"] == base_compiles[h]["cache"]
+        for h in end_compiles)
+    lat = [r["latency_ms"] for r in ok_recs]
+
+    out = {
+        "wall_seconds": round(wall_s, 2),
+        "generations": {str(g): {"outcome": reports[g]["outcome"],
+                                 "version": reports[g]["version"],
+                                 "eval_score": reports[g]["eval_score"],
+                                 "reason": reports[g].get("reason"),
+                                 "rolled_back_to":
+                                     reports[g].get("rolled_back_to")}
+                        for g in sorted(reports)},
+        "promoted_generations": promoted,
+        "promoted_losses": [round(float(s), 5) for s in losses],
+        "monotone_eval": bool(monotone),
+        "nan_caught_by_eval": bool(
+            reports[nan_gen]["outcome"] == "ROLLED_BACK"
+            and "eval gate failed" in (reports[nan_gen].get("reason") or "")
+            and "non-finite" in (reports[nan_gen].get("reason") or "")),
+        "canary_rejected_regression": bool(
+            reports[regress_gen]["outcome"] == "ROLLED_BACK"
+            and "canary rejected" in (reports[regress_gen].get("reason") or "")
+            and "divergence" in (reports[regress_gen].get("reason") or "")),
+        "midroll_kill_rolled_back": bool(
+            reports[kill_gen]["outcome"] == "ROLLED_BACK"
+            and "rolling swap failed" in (reports[kill_gen].get("reason") or "")),
+        "rollbacks_hit_lineage_target": bool(
+            reports[regress_gen].get("rolled_back_to") == v2
+            and reports[kill_gen].get("rolled_back_to") == v2
+            and reports[regress_gen].get("version") is not None
+            and reports[kill_gen].get("version") is not None
+            and v2 != reports[regress_gen]["version"] - 1
+            and v2 != reports[kill_gen]["version"] - 1),
+        "lineage_chain_ok": bool(
+            reg.lineage("m", reports[nan_gen]["version"])["eval_passed"]
+            is False
+            and reg.rollback_target(
+                "m", reports[kill_gen]["version"]) == v2),
+        "crash_fired": bool(crashed and crash["fired"]),
+        "resume_partial_gen": resume_state["partial"],
+        "train_calls_gen6": train_calls.get(crash_gen, 0),
+        "journal_gen6_stages": journal_stages,
+        "resume_ok": bool(
+            crashed and resume_state["partial"] == crash_gen
+            and train_calls.get(crash_gen, 0) == 1
+            and reports[crash_gen]["outcome"] == "PROMOTED"),
+        "alias_final": alias_final,
+        "fleet_final_tags": final_tags,
+        "fleet_final_hosts": final_hosts,
+        "fleet_converged": bool(
+            final_tags and
+            all(t == f"m:v{v6}" for t in final_tags.values())
+            and final_hosts["h2"] == "down"),
+        "n_submitted": n_submitted,
+        "all_done_before_timeout": bool(all_done),
+        "stranded": int(stranded),
+        "double_delivered": int(double),
+        "errors": errors,
+        "unmatched_versions": int(unmatched),
+        "window_samples": window_samples,
+        "window_violations": int(window_violations),
+        "p99_ms": (round(float(np.percentile(np.asarray(lat), 99)), 2)
+                   if lat else None),
+        "serve_time_bundle_misses": int(serve_compiles),
+        "bundle_hits": {h: end_compiles[h]["bundle_hits"]
+                        for h in end_compiles},
+        "compile_cache_stable": bool(cache_stable),
+        "canary_decisions": [
+            {"to": r["to"], "promoted": r["promoted"],
+             "divergence": r["decisions"][0].get("mean_divergence")
+             if r.get("decisions") else None,
+             "reasons": (r["decisions"][0].get("reasons")
+                         if r.get("decisions") else None)}
+            for r in reg.canary_history("m")],
+    }
+    out["soak_ok"] = bool(
+        out["promoted_generations"] == [1, 2, 6]
+        and out["monotone_eval"]
+        and out["nan_caught_by_eval"]
+        and out["canary_rejected_regression"]
+        and out["midroll_kill_rolled_back"]
+        and out["rollbacks_hit_lineage_target"]
+        and out["lineage_chain_ok"]
+        and out["resume_ok"]
+        and out["fleet_converged"]
+        and out["alias_final"] == v6
+        and out["all_done_before_timeout"]
+        and out["stranded"] == 0
+        and out["double_delivered"] == 0
+        and not out["errors"]
+        and out["unmatched_versions"] == 0
+        and out["window_samples"] > 0
+        and out["window_violations"] == 0
+        and out["serve_time_bundle_misses"] == 0
+        and out["compile_cache_stable"])
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    quick = args.quick or QUICK
+
+    import jax
+    print(f"train_promote_soak: platform={jax.devices()[0].platform}, "
+          f"quick={quick}", file=sys.stderr)
+
+    from deeplearning4j_tpu.obs import trace as obs_trace
+    rec = obs_trace.enable_tracing(capacity=131072)
+
+    out = {"config": "train_promote_loop",
+           "platform": jax.devices()[0].platform, "quick": quick}
+    out.update(run_soak(quick))
+    if not out["soak_ok"]:
+        path = os.path.join(tempfile.gettempdir(),
+                            "train_promote_soak_failure.trace.json")
+        try:
+            out["trace_artifact"] = rec.save(path)
+        except OSError:
+            out["trace_artifact"] = None
+    print(json.dumps(out), flush=True)
+    return 0 if out["soak_ok"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,573 @@
+"""The production flywheel (serving/lifecycle.py) + its seams:
+
+  - registry lineage provenance: records, ``lineage()``,
+    ``rollback_target()`` picking the last *eval-passing* ancestor
+    (never an audit-only eval_passed=False version, never merely v−1)
+  - typed CanaryRejectedError off the set_alias canary path (including
+    the unfilled-window → rollback-not-promote regression through a
+    real Engine), with the default return-record back-compat intact
+  - fleet promote() racing a host death between canary pass and the
+    first roll step: the alias never moves, the lineage target is
+    untouched
+  - ElasticTrainer run_id / final_checkpoint_path + CheckpointManager
+    registry-provenance sidecar (which checkpoint became which version)
+  - PromotionPipeline: happy path through a live fleet, eval-gate
+    rollback, canary rollback, mid-roll host-death rollback to the
+    lineage target, bounded retries, per-stage deadlines, and
+    controller-crash resume from the journal
+"""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datasets import DataSet
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import (
+    MultiLayerNetwork, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.updaters import Sgd
+from deeplearning4j_tpu.parallel.elastic import CheckpointManager, ElasticTrainer
+from deeplearning4j_tpu.serving import (
+    CanaryRejectedError, Engine, EvalGate, FleetRouter, ModelRegistry,
+    PipelineJournal, PipelineStageError, PromotionPipeline,
+    StageDeadlineError, data_fingerprint, weights_sha,
+)
+
+
+def _mlp(seed=7):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(lr=0.05))
+            .layer(Dense(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(12)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def _toy_data(n=8, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 12)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return DataSet(features=x, labels=y)
+
+
+class _Host:
+    """Scriptable fleet host (test_fleet.py's _FakeEngine, trimmed)."""
+
+    def __init__(self, tag="m:v1"):
+        self.tag = tag
+        self.swap_exc = None
+        self.swaps = []
+
+    def output_async(self, x, slo_ms=None):
+        from concurrent.futures import Future
+        fut = Future()
+        fut.set_result(self.tag)
+        return fut
+
+    def swap_model(self, model, tag=None, warm_bundle=None):
+        if self.swap_exc is not None:
+            exc, self.swap_exc = self.swap_exc, None
+            raise exc
+        self.swaps.append(tag)
+        self.tag = tag
+
+    @property
+    def current_tag(self):
+        return self.tag
+
+    def metrics_snapshot(self):
+        return {"queue_depth": 0}
+
+    def shutdown(self):
+        pass
+
+
+def _fleet(n=2, tag="m:v1"):
+    router = FleetRouter(start_watchdog=False)
+    hosts = []
+    for i in range(n):
+        h = _Host(tag=tag)
+        hosts.append(h)
+        router.add_host(f"h{i}", engine=h)
+    return router, hosts
+
+
+class _Model:
+    """Cheap model with distinguishable params per version."""
+
+    def __init__(self, v):
+        self.v = v
+        self.params = {"w": np.full((2, 2), float(v), np.float32)}
+
+    def output(self, x):
+        return np.asarray(x, np.float32) * self.v
+
+
+class _Calc:
+    minimize_score = False
+
+    def __init__(self, score=0.9):
+        self.score = score
+
+    def calculate_score(self, model):
+        s = self.score
+        return s(model) if callable(s) else s
+
+
+# ---------------------------------------------------------------------------
+# registry lineage
+# ---------------------------------------------------------------------------
+
+class TestLineage:
+    def test_records_are_normalized_and_immutable_copies(self):
+        reg = ModelRegistry()
+        v = reg.register("m", _Model(1),
+                         lineage={"run_id": "r1", "eval_score": 0.9,
+                                  "eval_passed": True, "extra": "kept"})
+        rec = reg.lineage("m", v)
+        assert rec["run_id"] == "r1" and rec["extra"] == "kept"
+        assert rec["name"] == "m" and rec["version"] == v
+        # unset LINEAGE_FIELDS are present as None (stable schema)
+        assert rec["weights_sha"] is None and rec["parent_version"] is None
+        rec["run_id"] = "tampered"
+        assert reg.lineage("m", v)["run_id"] == "r1"
+        assert reg.lineage("m", 999) is None
+
+    def test_lineage_listing_version_ascending(self):
+        reg = ModelRegistry()
+        reg.register("m", _Model(1), version=3, lineage={"run_id": "c"})
+        reg.register("m", _Model(2), version=1, lineage={"run_id": "a"})
+        reg.register("m", _Model(3), version=2)   # no lineage — skipped
+        assert [r["run_id"] for r in reg.lineage("m")] == ["a", "c"]
+
+    def test_rollback_target_follows_parent_chain_not_version_minus_1(self):
+        reg = ModelRegistry()
+        v1 = reg.register("m", _Model(1),
+                          lineage={"eval_passed": True, "run_id": "a"})
+        v2 = reg.register("m", _Model(2),
+                          lineage={"eval_passed": False, "run_id": "b",
+                                   "parent_version": v1})
+        v3 = reg.register("m", _Model(3),
+                          lineage={"eval_passed": False, "run_id": "c",
+                                   "parent_version": v2})
+        # v3's rollback target skips the failing v2 straight to v1
+        assert reg.rollback_target("m", version=v3) == v1
+        assert reg.rollback_target("m") == v1   # default: newest
+
+    def test_rollback_target_descending_fallback_without_chain(self):
+        reg = ModelRegistry()
+        v1 = reg.register("m", _Model(1),
+                          lineage={"eval_passed": True})
+        reg.register("m", _Model(2))              # no lineage — not passing
+        v3 = reg.register("m", _Model(3),
+                          lineage={"eval_passed": False})
+        assert reg.rollback_target("m", version=v3) == v1
+
+    def test_rollback_target_none_when_no_passing_ancestor(self):
+        reg = ModelRegistry()
+        reg.register("m", _Model(1), lineage={"eval_passed": False})
+        assert reg.rollback_target("m") is None
+        with pytest.raises(KeyError):
+            reg.rollback_target("ghost")
+
+    def test_rollback_target_survives_parent_cycle(self):
+        reg = ModelRegistry()
+        v1 = reg.register("m", _Model(1),
+                          lineage={"eval_passed": False, "parent_version": 2})
+        reg.register("m", _Model(2),
+                     lineage={"eval_passed": False, "parent_version": v1})
+        assert reg.rollback_target("m") is None   # terminates, no hang
+
+    def test_load_stamps_checkpoint_path_into_lineage(self, tmp_path):
+        from deeplearning4j_tpu.utils.serializer import save_model
+        net = _mlp()
+        p = str(tmp_path / "m.zip")
+        save_model(net, p)
+        reg = ModelRegistry()
+        v = reg.load("m", p, lineage={"run_id": "r9", "eval_passed": True})
+        rec = reg.lineage("m", v)
+        assert rec["checkpoint_path"] == p and rec["run_id"] == "r9"
+        assert reg.checkpoint_path("m", v) == p
+
+
+# ---------------------------------------------------------------------------
+# typed canary rejection
+# ---------------------------------------------------------------------------
+
+class TestCanaryRejectedError:
+    def _reg_with_canary_vote(self, vote):
+        reg = ModelRegistry()
+        v1 = reg.register("m", _Model(1))
+        v2 = reg.register("m", _Model(2))
+        reg.set_alias("m", "prod", v1)
+        swaps = []
+        reg.subscribe("m", "prod", lambda v, m: swaps.append(v),
+                      canary=lambda v, m, **kw: dict(vote))
+        return reg, v1, v2, swaps
+
+    def test_raise_on_reject_surfaces_typed_error(self):
+        vote = {"promote": False, "tag": "m:v2",
+                "reasons": ["error rate 0.5 > max 0.0"]}
+        reg, v1, v2, _ = self._reg_with_canary_vote(vote)
+        with pytest.raises(CanaryRejectedError) as ei:
+            reg.set_alias("m", "prod", v2, canary=0.5, raise_on_reject=True)
+        err = ei.value
+        assert err.name == "m" and err.alias == "prod"
+        assert err.incumbent == v1 and err.candidate == v2
+        assert err.reasons == ["error rate 0.5 > max 0.0"]
+        assert err.record["promoted"] is False
+        assert "error rate" in str(err)
+        # the alias never moved; the rejection is in canary_history
+        assert reg.resolve("m", "prod")[0] == v1
+        assert reg.canary_history("m")[-1]["promoted"] is False
+
+    def test_default_returns_record_back_compat(self):
+        vote = {"promote": False, "reasons": ["nope"]}
+        reg, v1, v2, _ = self._reg_with_canary_vote(vote)
+        record = reg.set_alias("m", "prod", v2, canary=0.5)
+        assert record["promoted"] is False
+        assert reg.resolve("m", "prod")[0] == v1
+
+    def test_promoted_canary_never_raises(self):
+        vote = {"promote": True, "reasons": []}
+        reg, v1, v2, _ = self._reg_with_canary_vote(vote)
+        record = reg.set_alias("m", "prod", v2, canary=0.5,
+                               raise_on_reject=True)
+        assert record["promoted"] is True
+        assert reg.resolve("m", "prod")[0] == v2
+
+    def test_unfilled_window_rolls_back_not_promotes_through_engine(self):
+        """Regression (PR 7 gap): a canary whose mirror window never
+        fills — zero traffic during the evaluation — must vote rollback
+        ("window incomplete"), and through the new API that is a typed
+        rejection with the alias still on the incumbent."""
+        reg = ModelRegistry()
+        v1 = reg.register("m", _mlp(1))
+        reg.set_alias("m", "prod", v1)
+        v2 = reg.register("m", _mlp(2))
+        eng = Engine.from_registry(reg, "m", "prod", replicas=1,
+                                   max_batch=4, slo_ms=10_000.0)
+        eng.load()
+        try:
+            with pytest.raises(CanaryRejectedError) as ei:
+                reg.set_alias("m", "prod", v2, canary=0.5,
+                              canary_window=4, canary_timeout_s=0.3,
+                              raise_on_reject=True)
+            assert any("window incomplete" in r for r in ei.value.reasons)
+            assert reg.resolve("m", "prod")[0] == v1
+            assert eng.current_tag == "m:v1"
+        finally:
+            eng.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fleet promote() vs host death before the first roll step
+# ---------------------------------------------------------------------------
+
+class TestPromoteRace:
+    def test_host_death_after_canary_before_first_roll_step(self):
+        """A host dying in the gap between canary pass and the first
+        roll step: promote() must fail the roll, never move the alias,
+        and leave the lineage rollback target untouched."""
+        reg = ModelRegistry()
+        v1 = reg.register("m", _Model(1),
+                          lineage={"eval_passed": True, "run_id": "a"})
+        reg.set_alias("m", "prod", v1)
+        v2 = reg.register("m", _Model(2),
+                          lineage={"eval_passed": True, "run_id": "b",
+                                   "parent_version": v1})
+        router, hosts = _fleet(n=3, tag="m:v1")
+        # the FIRST host to be rolled dies at its swap — nothing swapped
+        hosts[0].swap_exc = RuntimeError("host died before first roll step")
+        report = router.promote(reg, "m", version=v2)
+        assert not report["ok"] and report["swapped"] == []
+        assert reg.resolve("m", "prod")[0] == v1          # alias never moved
+        assert router.current_tag == "m:v1"
+        assert reg.rollback_target("m", version=v2) == v1  # target untouched
+        assert all(h.swaps == [] for h in hosts)
+        router.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# elastic seams: run_id, final checkpoint, registry provenance
+# ---------------------------------------------------------------------------
+
+class TestElasticSeams:
+    def test_run_id_and_final_checkpoint_path(self, tmp_path):
+        net = _mlp()
+        tr = ElasticTrainer(net, checkpoint_dir=str(tmp_path),
+                            checkpoint_every=2, run_id="run-abc")
+        assert tr.run_id == "run-abc"
+        assert tr.final_checkpoint_path is None
+        tr.fit(_toy_data(), epochs=1)
+        p = tr.final_checkpoint_path
+        assert p is not None and os.path.exists(p)
+        assert tr.recovery_stats()["run_id"] == "run-abc"
+        # default run_id: generated, unique per trainer
+        ids = {ElasticTrainer(_mlp(), checkpoint_dir=str(tmp_path / f"d{i}"),
+                              ).run_id for i in range(3)}
+        assert len(ids) == 3 and all(ids)
+
+    def test_resume_recovers_final_checkpoint_path(self, tmp_path):
+        tr = ElasticTrainer(_mlp(), checkpoint_dir=str(tmp_path),
+                            checkpoint_every=2)
+        tr.fit(_toy_data(), epochs=1)
+        p = tr.final_checkpoint_path
+        tr2 = ElasticTrainer(_mlp(), checkpoint_dir=str(tmp_path),
+                             checkpoint_every=2)
+        tr2.resume()
+        assert tr2.final_checkpoint_path == p
+
+    def test_note_registered_sidecar_persists(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        net = _mlp()
+        p = mgr.save(net, 10)
+        mgr.note_registered(p, "m", 3)
+        assert mgr.registered_version(p) == ("m", 3)
+        # a fresh manager over the same directory reloads the sidecar
+        mgr2 = CheckpointManager(str(tmp_path))
+        assert mgr2.registered_version(p) == ("m", 3)
+        assert mgr2.registered_version("nope.zip") is None
+
+    def test_unreadable_sidecar_is_tolerated(self, tmp_path):
+        mgr = CheckpointManager(str(tmp_path))
+        with open(mgr._provenance_path(), "w") as f:
+            f.write("{not json")
+        mgr2 = CheckpointManager(str(tmp_path))   # must not raise
+        assert mgr2.registered == {}
+
+
+# ---------------------------------------------------------------------------
+# journal + gate + fingerprints
+# ---------------------------------------------------------------------------
+
+class TestJournalAndGate:
+    def test_journal_replay_drops_torn_final_line(self, tmp_path):
+        j = PipelineJournal(str(tmp_path / "j.jsonl"))
+        j.append({"gen": 1, "stage": "TRAIN", "status": "done"})
+        j.append({"gen": 1, "stage": "EVAL", "status": "done"})
+        with open(j.path, "a") as f:
+            f.write('{"gen": 1, "stage": "REGI')   # torn by a crash
+        recs = j.replay()
+        assert [r["stage"] for r in recs] == ["TRAIN", "EVAL"]
+        assert PipelineJournal(str(tmp_path / "absent.jsonl")).replay() == []
+
+    def test_eval_gate_direction_and_nonfinite(self):
+        up = EvalGate(_Calc(0.8), threshold=0.5)       # maximize (accuracy)
+        assert up.check(None)["passed"]
+        assert not EvalGate(_Calc(0.4), threshold=0.5).check(None)["passed"]
+
+        class Loss:
+            minimize_score = True
+            def calculate_score(self, model): return 0.3
+        down = EvalGate(Loss(), threshold=0.5)         # minimize (loss)
+        assert down.minimize and down.check(None)["passed"]
+
+        nan = EvalGate(_Calc(float("nan")), threshold=0.5)
+        verdict = nan.check(None)
+        assert not verdict["passed"] and "non-finite" in verdict["reason"]
+        assert math.isnan(verdict["score"])
+
+    def test_weights_sha_and_data_fingerprint(self):
+        a, b = _Model(1), _Model(1)
+        assert weights_sha(a) == weights_sha(b)
+        assert weights_sha(a) != weights_sha(_Model(2))
+        ds = _toy_data()
+        assert data_fingerprint(ds) == data_fingerprint(ds)
+        assert data_fingerprint(ds) != data_fingerprint(_toy_data(seed=1))
+        assert data_fingerprint(ds.features) != data_fingerprint(ds)
+
+
+# ---------------------------------------------------------------------------
+# the flywheel controller
+# ---------------------------------------------------------------------------
+
+def _pipeline(reg, fleet, train_fn, tmp_path, calc=None, **kw):
+    kw.setdefault("build_warm_bundle", False)
+    kw.setdefault("journal_path", str(tmp_path / "pipeline.jsonl"))
+    gate = EvalGate(calc or _Calc(0.9), threshold=0.5)
+    return PromotionPipeline(reg, fleet, "m", train_fn, gate, **kw)
+
+
+class TestPromotionPipeline:
+    def test_happy_path_promotes_through_fleet(self, tmp_path):
+        reg = ModelRegistry()
+        router, hosts = _fleet(n=2, tag="")
+        pipe = _pipeline(reg, router, lambda g: _Model(g), tmp_path,
+                         data_slice=_toy_data())
+        rep = pipe.run_generation()
+        assert rep["outcome"] == "PROMOTED"
+        v = rep["version"]
+        assert reg.resolve("m", "prod")[0] == v
+        assert router.current_tag == f"m:v{v}"
+        rec = reg.lineage("m", v)
+        assert rec["eval_passed"] and rec["weights_sha"]
+        assert rec["data_fingerprint"] == data_fingerprint(_toy_data())
+        assert rec["parent_version"] is None
+        # second generation chains lineage to the first
+        rep2 = pipe.run_generation()
+        assert rep2["outcome"] == "PROMOTED"
+        assert reg.lineage("m", rep2["version"])["parent_version"] == v
+        router.shutdown()
+
+    def test_eval_failure_registers_audit_record_and_rolls_back(self, tmp_path):
+        reg = ModelRegistry()
+        router, hosts = _fleet(n=2, tag="")
+        calc = _Calc(0.9)
+        pipe = _pipeline(reg, router, lambda g: _Model(g), tmp_path, calc=calc)
+        good = pipe.run_generation()
+        calc.score = 0.1
+        bad = pipe.run_generation()
+        assert bad["outcome"] == "ROLLED_BACK"
+        assert bad["rolled_back_to"] == good["version"]
+        # the failing version IS registered (audit) but flagged
+        rec = reg.lineage("m", bad["version"])
+        assert rec["eval_passed"] is False
+        assert reg.rollback_target("m") == good["version"]
+        assert reg.resolve("m", "prod")[0] == good["version"]
+        assert router.current_tag == f"m:v{good['version']}"
+        router.shutdown()
+
+    def test_canary_rejection_rolls_back_alias(self, tmp_path):
+        reg = ModelRegistry()
+        votes = []
+        def canary_cb(v, m, **kw):
+            vote = {"promote": len(votes) == 0, "reasons": ["regressed p99"]}
+            votes.append(vote)
+            return vote
+        swaps = []
+        reg.subscribe("m", "prod", lambda v, m: swaps.append(v),
+                      canary=canary_cb)
+        pipe = _pipeline(reg, None, lambda g: _Model(g), tmp_path,
+                         canary_frac=0.5)
+        g1 = pipe.run_generation()        # no incumbent -> plain alias move
+        g2 = pipe.run_generation()        # canary vote #1: promote
+        assert g2["outcome"] == "PROMOTED"
+        g3 = pipe.run_generation()        # canary vote #2: reject
+        assert g3["outcome"] == "ROLLED_BACK"
+        assert "canary rejected" in g3["reason"]
+        assert g3["rolled_back_to"] == g2["version"]
+        assert reg.resolve("m", "prod")[0] == g2["version"]
+        assert pipe.stats()["rolled_back"] == 1
+
+    def test_mid_roll_host_death_rolls_back_to_lineage_target(self, tmp_path):
+        reg = ModelRegistry()
+        router, hosts = _fleet(n=3, tag="")
+        pipe = _pipeline(reg, router, lambda g: _Model(g), tmp_path,
+                         stage_retries=0)
+        good = pipe.run_generation()
+        hosts[1].swap_exc = RuntimeError("host killed mid-roll")
+        bad = pipe.run_generation()
+        assert bad["outcome"] == "ROLLED_BACK"
+        assert "rolling swap failed" in bad["reason"]
+        assert bad["rolled_back_to"] == good["version"]
+        # alias (moved by the canary-less flip) came BACK to the target,
+        # and the surviving hosts serve it
+        assert reg.resolve("m", "prod")[0] == good["version"]
+        assert router.current_tag == f"m:v{good['version']}"
+        assert router.hosts()["h1"] == "down"
+        router.shutdown()
+
+    def test_stage_retries_bounded_and_counted(self, tmp_path):
+        reg = ModelRegistry()
+        attempts = []
+        def flaky(g):
+            attempts.append(g)
+            if len(attempts) < 3:
+                raise OSError("preempted")
+            return _Model(g)
+        pipe = _pipeline(reg, None, flaky, tmp_path,
+                         stage_retries={"TRAIN": 2})
+        rep = pipe.run_generation()
+        assert rep["outcome"] == "PROMOTED" and len(attempts) == 3
+        # exhausted budget -> PipelineStageError -> rolled back
+        attempts.clear()
+        def dead(g):
+            attempts.append(g)
+            raise OSError("gone")
+        pipe2 = _pipeline(reg, None, dead, tmp_path,
+                          journal_path=str(tmp_path / "j2.jsonl"),
+                          stage_retries={"TRAIN": 1})
+        rep2 = pipe2.run_generation()
+        assert rep2["outcome"] == "ROLLED_BACK" and len(attempts) == 2
+        assert "TRAIN" in rep2["reason"]
+
+    def test_stage_deadline_enforced(self, tmp_path):
+        reg = ModelRegistry()
+        t = [0.0]
+        def clock():
+            return t[0]
+        def slow(g):
+            t[0] += 99.0
+            return _Model(g)
+        pipe = _pipeline(reg, None, slow, tmp_path, clock=clock,
+                         stage_retries=0, stage_deadline_s={"TRAIN": 5.0})
+        rep = pipe.run_generation()
+        assert rep["outcome"] == "ROLLED_BACK"
+        assert "deadline" in rep["reason"]
+
+    def test_controller_crash_resumes_from_journal(self, tmp_path):
+        reg = ModelRegistry()
+        trained = []
+        def train_fn(g):
+            trained.append(g)
+            return _Model(g)
+        class _Crash(Exception):
+            """Simulated controller kill — the stage hook runs OUTSIDE
+            the retry machinery, so this propagates like SIGKILL would."""
+        boom = {"armed": True}
+        def crash_at_canary(stage, gen):
+            if stage == "CANARY" and gen == 2 and boom["armed"]:
+                boom["armed"] = False
+                raise _Crash("controller killed")
+        pipe = _pipeline(reg, None, train_fn, tmp_path,
+                         stage_hook=crash_at_canary)
+        pipe.run_generation()                       # gen 1 promotes clean
+        with pytest.raises(_Crash):
+            pipe.run_generation()                   # gen 2 dies at CANARY
+        assert trained == [1, 2]
+        # a NEW controller over the same journal resumes gen 2 at CANARY:
+        # TRAIN is NOT re-run, the registered version is reused
+        pipe2 = _pipeline(reg, None, train_fn, tmp_path)
+        state = pipe2.resume()
+        assert state["partial"] == 2
+        rep = pipe2.run_generation()
+        assert rep["gen"] == 2 and rep["outcome"] == "PROMOTED"
+        assert trained == [1, 2]                    # no retrain
+        assert len(reg.versions("m")) == 2          # no duplicate register
+        assert pipe2.stats()["resumes"] == 1
+
+    def test_run_counts_journaled_generations(self, tmp_path):
+        reg = ModelRegistry()
+        pipe = _pipeline(reg, None, lambda g: _Model(g), tmp_path)
+        reports = pipe.run(generations=3)
+        assert [r["gen"] for r in reports] == [1, 2, 3]
+        # a resumed controller sees them complete; run(3) is a no-op
+        pipe2 = _pipeline(reg, None, lambda g: _Model(g), tmp_path)
+        assert len(pipe2.run(generations=3)) == 3
+        assert len(reg.versions("m")) == 3
+
+    def test_elastic_trainer_result_stamps_lineage(self, tmp_path):
+        reg = ModelRegistry()
+        def train_fn(g):
+            tr = ElasticTrainer(_mlp(g), checkpoint_dir=str(tmp_path / f"g{g}"),
+                                checkpoint_every=2, run_id=f"run-{g}")
+            tr.fit(_toy_data(), epochs=1)
+            return tr
+        pipe = _pipeline(reg, None, train_fn, tmp_path)
+        rep = pipe.run_generation()
+        assert rep["outcome"] == "PROMOTED"
+        rec = reg.lineage("m", rep["version"])
+        assert rec["run_id"] == "run-1"
+        assert rec["checkpoint_path"] and os.path.exists(rec["checkpoint_path"])
+        assert reg.checkpoint_path("m", rep["version"]) == rec["checkpoint_path"]
+        # CheckpointManager knows which checkpoint became which version
+        mgr = CheckpointManager(str(tmp_path / "g1"))
+        assert mgr.registered_version(rec["checkpoint_path"]) == \
+            ("m", rep["version"])
