@@ -100,6 +100,18 @@ stderr, including:
     their regression, crash-resume without retraining, zero dropped/
     stranded/version-mixed requests, and zero serve-time compiles
     (docs/LIFECYCLE.md)
+  - multitenant_soak: the multi-tenant many-model serving gate
+    (scripts/multitenant_soak.py) — 3 models x 3 tenants on a 3-host
+    fleet (per-host TenantTables: weighted-fair lanes + atomic quotas;
+    a PlacementController mapping (model, host) from live traffic)
+    under open-loop mixed load where one tenant 10x-bursts, a host
+    dies mid-burst, and the idle model is evicted then demand-
+    reloaded; hard-gated on victim-tenant p99/error isolation, exact
+    ledger==tables==metrics shed attribution to the bursting tenant,
+    zero version/tenant mixing, nothing stranded or double-delivered,
+    the placement loop actuating (widen/evict/demand-load), and zero
+    serve-time compiles across every placement move
+    (docs/SERVING.md "Multi-tenant serving")
   - decode_tokens_per_sec: the autoregressive-decode A/B gate
     (scripts/decode_ab.py) — static-batch full-re-encode decoding vs
     serving.DecodeEngine (paged KV-cache, bucketed prefill/decode split,
@@ -1386,6 +1398,85 @@ def bench_train_promote():
             "wall_seconds": soak["wall_seconds"]}
 
 
+def bench_multitenant():
+    """Config 26: the multi-tenant many-model serving gate
+    (scripts/multitenant_soak.py; CPU subprocess — admission/placement
+    logic is host-side).  Three models on a 3-host fleet, three tenants
+    under the same per-host TenantTable (weighted-fair lanes, atomic
+    check-and-charge quotas), a PlacementController closing the
+    (model, host) loop, open-loop mixed traffic.  Chaos: one tenant
+    10x-bursts its model (shared with a victim tenant), an m2-holding
+    host is killed mid-burst, the idle model is controller-evicted and
+    then demand-reloaded by fresh traffic.  HARD gates: both victim
+    tenants' burst-window p99 inside the calm envelope with ZERO victim
+    sheds/errors (the burst tenant sheds only its own traffic), exact
+    three-way shed attribution (request ledger == host TenantTables ==
+    per-tenant metric label slices, every TenantOverloadedError naming
+    the bursting tenant), zero version/tenant mixing on classified
+    responses, nothing stranded or double-delivered through the kill,
+    the placement loop observed widening the hot model and evicting +
+    demand-reloading the cold one, and zero serve-time compiles — no
+    warm-bundle miss and no compile-cache growth across eviction,
+    reload, and widening.  The reported value is the victim tenants'
+    burst-window p99."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    script = os.path.join(_REPO, "scripts", "multitenant_soak.py")
+    cmd = [sys.executable, script] + (["--quick"] if QUICK else [])
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=1800, cwd=_REPO)
+    if p.returncode not in (0, 2) or not p.stdout.strip():
+        raise RuntimeError(f"multitenant_soak failed (rc={p.returncode}): "
+                           f"{p.stdout[-500:]} {p.stderr[-1000:]}")
+    soak = json.loads(p.stdout.strip().splitlines()[-1])
+    if soak.get("stranded") != 0 or soak.get("double_delivered") != 0 \
+            or not soak.get("all_done_before_timeout"):
+        raise RuntimeError(f"multitenant soak stranded requests: {soak}")
+    if not soak.get("victims_ok") or soak.get("victim_sheds") != 0 \
+            or soak.get("victim_errors") != 0:
+        raise RuntimeError("victim-tenant isolation gate FAILED (burst "
+                           f"leaked into a victim's p99/errors): {soak}")
+    if not soak.get("burst_sheds") or not soak.get("attribution_exact"):
+        raise RuntimeError("exact shed-attribution gate FAILED (ledger, "
+                           f"host tables and metric slices disagree): {soak}")
+    if soak.get("mixed_responses") != 0:
+        raise RuntimeError(f"version/tenant mixing detected: {soak}")
+    if not soak.get("host_killed") \
+            or soak.get("hosts_final", {}).get("h1") != "down":
+        raise RuntimeError(f"mid-burst host kill did not land: {soak}")
+    if not soak.get("m3_evicted") or not soak.get("m3_reloaded") \
+            or not soak.get("m3_ok_responses"):
+        raise RuntimeError("cold-model evict + demand-reload gate "
+                           f"FAILED: {soak}")
+    if not soak.get("placements") or not soak.get("placement_evictions") \
+            or not soak.get("demand_loads") or not soak.get("model_misses"):
+        raise RuntimeError(f"placement loop never actuated: {soak}")
+    if soak.get("serve_time_bundle_misses") != 0 \
+            or not soak.get("compile_caches_stable"):
+        raise RuntimeError("serve-time compile gate FAILED (a placement "
+                           f"move missed its warm bundle): {soak}")
+    if not soak.get("soak_ok"):
+        raise RuntimeError(f"multitenant_soak gate FAILED: {soak}")
+    iso = soak["isolation"]
+    p99 = max(iso[t]["burst_p99_ms"] for t in iso)
+    return {"metric": "multitenant_soak", "value": p99,
+            "unit": "ms victim burst p99",
+            "platform": soak["platform"],
+            "requests": soak["n_requests"],
+            "burst_sheds": soak["burst_sheds"],
+            "victim_sheds": 0, "victim_errors": 0,
+            "attribution_exact": True, "mixed_responses": 0,
+            "placements": soak["placements"],
+            "placement_evictions": soak["placement_evictions"],
+            "demand_loads": soak["demand_loads"],
+            "stranded": 0, "double_delivered": 0,
+            "serve_time_bundle_misses": 0,
+            "wall_seconds": soak["wall_seconds"]}
+
+
 def bench_chaos_recovery():
     """Config 11: chaos-tested fault recovery (scripts/chaos_soak.py; the
     subprocess mechanism, CPU — fault injection needs no accelerator).  A
@@ -2015,7 +2106,8 @@ def main() -> None:
                      ("cold_start_ab", bench_cold_start),
                      ("decode_speed_ab", bench_decode_speed),
                      ("disagg_decode_ab", bench_disagg_decode),
-                     ("train_promote_loop", bench_train_promote)]:
+                     ("train_promote_loop", bench_train_promote),
+                     ("multitenant_soak", bench_multitenant)]:
         try:
             t0 = time.perf_counter()
             out = fn()

@@ -524,6 +524,61 @@ def cmd_predict(args) -> int:
     return 0
 
 
+def _parse_tenants(path):
+    """tenants.json → serving.TenantTable (docs/SERVING.md "Multi-tenant
+    serving").  The file is a JSON list of tenant rows (or an object
+    with a "tenants" list), each row the TenantConfig dict shape:
+    {"tenant": "acme", "model": null, "slo_ms": 50, "weight": 2.0,
+    "quota_qps": 100, "quota_concurrent": 8, "admission": "shed"}.
+    Every parse or validation failure is a one-line CLI error, not a
+    traceback."""
+    from .serving import TenantTable
+
+    try:
+        with open(path) as f:
+            rows = json.load(f)
+    except OSError as e:
+        raise SystemExit(f"bad --tenants {path!r}: {e}")
+    except json.JSONDecodeError as e:
+        raise SystemExit(f"bad --tenants {path!r}: invalid JSON ({e})")
+    if isinstance(rows, dict):
+        rows = rows.get("tenants", rows)
+    if not isinstance(rows, list) or not rows or not all(
+            isinstance(r, dict) for r in rows):
+        raise SystemExit(f"bad --tenants {path!r}: expected a non-empty "
+                         "JSON list of tenant rows (or "
+                         '{"tenants": [...]})')
+    try:
+        return TenantTable.from_specs(rows)
+    except (TypeError, ValueError) as e:
+        raise SystemExit(f"bad --tenants {path!r}: {e}")
+
+
+def _parse_models(spec):
+    """'NAME=PATH[,NAME=PATH...]' (or bare checkpoint paths — the name
+    is the file stem) → [(name, path)] with clean CLI errors."""
+    import os
+
+    out, seen = [], set()
+    for part in [p.strip() for p in spec.split(",") if p.strip()]:
+        name, sep, path = part.partition("=")
+        if not sep:
+            name = os.path.splitext(os.path.basename(part))[0]
+            path = part
+        if not name or not path:
+            raise SystemExit(f"bad --models {spec!r}: expected "
+                             "NAME=PATH[,NAME=PATH...] or a comma-"
+                             "separated list of checkpoint paths")
+        if name in seen:
+            raise SystemExit(f"bad --models {spec!r}: duplicate model "
+                             f"name {name!r}")
+        seen.add(name)
+        out.append((name, path))
+    if not out:
+        raise SystemExit(f"bad --models {spec!r}: no models")
+    return out
+
+
 def _serve_queue_depth(engine) -> int:
     """Pending work still inside a serving engine (or fleet router) —
     the drain loop below waits for this to reach zero."""
@@ -559,13 +614,21 @@ def cmd_serve(args) -> int:
     cache_dir = enable_compile_cache(getattr(args, "compile_cache", None))
     if cache_dir:
         print(f"compile cache: {cache_dir}")
-    if not args.fleet and not args.model:
-        raise SystemExit("serve needs --model (or --fleet HOST:PORT,...)")
+    if not args.fleet and not args.model and not getattr(
+            args, "models", None):
+        raise SystemExit("serve needs --model/--models "
+                         "(or --fleet HOST:PORT,...)")
+    tenants = (_parse_tenants(args.tenants)
+               if getattr(args, "tenants", None) else None)
     net = None
     is_lm = False
     if args.fleet:
         if args.smoke:
             raise SystemExit("serve --smoke is incompatible with --fleet")
+        if tenants is not None:
+            raise SystemExit("--tenants configures a serve HOST's "
+                             "admission — pass it to each `serve --model` "
+                             "worker, not the --fleet router")
         engine = FleetRouter(
             max_retries=args.max_retries,
             request_timeout_s=args.forward_timeout,
@@ -579,17 +642,25 @@ def cmd_serve(args) -> int:
               f"request_timeout={args.forward_timeout}")
     else:
         from .models.transformer import TransformerBlock
-        net = _load_model(args.model)
+        model_pairs = (_parse_models(args.models)
+                       if getattr(args, "models", None) else [])
+        if args.model:
+            model_pairs = [(args.name, args.model)] + model_pairs
+        name, default_path = model_pairs[0]
+        net = _load_model(default_path)
         is_lm = any(isinstance(l, TransformerBlock) for l in net.conf.layers)
         if is_lm:
             # a transformer LM has no float /predict surface (the predict
             # engine's warmup batches are float feature rows) — serve it
             # decode-only: POST /generate below, /predict answers 503
+            if len(model_pairs) > 1:
+                raise SystemExit("--models needs predict checkpoints "
+                                 "(float feature inputs) — a transformer "
+                                 "LM serves decode-only via --model")
             engine = None
         else:
             reg = ModelRegistry()
-            name = args.name
-            version = reg.load(name, args.model, version=args.version)
+            version = reg.load(name, default_path, version=args.version)
             reg.set_alias(name, "prod", version)
             engine = Engine.from_registry(
                 reg, name, "prod", max_batch=args.max_batch,
@@ -598,15 +669,26 @@ def cmd_serve(args) -> int:
                 admission=args.admission,
                 forward_timeout_s=args.forward_timeout,
                 max_retries=args.max_retries,
-                breaker_threshold=args.breaker_threshold)
+                breaker_threshold=args.breaker_threshold,
+                tenants=tenants)
             # an explicit --warm-bundle wins; otherwise the registry's
             # checkpoint provenance finds `<checkpoint>.warm` automatically
             engine.load(warm_bundle=getattr(args, "warm_bundle", None))
+            # --models extras: registered + AOT-warmed alongside the
+            # default, addressable via the request's "model" field
+            for extra_name, extra_path in model_pairs[1:]:
+                v = reg.load(extra_name, extra_path)
+                reg.set_alias(extra_name, "prod", v)
+                engine.add_model_from_registry(reg, extra_name, "prod")
             print(f"serving {name} v{version} (alias 'prod'): "
                   f"max_batch={args.max_batch}, slo={args.slo_ms}ms, "
                   f"replicas={len(engine._replicas)}, "
                   f"admission={args.admission}, "
                   f"warmed buckets {engine.batcher.buckets}")
+            if len(model_pairs) > 1:
+                print(f"models placed: {engine.placed_models()}")
+    if tenants is not None:
+        print(f"tenants: {sorted(tenants.tenants())} from {args.tenants}")
     if args.smoke:
         if engine is None:
             raise SystemExit("serve --smoke needs a predict checkpoint "
@@ -659,7 +741,7 @@ def cmd_serve(args) -> int:
                              "a transformer LM checkpoint")
         opts = _decode_opts(args)
         decode_eng = DecodeEngine(TransformerDecodeAdapter(net),
-                                  **opts).load()
+                                  tenants=tenants, **opts).load()
         server.attach_decode_engine(decode_eng)
         print(f"decode engine on POST /generate: "
               f"role={opts['role']}, "
@@ -1409,7 +1491,22 @@ def build_parser() -> argparse.ArgumentParser:
 
     v = sub.add_parser("serve", help="serve a saved model (docs/SERVING.md)")
     v.add_argument("--model", default=None,
-                   help="checkpoint zip to serve (required unless --fleet)")
+                   help="checkpoint zip to serve (required unless --fleet "
+                   "or --models)")
+    v.add_argument("--models", metavar="NAME=PATH,...",
+                   help="boot a multi-model host: comma-separated "
+                   "checkpoints (NAME=PATH, or bare paths — the name is "
+                   "the file stem), all registered and AOT-warmed on one "
+                   "engine; the first (or --model) is the default, the "
+                   "rest are addressed by the request's 'model' field "
+                   "(docs/SERVING.md 'Multi-tenant serving')")
+    v.add_argument("--tenants", metavar="JSON",
+                   help="per-tenant admission classes: a JSON list of "
+                   "rows {tenant, model?, slo_ms?, weight?, quota_qps?, "
+                   "quota_concurrent?, admission?} enforced by the "
+                   "batcher's weighted-fair lanes — over-quota requests "
+                   "shed typed, and the HTTP 429 carries the tenant "
+                   "(docs/SERVING.md 'Multi-tenant serving')")
     v.add_argument("--fleet", metavar="HOST:PORT,...",
                    help="run a fleet router instead of a local engine: "
                    "front the comma-separated serve hosts with "

@@ -34,6 +34,15 @@ remains as a thin back-compat shim over this engine).  Pieces:
   autoscale.py load-driven replica autoscaling controller (hysteresis +
                cooldown + bounds, injectable clock); actuated by the
                engine supervisor loops via PR-7 birth/retire machinery
+  tenancy.py   multi-tenant admission: TenantConfig SLO classes
+               (slo_ms, fair-share weight, qps/concurrent quotas,
+               shed/block policy), TenantTable atomic check-and-charge,
+               typed TenantOverloadedError carrying the tenant — the
+               batchers' per-tenant weighted-fair lanes read this table
+  placement.py traffic-driven (model, host) placement over one fleet:
+               per-model EWMA demand + the autoscale control law widen
+               hot models, narrow/evict cold ones (warm-bundle loads),
+               and demand-reload on a router model miss
   lifecycle.py the production flywheel: PromotionPipeline runs
                TRAIN → EVAL → REGISTER → CANARY → ROLL repeatedly with
                lineage-provenance registration, warm-bundle-at-save,
@@ -53,10 +62,12 @@ from .batcher import (
 )
 from .decode import DecodeEngine, GenerationResult, PrefillHandoff
 from .engine import (
-    Engine, PoisonInputError, ReplicaCrashError, ReplicaHungError,
-    ServingUnavailableError,
+    Engine, ModelNotLoadedError, PoisonInputError, ReplicaCrashError,
+    ReplicaHungError, ServingUnavailableError,
 )
 from .fleet import FleetHost, FleetRouter, FleetTimeoutError, HttpHost
+from .placement import PlacementController
+from .tenancy import TenantConfig, TenantOverloadedError, TenantTable
 from .lifecycle import (
     EvalGate, PipelineJournal, PipelineStageError, PromotionPipeline,
     StageDeadlineError, data_fingerprint, weights_sha,
@@ -75,12 +86,14 @@ __all__ = [
     "DecodeEngine", "DecodeMetrics", "DynamicBatcher", "Engine",
     "EvalGate",
     "FleetHost", "FleetMetrics", "FleetRouter", "FleetTimeoutError",
-    "GenerationResult", "HttpHost", "LatencyHistogram", "ModelRegistry",
+    "GenerationResult", "HttpHost", "LatencyHistogram",
+    "ModelNotLoadedError", "ModelRegistry",
     "OverloadedError", "PipelineJournal", "PipelineStageError",
-    "PoisonInputError", "PrefillHandoff",
+    "PlacementController", "PoisonInputError", "PrefillHandoff",
     "PromotionPipeline", "ReplicaAutoscaler",
     "ReplicaCrashError", "ReplicaHungError", "ServingMetrics",
-    "ServingUnavailableError", "StageDeadlineError", "bundle_path_for",
+    "ServingUnavailableError", "StageDeadlineError", "TenantConfig",
+    "TenantOverloadedError", "TenantTable", "bundle_path_for",
     "data_fingerprint", "device_fingerprint",
     "enable_compile_cache", "load_bundle", "pow2_buckets", "save_bundle",
     "weights_sha",

@@ -106,7 +106,8 @@ import numpy as np
 
 from ..obs import trace as obs_trace
 from .batcher import ContinuousBatcher, pow2_buckets
-from .engine import PoisonInputError, ReplicaCrashError, _fail_safe, _set_safe
+from .engine import (ModelNotLoadedError, PoisonInputError,
+                     ReplicaCrashError, _fail_safe, _set_safe)
 from .metrics import DecodeMetrics
 
 FINISH_REASONS = ("eos", "max_tokens", "deadline")
@@ -381,7 +382,7 @@ class DecodeEngine:
                  metrics: Optional[DecodeMetrics] = None,
                  prefix_cache: bool = False, draft_model=None,
                  speculate_k: int = 4, kv_dtype: Optional[str] = None,
-                 role: str = "unified"):
+                 role: str = "unified", tenants=None):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
         if kv_dtype not in (None, "f32", "float32", "int8", "i8"):
@@ -449,9 +450,11 @@ class DecodeEngine:
                 f"full-length request ({prog.pages_per_slot} pages) plus "
                 "the scratch page")
         self.metrics = metrics or DecodeMetrics()
+        self.tenants = tenants           # tenancy.TenantTable or None
         self.batcher = ContinuousBatcher(
             max_batch=self.max_slots, slo_ms=slo_ms, max_queue=max_queue,
-            admission=admission, metrics=self.metrics, clock=clock)
+            admission=admission, metrics=self.metrics, clock=clock,
+            tenants=tenants)
         buckets = sorted(set(int(b) for b in (prompt_buckets
                                               or pow2_buckets(prog.max_len))))
         self.prompt_buckets = [b for b in buckets if 0 < b <= prog.max_len]
@@ -463,6 +466,11 @@ class DecodeEngine:
         params = getattr(model, "params", model)
         self._versions: Dict[str, Any] = {tag: params}
         self._serve_tag = tag
+        # NAMED models this engine also decodes: name -> serve tag in
+        # _versions.  Param trees must be shape-compatible with the
+        # loaded program (executables are shared across versions).
+        self._model_tags: Dict[str, str] = {}
+        self._model_last_used: Dict[str, float] = {}
         self._slots: List[Optional[_Slot]] = [None] * self.max_slots
         self._page_table = np.zeros(
             (self.max_slots, prog.pages_per_slot), np.int32)
@@ -814,12 +822,25 @@ class DecodeEngine:
                        top_p: float = 1.0, seed: int = 0,
                        slo_ms: Optional[float] = None,
                        deadline: Optional[float] = None,
-                       echo_logits: bool = False) -> Future:
+                       echo_logits: bool = False,
+                       model: Optional[str] = None,
+                       tenant: Optional[str] = None) -> Future:
         """Enqueue one generation; the Future resolves to a
         ``GenerationResult`` (or a typed serving error).  Joins the
-        running decode batch at the next step boundary."""
+        running decode batch at the next step boundary.  ``model``
+        routes to a placed named model (``add_model``; None = the
+        default); ``tenant`` tags the request for fair-share scheduling
+        and quota accounting."""
         if not self._loaded:
             raise RuntimeError("DecodeEngine.load() must run before generate")
+        if model is not None:
+            with self._lock:
+                if model not in self._model_tags:
+                    f: Future = Future()
+                    f.set_exception(ModelNotLoadedError(
+                        f"model {model!r} is not placed on this decode "
+                        "host"))
+                    return f
         if self.role == "decode":
             raise RuntimeError(
                 "decode-role host accepts page handoffs (continue_async), "
@@ -850,7 +871,8 @@ class DecodeEngine:
                         top_p=float(top_p), seed=int(seed),
                         echo_logits=bool(echo_logits))
         return self.batcher.submit_request(spec, slo_ms=slo_ms,
-                                           deadline=deadline)
+                                           deadline=deadline,
+                                           tenant=tenant, model=model)
 
     def generate(self, prompt_ids, **kw) -> GenerationResult:
         """Blocking ``generate_async``."""
@@ -858,7 +880,8 @@ class DecodeEngine:
 
     def continue_async(self, handoff: PrefillHandoff, *,
                        slo_ms: Optional[float] = None,
-                       deadline: Optional[float] = None) -> Future:
+                       deadline: Optional[float] = None,
+                       tenant: Optional[str] = None) -> Future:
         """Enqueue the DECODE stage of a disaggregated generation:
         attach the prefill host's exported KV pages, then stream tokens
         from the already-sampled first token.  Only valid on a
@@ -893,19 +916,16 @@ class DecodeEngine:
             seed=int(handoff.seed),
             echo_logits=bool(handoff.echo_logits), handoff=handoff)
         return self.batcher.submit_request(spec, slo_ms=slo_ms,
-                                           deadline=deadline)
+                                           deadline=deadline, tenant=tenant)
 
     # -- hot-swap ----------------------------------------------------------
 
-    def swap_model(self, model, tag: str) -> None:
-        """Flip the version NEW admissions decode under; in-flight slots
-        finish under the version that prefilled them (the step runs per
-        distinct active tag), so no request mixes versions and nothing
-        drains.  The incoming params must match the loaded shapes/dtypes
-        — the AOT executables are shared across versions."""
+    def _check_params_compat(self, params, tag: str) -> None:
+        """Incoming params must match the loaded shapes/dtypes — the
+        AOT executables are shared across every version and named
+        model on this engine."""
         import jax
 
-        params = getattr(model, "params", model)
         ref = self._versions[self._serve_tag]
         try:
             mismatch = jax.tree_util.tree_map(
@@ -920,12 +940,114 @@ class DecodeEngine:
                 f"incoming model {tag!r} has mismatched parameter "
                 "shapes/dtypes — decode versions must share the compiled "
                 "executables")
+
+    def swap_model(self, model, tag: str,
+                   name: Optional[str] = None) -> None:
+        """Flip the version NEW admissions decode under; in-flight slots
+        finish under the version that prefilled them (the step runs per
+        distinct active tag), so no request mixes versions and nothing
+        drains.  ``name`` scopes the flip to one placed named model
+        (swaps never cross models/tenants); None flips the default."""
+        params = getattr(model, "params", model)
+        self._check_params_compat(params, tag)
         with self._lock:
-            self._versions[tag] = params
-            self._serve_tag = tag
+            if name is not None:
+                if name not in self._model_tags:
+                    raise ModelNotLoadedError(
+                        f"model {name!r} is not placed on this decode host")
+                self._versions[tag] = params
+                self._model_tags[name] = tag
+            else:
+                self._versions[tag] = params
+                self._serve_tag = tag
         self.metrics.inc("swaps")
         obs_trace.instant("serve/swap", cat="serve", incoming=tag,
-                          kind="decode")
+                          kind="decode", model=name)
+
+    # -- multi-model placement ---------------------------------------------
+
+    def add_model(self, name: str, model,
+                  tag: Optional[str] = None) -> "DecodeEngine":
+        """Place a NAMED model alongside the default: its param tree
+        must be shape/dtype-compatible with the loaded decode program
+        (same vocab, max_len, page layout — the compiled step/prefill
+        executables are shared, so placement costs a params residency,
+        not a compile).  New generations route with
+        ``generate_async(model=name)``."""
+        if not name:
+            raise ValueError("model name must be non-empty")
+        params = getattr(model, "params", model)
+        tag = tag or f"{name}:v0"
+        self._check_params_compat(params, tag)
+        with self._lock:
+            if name in self._model_tags:
+                raise ValueError(f"model {name!r} is already placed")
+            self._versions[tag] = params
+            self._model_tags[name] = tag
+            self._model_last_used[name] = self.clock()
+        self.metrics.inc("model_loads")
+        obs_trace.instant("serve/model_load", cat="serve", model=name,
+                          tag=tag, kind="decode")
+        return self
+
+    def add_model_from_registry(self, registry, name: str,
+                                ref: str = "prod", *,
+                                subscribe: bool = False) -> "DecodeEngine":
+        """Registry-backed :meth:`add_model` (tag = ``name:vN``).
+        ``subscribe=True`` follows alias moves with per-model swaps —
+        leave False under a placement controller."""
+        version, model = registry.resolve(name, ref)
+        self.add_model(name, model, tag=f"{name}:v{version}")
+        if subscribe:
+            registry.subscribe(
+                name, ref,
+                lambda ver, m: self.swap_model(m, f"{name}:v{ver}",
+                                               name=name))
+        return self
+
+    def remove_model(self, name: str) -> bool:
+        """Evict a named model: unroute it (queued requests fail typed
+        at admission → the fleet re-routes).  In-flight slots finish
+        under their own tag — the params stay resident until the last
+        such slot completes (version GC), so eviction never strands a
+        generation or mixes versions.  Returns False if not placed."""
+        with self._lock:
+            tag = self._model_tags.pop(name, None)
+            self._model_last_used.pop(name, None)
+            if tag is None:
+                return False
+            live = {sl.tag for sl in self._slots if sl is not None}
+            live.add(self._serve_tag)
+            live.update(self._model_tags.values())
+            if tag not in live:
+                del self._versions[tag]
+        self.metrics.inc("model_evictions")
+        obs_trace.instant("serve/model_evict", cat="serve", model=name,
+                          tag=tag, kind="decode")
+        return True
+
+    def has_model(self, name: Optional[str]) -> bool:
+        """True when this engine currently decodes ``name`` (None — the
+        default model — always)."""
+        if name is None:
+            return True
+        with self._lock:
+            return name in self._model_tags
+
+    def placed_models(self) -> Dict[str, str]:
+        """name → serve tag for every model this engine decodes (the
+        default under "")."""
+        with self._lock:
+            out = {"": self._serve_tag}
+            out.update(self._model_tags)
+            return out
+
+    def model_last_used(self, name: str) -> Optional[float]:
+        """Engine-clock stamp of the last admission for a named model
+        (None = never, or not placed) — the placement controller's
+        idle-eviction signal."""
+        with self._lock:
+            return self._model_last_used.get(name)
 
     def attach_registry(self, registry, name: str,
                         alias: str = "prod") -> "DecodeEngine":
@@ -1187,6 +1309,20 @@ class DecodeEngine:
             spec = r.payload
             handoff = getattr(spec, "handoff", None)
             transfer = None
+            with self._lock:
+                if r.model is None:
+                    slot_tag = self._serve_tag
+                else:
+                    slot_tag = self._model_tags.get(r.model)
+                    if slot_tag is not None:
+                        self._model_last_used[r.model] = self.clock()
+            if slot_tag is None:
+                # evicted between admission and slot assignment: typed
+                # failure, retryable at fleet level (demand reload)
+                self.metrics.inc("errors")
+                _fail_safe(r.future, ModelNotLoadedError(
+                    f"model {r.model!r} was evicted from this decode host"))
+                continue
             if handoff is not None:
                 try:
                     # validate BEFORE any allocation: a corrupt transfer
@@ -1231,7 +1367,7 @@ class DecodeEngine:
                 self._page_table[i] = 0
                 self._page_table[i, :m] = [nd.page_id for nd in matched]
                 self._page_table[i, m:m + need] = ids
-                slot = _Slot(r, self._serve_tag, ids, spec.max_new)
+                slot = _Slot(r, slot_tag, ids, spec.max_new)
                 slot.shared_nodes = matched
                 slot.n_matched = m
                 self._slots[i] = slot
@@ -1490,6 +1626,7 @@ class DecodeEngine:
             self._page_table[i] = 0
             live_tags = {sl.tag for sl in self._slots if sl is not None}
             live_tags.add(self._serve_tag)
+            live_tags.update(self._model_tags.values())
             for t in [t for t in self._versions if t not in live_tags]:
                 del self._versions[t]
             self.metrics.active_slots.set(
@@ -1768,6 +1905,7 @@ class DecodeEngine:
             self._page_table[i] = 0
             live_tags = {sl.tag for sl in self._slots if sl is not None}
             live_tags.add(self._serve_tag)
+            live_tags.update(self._model_tags.values())
             for t in [t for t in self._versions if t not in live_tags]:
                 del self._versions[t]
             self.metrics.active_slots.set(
@@ -1855,6 +1993,10 @@ class DecodeEngine:
         with self._lock:
             snap["model"] = self._serve_tag
             snap["versions"] = sorted(self._versions)
+            snap["models"] = {"": self._serve_tag, **self._model_tags}
+        if self.tenants is not None:
+            snap["tenants"] = self.tenants.snapshot()
+        with self._lock:
             snap["queue_depth"] = self.batcher.qsize()
             snap["free_pages"] = len(self._free_pages)
             snap["free_slots"] = sum(1 for s in self._slots if s is None)

@@ -91,6 +91,13 @@ class ServingUnavailableError(RuntimeError):
     """No dispatchable replica (all dead or circuit-broken)."""
 
 
+class ModelNotLoadedError(RuntimeError):
+    """The request named a model this engine does not currently place —
+    either it was never added or the placement controller evicted it.
+    Retryable at fleet level: the router re-routes to a host that does
+    place it (or demand-loads it, serving/placement.py)."""
+
+
 def _fail_safe(fut: Future, exc: BaseException) -> None:
     if not fut.done():
         try:
@@ -161,6 +168,26 @@ class _ModelVersion:
             return int(self.fwd._cache_size())
         except Exception:
             return None
+
+
+class _ModelEntry:
+    """One NAMED model placed on a multi-model engine: its current
+    version plus the per-model warmup state (the AOT/bundle and
+    zero-serve-time-compiles contracts hold per model, not per host).
+    The engine's constructor model stays the DEFAULT model outside this
+    table; placement adds/evicts entries at runtime."""
+
+    __slots__ = ("version", "example_shape", "warm_dtypes", "warmed",
+                 "last_used")
+
+    def __init__(self, version: _ModelVersion,
+                 example_shape: Tuple[int, ...],
+                 warm_dtypes: Tuple[str, ...]):
+        self.version = version
+        self.example_shape = example_shape
+        self.warm_dtypes = warm_dtypes
+        self.warmed: set = set()       # (bucket, dtype_str) pairs
+        self.last_used: Optional[float] = None
 
 
 class _Execution:
@@ -261,6 +288,7 @@ class Engine:
                  supervise_interval_s: float = 0.02,
                  poison_isolation: bool = True,
                  chaos=None,
+                 tenants=None,
                  clock=time.monotonic):
         import jax
 
@@ -272,10 +300,12 @@ class Engine:
         else:
             tag = "v0"
         self.metrics = metrics or ServingMetrics()
+        self.tenants = tenants           # tenancy.TenantTable or None
         self.batcher = DynamicBatcher(
             max_batch=max_batch, slo_ms=slo_ms, bucket_sizes=bucket_sizes,
             max_queue=max_queue, admission=admission,
-            max_wait_ms=max_wait_ms, metrics=self.metrics, clock=clock)
+            max_wait_ms=max_wait_ms, metrics=self.metrics, clock=clock,
+            tenants=tenants)
         self.clock = clock
         self.forward_timeout_s = forward_timeout_s
         self.max_retries = int(max_retries)
@@ -296,6 +326,11 @@ class Engine:
         self._vlock = threading.Lock()
         self._swap_lock = threading.Lock()
         self._current = _ModelVersion(model, tag, self._devices)
+        # the constructor model is the DEFAULT model; placement can add
+        # further named models at runtime (one engine, many models)
+        self._default_name: Optional[str] = (name if registry is not None
+                                             else None)
+        self._named: Dict[str, _ModelEntry] = {}
         self._canary: Optional[_CanaryState] = None
         self._canary_log: List[dict] = []
         self._warmed: set = set()       # (bucket, dtype_str) pairs
@@ -331,8 +366,9 @@ class Engine:
 
     # -- warmup ------------------------------------------------------------
 
-    def _infer_example_shape(self) -> Optional[Tuple[int, ...]]:
-        conf = getattr(self._current.model, "conf", None)
+    @staticmethod
+    def _infer_shape_of(model) -> Optional[Tuple[int, ...]]:
+        conf = getattr(model, "conf", None)
         it = getattr(conf, "input_type", None)
         if it is None:
             return None
@@ -340,6 +376,9 @@ class Engine:
             return tuple(it.batch_shape(1))[1:]
         except ValueError:  # variable-length recurrent input
             return None
+
+    def _infer_example_shape(self) -> Optional[Tuple[int, ...]]:
+        return self._infer_shape_of(self._current.model)
 
     def load(self, input_shape: Optional[Sequence[int]] = None,
              dtypes: Sequence[str] = ("float32",),
@@ -414,15 +453,25 @@ class Engine:
 
     def _warm_version(self, v: _ModelVersion,
                       warm_bundle: Optional[str] = None,
-                      use_bundle: bool = True) -> None:
-        if self._example_shape is None:
+                      use_bundle: bool = True,
+                      shape: Optional[Tuple[int, ...]] = None,
+                      dtypes: Optional[Tuple[str, ...]] = None,
+                      warmed: Optional[set] = None) -> None:
+        """Warm one version over every (bucket, dtype) pair.  With no
+        overrides this warms the DEFAULT model (engine-level shape/
+        dtypes/warmed set); ``add_model``/per-model swap pass the named
+        entry's own triple so the contracts hold per model."""
+        shape = shape if shape is not None else self._example_shape
+        dtypes = dtypes if dtypes is not None else self._warm_dtypes
+        warmed = warmed if warmed is not None else self._warmed
+        if shape is None:
             return
         bundle = (self._load_bundle_for(v, warm_bundle) if use_bundle
                   else {})
-        for dtype in self._warm_dtypes:
+        for dtype in dtypes:
             for b in self.batcher.buckets:
                 dts = str(np.dtype(dtype))
-                x = np.zeros((b,) + self._example_shape, dtype=dtype)
+                x = np.zeros((b,) + shape, dtype=dtype)
                 t0 = self.clock()
                 with obs_trace.span("serve/warmup", cat="serve", bucket=b,
                                     dtype=dts, tag=v.tag):
@@ -435,7 +484,7 @@ class Engine:
                 t1 = self.clock()
                 np.asarray(self._run_forward(v, 0, x))
                 self.batcher.observe_exec_ms(b, (self.clock() - t1) * 1e3)
-                self._warmed.add((b, dts))
+                warmed.add((b, dts))
 
     def _warm_pair(self, v: _ModelVersion, b: int, dts: str, x: np.ndarray,
                    bundle: dict) -> None:
@@ -458,47 +507,70 @@ class Engine:
 
     def _rewarm_replica(self, idx: int) -> None:
         """Re-warm one (respawned) replica: run every warmed (bucket,
-        dtype) pair once on its device, for the current AND any canary
-        version.  Executables already live in each version's jit cache,
-        so this is a cache-hit pass — zero new compiles (the respawn
-        contract) — that doubles as a health probe."""
-        if self._example_shape is None:
-            return
+        dtype) pair once on its device, for the current version, every
+        NAMED model's version, and any canary.  Executables already
+        live in each version's jit cache, so this is a cache-hit pass —
+        zero new compiles (the respawn contract) — that doubles as a
+        health probe."""
         with self._vlock:
-            versions = [self._current]
+            triples = [(self._current, self._example_shape,
+                        self._warm_dtypes)]
+            triples += [(e.version, e.example_shape, e.warm_dtypes)
+                        for e in self._named.values()]
         can = self._canary
         if can is not None:
-            versions.append(can.version)
-        for dtype in self._warm_dtypes:
-            for b in self.batcher.buckets:
-                x = np.zeros((b,) + self._example_shape, dtype=dtype)
-                for v in versions:
+            triples.append((can.version, self._example_shape,
+                            self._warm_dtypes))
+        for v, shape, dtypes in triples:
+            if shape is None:
+                continue
+            for dtype in dtypes:
+                for b in self.batcher.buckets:
+                    x = np.zeros((b,) + shape, dtype=dtype)
                     np.asarray(self._run_forward(v, idx, x))
 
-    def compile_cache_size(self) -> Optional[int]:
-        """Number of compiled executables backing the CURRENT version's
-        forward (None for non-jit-able models): the jit cache PLUS the
-        AOT warm executables.  After ``load()`` this must not grow while
-        serving bucket-shaped requests — the zero-compiles-at-serve-time
-        contract (also across replica respawns and autoscale births:
-        re-warm is a cache-hit/AOT pass)."""
+    def compile_cache_size(self, model: Optional[str] = None) -> Optional[int]:
+        """Number of compiled executables backing one model's forward
+        (None for non-jit-able models): the jit cache PLUS the AOT warm
+        executables — the default model unless ``model`` names a placed
+        one.  After warmup this must not grow while serving
+        bucket-shaped requests — the zero-compiles-at-serve-time
+        contract, held PER MODEL (also across replica respawns,
+        autoscale births, and placement evict/reload cycles)."""
         with self._vlock:
-            jit_n = self._current.cache_size()
+            if model is None or model == self._default_name:
+                v = self._current
+            else:
+                entry = self._named.get(model)
+                if entry is None:
+                    raise ModelNotLoadedError(
+                        f"model {model!r} is not placed on this host")
+                v = entry.version
+            jit_n = v.cache_size()
             if jit_n is None:
                 return None
-            return jit_n + len(self._current.aot)
+            return jit_n + len(v.aot)
 
-    def save_warmup_bundle(self, path: Optional[str] = None) -> str:
-        """Write the current version's AOT executables as a warmup
-        bundle (serving/warmcache.py).  Default path: the
-        ``<checkpoint>.warm`` convention next to the version's
-        checkpoint zip (registry-loaded models carry their provenance).
-        A fresh process passes the bundle to ``load(warm_bundle=)`` —
-        or just registry-loads the same checkpoint — and warms from
-        disk instead of compiling."""
+    def save_warmup_bundle(self, path: Optional[str] = None,
+                           model: Optional[str] = None) -> str:
+        """Write one model's AOT executables as a warmup bundle
+        (serving/warmcache.py) — the default model unless ``model``
+        names a placed one.  Default path: the ``<checkpoint>.warm``
+        convention next to the version's checkpoint zip
+        (registry-loaded models carry their provenance).  A fresh
+        process passes the bundle to ``load(warm_bundle=)`` /
+        ``add_model(warm_bundle=)`` — or just registry-loads the same
+        checkpoint — and warms from disk instead of compiling."""
         from . import warmcache
         with self._vlock:
-            v = self._current
+            if model is None or model == self._default_name:
+                v = self._current
+            else:
+                entry = self._named.get(model)
+                if entry is None:
+                    raise ModelNotLoadedError(
+                        f"model {model!r} is not placed on this host")
+                v = entry.version
         if not v.aot:
             raise RuntimeError(
                 "nothing to bundle — load() the engine first (non-jit-able "
@@ -514,12 +586,32 @@ class Engine:
 
     # -- request path ------------------------------------------------------
 
-    def output(self, x, slo_ms: Optional[float] = None) -> np.ndarray:
+    def output(self, x, slo_ms: Optional[float] = None,
+               model: Optional[str] = None,
+               tenant: Optional[str] = None) -> np.ndarray:
         """Submit one request (leading batch axis); blocks for the result."""
-        return self.output_async(x, slo_ms=slo_ms).result()
+        return self.output_async(x, slo_ms=slo_ms, model=model,
+                                 tenant=tenant).result()
 
-    def output_async(self, x, slo_ms: Optional[float] = None) -> Future:
-        return self.batcher.submit(np.asarray(x), slo_ms=slo_ms)
+    def output_async(self, x, slo_ms: Optional[float] = None,
+                     model: Optional[str] = None,
+                     tenant: Optional[str] = None) -> Future:
+        """``model`` routes to a placed named model (None = the default
+        model this engine was constructed with); ``tenant`` tags the
+        request for fair-share scheduling and quota accounting.  An
+        unplaced model fails fast with :class:`ModelNotLoadedError`
+        (retryable at fleet level — the router demand-loads)."""
+        if model is not None:
+            with self._vlock:
+                if model == self._default_name:
+                    model = None        # default lane: no fragmentation
+                elif model not in self._named:
+                    f: Future = Future()
+                    f.set_exception(ModelNotLoadedError(
+                        f"model {model!r} is not placed on this host"))
+                    return f
+        return self.batcher.submit(np.asarray(x), slo_ms=slo_ms,
+                                   tenant=tenant, model=model)
 
     # -- dispatch ----------------------------------------------------------
 
@@ -672,8 +764,9 @@ class Engine:
 
     def _forward_padded(self, v: _ModelVersion, replica_idx: int,
                         reqs: List[_Request],
-                        count_unwarmed: bool = True) -> Tuple[np.ndarray,
-                                                              int, int, int]:
+                        count_unwarmed: bool = True,
+                        warmed: Optional[set] = None) -> Tuple[np.ndarray,
+                                                               int, int, int]:
         """Concat + pad ``reqs`` to their bucket, run the forward, and
         return (out rows for the requests, rows, bucket, padded)."""
         xs = (reqs[0].x if len(reqs) == 1
@@ -684,8 +777,9 @@ class Engine:
         if padded:
             pad = np.zeros((padded,) + xs.shape[1:], xs.dtype)
             xs = np.concatenate([xs, pad], axis=0)
+        warm_set = self._warmed if warmed is None else warmed
         if (count_unwarmed and self._loaded
-                and (bucket, str(xs.dtype)) not in self._warmed):
+                and (bucket, str(xs.dtype)) not in warm_set):
             self.metrics.inc("unwarmed_serves")
         out = np.asarray(self._run_forward(v, replica_idx, xs))
         return out[:rows], rows, bucket, padded
@@ -702,8 +796,26 @@ class Engine:
             # (the engine clock and the trace clock are both monotonic)
             obs_trace.complete_at("serve/queue_wait", r.t_submit, now,
                                   cat="serve", rows=r.rows)
+        # batches are model-homogeneous (the batcher never mixes models
+        # in one batch), so the whole batch reads ONE version snapshot
+        model_name = live[0].model
         with self._vlock:
-            v = self._current
+            if model_name is None:
+                v = self._current
+                warmed = self._warmed
+            else:
+                entry = self._named.get(model_name)
+                if entry is None:
+                    # evicted between admission and execution: typed
+                    # failure, retryable at fleet level (demand reload)
+                    err = ModelNotLoadedError(
+                        f"model {model_name!r} was evicted from this host")
+                    for r in live:
+                        _fail_safe(r.future, err)
+                    return
+                entry.last_used = now
+                v = entry.version
+                warmed = entry.warmed
             v.active += 1
         ex = _Execution(v)
         with replica.lock:
@@ -711,7 +823,7 @@ class Engine:
         t0 = self.clock()
         try:
             out, rows, bucket, padded = self._forward_padded(
-                v, replica.idx, live)
+                v, replica.idx, live, warmed=warmed)
             device_ms = (self.clock() - t0) * 1e3
             obs_trace.complete_at("serve/forward", t0, self.clock(),
                                   cat="serve", replica=replica.idx,
@@ -766,7 +878,11 @@ class Engine:
                               replica=replica.idx, n_requests=len(live),
                               rows=rows, padded=padded, tag=v.tag)
         can = self._canary
-        if can is not None and not can.done.is_set():
+        if (can is not None and not can.done.is_set()
+                and model_name is None):
+            # canary mirrors DEFAULT-model traffic only: a named model's
+            # batches never shadow another model's candidate (canary and
+            # rollback stay per-model, never crossing tenants)
             self._mirror_canary(can, replica, live, out, device_ms)
 
     def _isolate_poison(self, v: _ModelVersion, replica: _Replica,
@@ -1230,13 +1346,18 @@ class Engine:
     # -- hot swap ----------------------------------------------------------
 
     def swap_model(self, model, tag: Optional[str] = None,
-                   warm_bundle: Optional[str] = None) -> str:
+                   warm_bundle: Optional[str] = None,
+                   name: Optional[str] = None) -> str:
         """Atomic hot-swap: build + AOT-warm the new version, flip the
         current pointer, then drain — block until every in-flight batch
         on the old version completes before releasing it.  In-flight
         requests keep their version; a batch never mixes two versions.
         Returns the retired version's tag (rollback = swap back, or an
         alias move in the registry).
+
+        ``name`` scopes the swap to one placed named model (None or the
+        default name = the default model) — swaps never cross models, so
+        a rollout of tenant A's model cannot disturb tenant B's.
 
         ``warm_bundle`` (or the incoming model's registry-stamped
         ``<checkpoint>.warm`` provenance) lets the warm pass deserialize
@@ -1245,6 +1366,30 @@ class Engine:
         # graftcheck: disable=GC201 (wall-anchor: human-facing default tag names WHEN the swap happened; never feeds math or replay)
         nv = _ModelVersion(model, tag or f"swap@{time.time():.0f}",
                            self._devices)
+        if name is not None and name != self._default_name:
+            with self._vlock:
+                entry = self._named.get(name)
+                if entry is None:
+                    raise ModelNotLoadedError(
+                        f"model {name!r} is not placed on this host")
+            if self._loaded:
+                self._warm_version(nv, warm_bundle=warm_bundle,
+                                   shape=entry.example_shape,
+                                   dtypes=entry.warm_dtypes,
+                                   warmed=entry.warmed)
+            with self._swap_lock:
+                with self._vlock:
+                    old = entry.version
+                    entry.version = nv
+                    old.retired = True
+                    if old.active == 0:
+                        old.drained.set()
+                old.drained.wait()
+                self.metrics.inc("swaps")
+                obs_trace.instant("serve/swap", cat="serve",
+                                  incoming=nv.tag, retired=old.tag,
+                                  model=name)
+                return old.tag
         if self._loaded:
             self._warm_version(nv, warm_bundle=warm_bundle)
         return self._swap_version(nv)
@@ -1268,11 +1413,132 @@ class Engine:
         with self._vlock:
             return self._current.tag
 
+    # -- multi-model placement ---------------------------------------------
+
+    def add_model(self, name: str, model, *,
+                  input_shape: Optional[Sequence[int]] = None,
+                  dtypes: Sequence[str] = ("float32",),
+                  warm_bundle: Optional[str] = None,
+                  tag: Optional[str] = None) -> "Engine":
+        """Place a NAMED model on this engine alongside the default one.
+        The new model is fully AOT-warmed (bundle-first via
+        ``warm_bundle`` or its ``<checkpoint>.warm`` provenance) BEFORE
+        it becomes routable, so the zero-serve-time-compiles contract
+        holds per model from its first request.  Placement load is a
+        scheduling decision, not an outage: existing models keep serving
+        throughout."""
+        if not name:
+            raise ValueError("model name must be non-empty")
+        with self._vlock:
+            if name == self._default_name or name in self._named:
+                raise ValueError(f"model {name!r} is already placed")
+        shape = (tuple(input_shape) if input_shape is not None
+                 else self._infer_shape_of(model))
+        if shape is None:
+            raise ValueError(
+                f"cannot infer the per-example input shape for {name!r} — "
+                "pass input_shape=(...) explicitly")
+        v = _ModelVersion(model, tag or name, self._devices)
+        entry = _ModelEntry(v, shape, tuple(dtypes))
+        entry.last_used = self.clock()
+        t0 = self.clock()
+        if self._loaded:
+            self._warm_version(v, warm_bundle=warm_bundle,
+                               shape=entry.example_shape,
+                               dtypes=entry.warm_dtypes,
+                               warmed=entry.warmed)
+        with self._vlock:
+            self._named[name] = entry
+        self.metrics.inc("model_loads")
+        obs_trace.instant("serve/model_load", cat="serve", model=name,
+                          tag=v.tag,
+                          warm_ms=(self.clock() - t0) * 1e3)
+        return self
+
+    def add_model_from_registry(self, registry, name: str,
+                                ref: str = "prod", *,
+                                input_shape: Optional[Sequence[int]] = None,
+                                dtypes: Sequence[str] = ("float32",),
+                                warm_bundle: Optional[str] = None,
+                                subscribe: bool = False) -> "Engine":
+        """Registry-backed :meth:`add_model`: resolves ``name@ref``,
+        places it under ``name`` with the registry tag convention
+        (``name:vN``), and warms from the checkpoint's warm bundle when
+        one exists.  ``subscribe=True`` additionally wires alias moves
+        to per-model hot-swaps — leave False under a placement
+        controller (it owns reload/evict and an alias callback firing
+        after an eviction would dangle)."""
+        version, model = registry.resolve(name, ref)
+        self.add_model(name, model, input_shape=input_shape,
+                       dtypes=dtypes, warm_bundle=warm_bundle,
+                       tag=f"{name}:v{version}")
+        if subscribe:
+            registry.subscribe(
+                name, ref,
+                lambda ver, m: self.swap_model(m, tag=f"{name}:v{ver}",
+                                               name=name))
+        return self
+
+    def remove_model(self, name: str, timeout: float = 30.0) -> bool:
+        """Evict a named model: unroute it (new requests fail typed →
+        the fleet re-routes), then drain — wait for in-flight batches on
+        its version to complete so eviction can never strand a future or
+        mix versions.  Returns False if the model was not placed.  The
+        default model cannot be evicted (use ``begin_drain`` to retire a
+        whole host)."""
+        if name == self._default_name:
+            raise ValueError(
+                f"model {name!r} is this engine's default model and "
+                "cannot be evicted; drain the host instead")
+        with self._vlock:
+            entry = self._named.pop(name, None)
+            if entry is None:
+                return False
+            v = entry.version
+            v.retired = True
+            if v.active == 0:
+                v.drained.set()
+        v.drained.wait(timeout)
+        self.metrics.inc("model_evictions")
+        obs_trace.instant("serve/model_evict", cat="serve", model=name,
+                          tag=v.tag)
+        return True
+
+    def has_model(self, name: Optional[str]) -> bool:
+        """True when this engine currently places ``name`` (None and
+        the default model's own name are always served)."""
+        if name is None:
+            return True
+        with self._vlock:
+            return name == self._default_name or name in self._named
+
+    def placed_models(self) -> Dict[str, str]:
+        """name → current version tag for every model this engine
+        places (the default model under its registry name, or "" when
+        it was constructed from a bare model)."""
+        with self._vlock:
+            out = {self._default_name if self._default_name is not None
+                   else "": self._current.tag}
+            for name, e in self._named.items():
+                out[name] = e.version.tag
+            return out
+
+    def model_last_used(self, name: str) -> Optional[float]:
+        """Engine-clock stamp of the last batch executed for a named
+        model (None = never, or not placed) — the placement
+        controller's idle-eviction signal."""
+        with self._vlock:
+            e = self._named.get(name)
+            return e.last_used if e is not None else None
+
     # -- lifecycle ---------------------------------------------------------
 
     def metrics_snapshot(self) -> dict:
         snap = self.metrics.snapshot()
         snap["model"] = self.current_tag
+        snap["models"] = self.placed_models()
+        if self.tenants is not None:
+            snap["tenants"] = self.tenants.snapshot()
         snap["replicas"] = len(self._replicas)
         snap["queue_depth"] = self.batcher.qsize()
         snap["buckets"] = list(self.batcher.buckets)

@@ -72,9 +72,10 @@ import numpy as np
 from ..obs import trace as obs_trace
 from .batcher import DeadlineExceededError, OverloadedError
 from .decode import PrefillHandoff
-from .engine import (PoisonInputError, ServingUnavailableError, _fail_safe,
-                     _set_safe)
+from .engine import (ModelNotLoadedError, PoisonInputError,
+                     ServingUnavailableError, _fail_safe, _set_safe)
 from .metrics import FleetMetrics
+from .tenancy import TenantOverloadedError
 
 
 class FleetTimeoutError(RuntimeError):
@@ -83,9 +84,12 @@ class FleetTimeoutError(RuntimeError):
 
 
 # deterministic request errors: the same input fails the same way on any
-# host, so burning a retry (and a peer's capacity) on them is waste
+# host, so burning a retry (and a peer's capacity) on them is waste.
+# TenantOverloadedError is logical back-pressure on the TENANT's own
+# quota, not host capacity — retrying it on a peer would let a bursting
+# tenant launder its shed traffic through the retry budget.
 _NON_RETRYABLE = (PoisonInputError, DeadlineExceededError, ValueError,
-                  TypeError, KeyError)
+                  TypeError, KeyError, TenantOverloadedError)
 
 
 def _hash64(s: str) -> int:
@@ -128,6 +132,38 @@ class FleetHost:
 
     def supports(self, kind: str) -> bool:
         return (self.decode if kind == "decode" else self.engine) is not None
+
+    def places(self, model: Optional[str], kind: str = "predict") -> bool:
+        """True when the host's engine for ``kind`` currently serves
+        ``model`` (None = the default model, always placed).  Engines
+        without a ``has_model`` (HTTP hosts on an old build, test
+        fakes) place everything — routing degrades to pre-placement
+        behavior instead of blackholing."""
+        if model is None:
+            return True
+        eng = self.engine_for(kind)
+        has = getattr(eng, "has_model", None)
+        if has is None:
+            return True
+        try:
+            return bool(has(model))
+        except Exception:
+            return False
+
+    def placed_models(self) -> Dict[str, str]:
+        """Union of placed model names -> tag over both engines."""
+        out: Dict[str, str] = {}
+        for eng in (self.engine, self.decode):
+            pm = getattr(eng, "placed_models", None)
+            if pm is None:
+                continue
+            try:
+                out.update(pm())
+            except (OSError, ValueError, KeyError, RuntimeError):
+                # a dead/remote engine's view is just absent; the
+                # router's health machinery owns reporting that host
+                pass
+        return out
 
     def engine_for(self, kind: str):
         return self.decode if kind == "decode" else self.engine
@@ -184,10 +220,11 @@ class FleetHost:
 
 class _FleetRequest:
     __slots__ = ("kind", "payload", "session", "slo_ms", "deadline",
-                 "future", "tried", "retries", "t_submit")
+                 "future", "tried", "retries", "t_submit", "model",
+                 "tenant")
 
     def __init__(self, kind, payload, session, slo_ms, deadline, future,
-                 t_submit):
+                 t_submit, model=None, tenant=None):
         self.kind = kind
         self.payload = payload
         self.session = session
@@ -197,6 +234,8 @@ class _FleetRequest:
         self.tried: set = set()
         self.retries = 0
         self.t_submit = t_submit
+        self.model = model
+        self.tenant = tenant
 
 
 class _Attempt:
@@ -250,6 +289,13 @@ class FleetRouter:
         self._draining = False
         self._last_depth_poll: Optional[float] = None
         self._last_member_poll: Optional[float] = None
+        # per-model submit counts since the last drain ("" = default
+        # model) — the placement controller's traffic signal
+        self._model_traffic: Dict[str, int] = {}
+        # placement hook: called (model, kind) when no up host places a
+        # requested model — the controller demand-loads, then dispatch
+        # re-picks once (serving/placement.py)
+        self._on_model_miss: Optional[Callable[[str, str], bool]] = None
         self._stop = threading.Event()
         self._watchdog_thread: Optional[threading.Thread] = None
         for h in hosts:
@@ -286,6 +332,12 @@ class FleetRouter:
     def hosts(self) -> Dict[str, str]:
         with self._lock:
             return {hid: h.state for hid, h in self._hosts.items()}
+
+    def host(self, host_id: str) -> Optional[FleetHost]:
+        """The live FleetHost record (None if unknown) — the placement
+        controller's actuation handle."""
+        with self._lock:
+            return self._hosts.get(host_id)
 
     def mark_host_down(self, host_id: str, reason: str = "manual",
                        planned: bool = False) -> None:
@@ -329,18 +381,26 @@ class FleetRouter:
 
     # -- the engine duck type -------------------------------------------
 
-    def output(self, x, slo_ms: Optional[float] = None) -> np.ndarray:
-        return self.output_async(x, slo_ms=slo_ms).result()
+    def output(self, x, slo_ms: Optional[float] = None,
+               model: Optional[str] = None,
+               tenant: Optional[str] = None) -> np.ndarray:
+        return self.output_async(x, slo_ms=slo_ms, model=model,
+                                 tenant=tenant).result()
 
     def output_async(self, x, slo_ms: Optional[float] = None,
-                     session=None) -> Future:
-        return self._submit("predict", np.asarray(x), session, slo_ms)
+                     session=None, model: Optional[str] = None,
+                     tenant: Optional[str] = None) -> Future:
+        return self._submit("predict", np.asarray(x), session, slo_ms,
+                            model=model, tenant=tenant)
 
     def generate_async(self, prompt_ids, *, session=None,
-                       slo_ms: Optional[float] = None, **kw) -> Future:
+                       slo_ms: Optional[float] = None,
+                       model: Optional[str] = None,
+                       tenant: Optional[str] = None, **kw) -> Future:
         payload = dict(kw)
         payload["prompt_ids"] = prompt_ids
-        return self._submit("decode", payload, session, slo_ms)
+        return self._submit("decode", payload, session, slo_ms,
+                            model=model, tenant=tenant)
 
     def generate(self, prompt_ids, **kw):
         return self.generate_async(prompt_ids, **kw).result()
@@ -415,24 +475,30 @@ class FleetRouter:
                 for hid, h in self._hosts.items()}
             snap["queue_depth"] = sum(
                 h.inflight for h in self._hosts.values())
+            snap["model_traffic"] = dict(self._model_traffic)
         snap["model"] = self.current_tag
+        snap["models"] = self.model_map()
         return snap
 
     # -- dispatch --------------------------------------------------------
 
-    def _submit(self, kind, payload, session, slo_ms) -> Future:
+    def _submit(self, kind, payload, session, slo_ms,
+                model=None, tenant=None) -> Future:
         fut: Future = Future()
         now = self.clock()
         deadline = (now + slo_ms / 1000.0) if slo_ms else None
         spec = _FleetRequest(kind, payload, session, slo_ms, deadline, fut,
-                             now)
-        self.metrics.inc("requests")
+                             now, model=model, tenant=tenant)
+        self.metrics.inc("requests", tenant=tenant)
+        with self._lock:
+            key = model if model is not None else ""
+            self._model_traffic[key] = self._model_traffic.get(key, 0) + 1
         if self._shutdown:
             _fail_safe(fut, ServingUnavailableError(
                 "fleet router is shut down"))
             return fut
         if self._draining:
-            self.metrics.inc("shed")
+            self.metrics.inc("shed", tenant=tenant)
             _fail_safe(fut, OverloadedError(
                 "admission stopped: fleet is draining (preemption notice)"))
             return fut
@@ -446,13 +512,15 @@ class FleetRouter:
         # cannot prefill), a PrefillHandoff to a decode-role sink
         cands = [h for h in self._hosts.values()
                  if h.state == "up" and h.supports(spec.kind)
+                 and h.places(spec.model, spec.kind)
                  and (spec.kind != "decode"
                       or (h.decode_role() == "decode") == sink)]
         if not cands:
             return None
         if spec.session is not None:
             host = self._ring_lookup_locked(spec.session, spec.kind,
-                                            spec.tried, sink)
+                                            spec.tried, sink,
+                                            model=spec.model)
             if host is not None:
                 self.metrics.inc("affinity_routed")
                 return host
@@ -466,7 +534,9 @@ class FleetRouter:
         return tied[self._rr % len(tied)]
 
     def _ring_lookup_locked(self, key, kind, tried,
-                            sink: bool = False) -> Optional[FleetHost]:
+                            sink: bool = False,
+                            model: Optional[str] = None
+                            ) -> Optional[FleetHost]:
         if not self._ring:
             return None
         h = _hash64(str(key))
@@ -481,40 +551,111 @@ class FleetRouter:
                 seen.add(hid)
                 host = self._hosts[hid]
                 if (host.state == "up" and host.supports(kind)
+                        and host.places(model, kind)
                         and (kind != "decode"
                              or (host.decode_role() == "decode") == sink)
                         and (allow_tried or hid not in tried)):
                     return host
         return None
 
+    def set_model_miss_handler(
+            self, handler: Optional[Callable[[str, str], bool]]) -> None:
+        """Placement hook: ``handler(model, kind)`` runs (outside the
+        router lock) when a request names a model no up host places.
+        Return True to have dispatch re-pick once — the demand-reload
+        path: eviction makes a cold model a routing miss, not an
+        error."""
+        with self._lock:
+            self._on_model_miss = handler
+
+    def model_traffic(self, reset: bool = False) -> Dict[str, int]:
+        """Per-model submit counts since the last reset ("" = the
+        default model) — the placement controller's demand signal."""
+        with self._lock:
+            out = dict(self._model_traffic)
+            if reset:
+                self._model_traffic = {}
+            return out
+
+    def model_map(self) -> Dict[str, Dict[str, str]]:
+        """host_id -> {model name -> tag} over non-down hosts — the
+        fleet's live placement view."""
+        with self._lock:
+            return {hid: h.placed_models()
+                    for hid, h in self._hosts.items() if h.state != "down"}
+
     def _dispatch(self, spec) -> None:
         if self._shutdown:
             _fail_safe(spec.future, ServingUnavailableError(
                 "fleet router is shut down"))
             return
-        with self._lock:
-            host = self._pick_host_locked(spec)
-            if host is not None:
-                host.inflight += 1
-                self._aid += 1
-                timeout_at = (self.clock() + self.request_timeout_s
-                              if self.request_timeout_s else None)
-                attempt = _Attempt(self._aid, spec, host, self.clock(),
-                                   timeout_at)
-                self._outstanding[attempt.aid] = attempt
+        attempt = None
+        for round_no in (0, 1):
+            with self._lock:
+                host = self._pick_host_locked(spec)
+                if host is not None:
+                    host.inflight += 1
+                    self._aid += 1
+                    timeout_at = (self.clock() + self.request_timeout_s
+                                  if self.request_timeout_s else None)
+                    attempt = _Attempt(self._aid, spec, host, self.clock(),
+                                       timeout_at)
+                    self._outstanding[attempt.aid] = attempt
+                    break
+                miss_cb = self._on_model_miss
+            if (round_no == 0 and spec.model is not None
+                    and miss_cb is not None):
+                # no up host places this model: give the placement
+                # controller one shot at a demand reload, then re-pick
+                self.metrics.inc("model_misses")
+                try:
+                    if not miss_cb(spec.model, spec.kind):
+                        break
+                except Exception:
+                    # a crashing miss handler degrades to the typed
+                    # ModelNotLoadedError below, visibly
+                    self.metrics.inc("model_miss_cb_errors")
+                    break
+            else:
+                break
         if host is None:
-            self.metrics.inc("shed")
-            _fail_safe(spec.future, OverloadedError(
-                f"no dispatchable fleet host for kind={spec.kind!r}"))
+            self.metrics.inc("shed", tenant=spec.tenant)
+            if spec.model is not None:
+                _fail_safe(spec.future, ModelNotLoadedError(
+                    f"no up fleet host places model {spec.model!r} "
+                    f"(kind={spec.kind!r})"))
+            else:
+                _fail_safe(spec.future, OverloadedError(
+                    f"no dispatchable fleet host for kind={spec.kind!r}"))
             return
         self.metrics.inc("dispatched")
         try:
             eng = host.engine_for(spec.kind)
+            kw = {}
+            if spec.model is not None:
+                kw["model"] = spec.model
+            if spec.tenant is not None:
+                kw["tenant"] = spec.tenant
             if spec.kind == "decode":
-                inner = eng.generate_async(slo_ms=spec.slo_ms,
-                                           **spec.payload)
+                try:
+                    inner = eng.generate_async(slo_ms=spec.slo_ms, **kw,
+                                               **spec.payload)
+                except TypeError:
+                    if not kw:
+                        raise
+                    # pre-tenancy engine (or a test fake): routing
+                    # already honored placement; drop the tags
+                    inner = eng.generate_async(slo_ms=spec.slo_ms,
+                                               **spec.payload)
             else:
-                inner = eng.output_async(spec.payload, slo_ms=spec.slo_ms)
+                try:
+                    inner = eng.output_async(spec.payload,
+                                             slo_ms=spec.slo_ms, **kw)
+                except TypeError:
+                    if not kw:
+                        raise
+                    inner = eng.output_async(spec.payload,
+                                             slo_ms=spec.slo_ms)
         except BaseException as exc:
             # synchronous failure (admission shed, validation, shut-down
             # host): the attempt never reached the host's queue
@@ -610,7 +751,7 @@ class FleetRouter:
             host.failures = 0
         if _set_safe(spec.future, result):
             done = self.clock()
-            self.metrics.inc("delivered")
+            self.metrics.inc("delivered", tenant=spec.tenant)
             self.metrics.e2e.record((done - spec.t_submit) * 1000.0)
             obs_trace.complete_at("fleet/request", spec.t_submit, done,
                                   cat="fleet", host=host.host_id,
@@ -621,9 +762,11 @@ class FleetRouter:
     def _handle_failure(self, spec, host, exc) -> None:
         try:
             retryable = not isinstance(exc, _NON_RETRYABLE)
-            # an admission shed is back-pressure, not a sick host: route
-            # around it but don't feed the circuit breaker
-            if retryable and not isinstance(exc, OverloadedError):
+            # an admission shed is back-pressure, not a sick host —
+            # likewise a model the host merely doesn't place: route
+            # around them but don't feed the circuit breaker
+            if retryable and not isinstance(
+                    exc, (OverloadedError, ModelNotLoadedError)):
                 self._note_host_failure(host, exc)
             if spec.future.done():
                 return
@@ -640,7 +783,7 @@ class FleetRouter:
                                   error=type(exc).__name__)
                 self._dispatch(spec)
                 return
-            self.metrics.inc("failed")
+            self.metrics.inc("failed", tenant=spec.tenant)
             _fail_safe(spec.future, exc)
         except BaseException as e:
             _fail_safe(spec.future, e)
@@ -970,6 +1113,7 @@ class HttpHost:
         "deadline_exceeded": DeadlineExceededError,
         "poison_input": PoisonInputError,
         "unavailable": ServingUnavailableError,
+        "model_not_loaded": ModelNotLoadedError,
     }
 
     def __init__(self, base_url: str, timeout_s: float = 5.0,
@@ -987,9 +1131,13 @@ class HttpHost:
                                     timeout=self.timeout_s) as r:
             return json.loads(r.read().decode())
 
-    def _predict(self, x, slo_ms):
-        body = json.dumps({"inputs": np.asarray(x).tolist(),
-                           "slo_ms": slo_ms}).encode()
+    def _predict(self, x, slo_ms, model=None, tenant=None):
+        doc = {"inputs": np.asarray(x).tolist(), "slo_ms": slo_ms}
+        if model is not None:
+            doc["model"] = model
+        if tenant is not None:
+            doc["tenant"] = tenant
+        body = json.dumps(doc).encode()
         req = urllib.request.Request(
             self.base_url + "/predict", data=body,
             headers={"Content-Type": "application/json"})
@@ -1001,16 +1149,28 @@ class HttpHost:
                 payload = json.loads(e.read().decode())
             except Exception:
                 payload = {}
-            cls = self._ERROR_CLASSES.get(payload.get("error_class"),
-                                          RuntimeError)
-            raise cls(payload.get("error", f"HTTP {e.code}")) from None
+            kind = payload.get("error_class")
+            msg = payload.get("error", f"HTTP {e.code}")
+            if kind == "tenant_overloaded":
+                # rebuild the typed error so per-tenant attribution
+                # survives the HTTP seam (429 body carries the fields)
+                raise TenantOverloadedError(
+                    msg, payload.get("tenant", tenant or ""),
+                    payload.get("shed_count", 0),
+                    reason=payload.get("reason", "quota")) from None
+            cls = self._ERROR_CLASSES.get(kind, RuntimeError)
+            raise cls(msg) from None
         return np.asarray(out["outputs"])
 
-    def output_async(self, x, slo_ms: Optional[float] = None) -> Future:
-        return self._pool.submit(self._predict, x, slo_ms)
+    def output_async(self, x, slo_ms: Optional[float] = None,
+                     model: Optional[str] = None,
+                     tenant: Optional[str] = None) -> Future:
+        return self._pool.submit(self._predict, x, slo_ms, model, tenant)
 
-    def output(self, x, slo_ms: Optional[float] = None):
-        return self._predict(x, slo_ms)
+    def output(self, x, slo_ms: Optional[float] = None,
+               model: Optional[str] = None,
+               tenant: Optional[str] = None):
+        return self._predict(x, slo_ms, model, tenant)
 
     @property
     def current_tag(self) -> str:

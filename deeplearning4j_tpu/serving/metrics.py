@@ -91,6 +91,7 @@ _COUNTER_KEYS = (
     "canary_promotions", "canary_rollbacks", "canary_mirrored_batches",
     "warmup_seconds_total", "bundle_hits", "bundle_misses",
     "scale_ups", "scale_downs",
+    "model_loads", "model_evictions",
 )
 
 
@@ -125,7 +126,7 @@ class ServingMetrics:
         self.global_name = get_registry().register_collector(
             "serving", self.snapshot, unique=True)
 
-    def inc(self, key: str, n: int = 1) -> None:
+    def inc(self, key: str, n: int = 1, tenant: str = None) -> None:
         c = self._counters.get(key)
         if c is None:        # open key set, as before the migration
             with self._lock:
@@ -133,12 +134,20 @@ class ServingMetrics:
                 if c is None:
                     c = self._counters[key] = self.registry.counter(key)
         c.inc(n)
+        if tenant:
+            # a per-tenant label slice of the same instrument — shows up
+            # in the registry snapshot as ``key{tenant=...}`` (the
+            # unlabeled series above stays the all-tenants total)
+            c.inc(n, tenant=tenant)
 
-    def counter_value(self, key: str) -> float:
+    def counter_value(self, key: str, tenant: str = None) -> float:
         """Current value of one counter (0.0 if never incremented) — the
-        cheap read the autoscaler's shed-delta signal polls."""
+        cheap read the autoscaler's shed-delta signal polls.  With
+        ``tenant``, reads that tenant's label slice."""
         c = self._counters.get(key)
-        return float(c.value()) if c is not None else 0.0
+        if c is None:
+            return 0.0
+        return float(c.value(tenant=tenant) if tenant else c.value())
 
     def record_batch(self, n_requests: int, rows: int, padded_rows: int,
                      device_ms: float) -> None:
@@ -182,6 +191,7 @@ _FLEET_COUNTER_KEYS = (
     "host_failures", "host_down", "host_up",
     "drains", "preempt_drains", "rolling_swaps", "swap_hosts", "rollbacks",
     "disagg_requests", "page_transfers", "transfer_bytes",
+    "placements", "placement_evictions", "demand_loads", "model_misses",
 )
 
 
@@ -210,7 +220,7 @@ class FleetMetrics:
         self.global_name = get_registry().register_collector(
             "fleet", self.snapshot, unique=True)
 
-    def inc(self, key: str, n: int = 1) -> None:
+    def inc(self, key: str, n: int = 1, tenant: str = None) -> None:
         c = self._counters.get(key)
         if c is None:        # open key set, matching ServingMetrics
             with self._lock:
@@ -218,6 +228,8 @@ class FleetMetrics:
                 if c is None:
                     c = self._counters[key] = self.registry.counter(key)
         c.inc(n)
+        if tenant:
+            c.inc(n, tenant=tenant)
 
     def snapshot(self) -> dict:
         c: Dict[str, int] = {}
@@ -292,7 +304,7 @@ class DecodeMetrics:
         self.global_name = get_registry().register_collector(
             "decode", self.snapshot, unique=True)
 
-    def inc(self, key: str, n: int = 1) -> None:
+    def inc(self, key: str, n: int = 1, tenant: str = None) -> None:
         c = self._counters.get(key)
         if c is None:        # open key set, matching ServingMetrics
             with self._lock:
@@ -300,12 +312,17 @@ class DecodeMetrics:
                 if c is None:
                     c = self._counters[key] = self.registry.counter(key)
         c.inc(n)
+        if tenant:
+            c.inc(n, tenant=tenant)
 
-    def counter_value(self, key: str) -> float:
+    def counter_value(self, key: str, tenant: str = None) -> float:
         """Current value of one counter (0.0 if never incremented) — the
-        cheap read the autoscaler's shed-delta signal polls."""
+        cheap read the autoscaler's shed-delta signal polls.  With
+        ``tenant``, reads that tenant's label slice."""
         c = self._counters.get(key)
-        return float(c.value()) if c is not None else 0.0
+        if c is None:
+            return 0.0
+        return float(c.value(tenant=tenant) if tenant else c.value())
 
     def snapshot(self) -> dict:
         c: Dict[str, int] = {}

@@ -44,6 +44,7 @@ are immutable alongside the version itself and drive
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 
@@ -79,10 +80,15 @@ LINEAGE_FIELDS = ("run_id", "data_fingerprint", "parent_version",
 class ModelRegistry:
     """name -> {version -> model} + name -> {alias -> version}."""
 
-    def __init__(self):
+    def __init__(self, clock=time.monotonic):
         self._models: Dict[str, Dict[int, Any]] = {}
         self._aliases: Dict[str, Dict[str, int]] = {}
         self._lock = threading.Lock()
+        self.clock = clock
+        # name -> registry-clock stamp of the last resolve() — the
+        # placement controller's cold-model signal (injectable clock,
+        # GC201: never a wall clock)
+        self._last_access: Dict[str, float] = {}
         # (name, alias) -> [(callback(version, model), canary_cb or None)]
         self._subs: Dict[Tuple[str, str],
                          List[Tuple[Callable[[int, Any], None],
@@ -225,6 +231,51 @@ class ModelRegistry:
         with self._lock:
             return dict(self._aliases.get(name, {}))
 
+    def list_aliases(self) -> Dict[str, Dict[str, int]]:
+        """Every alias pin across the whole registry:
+        name -> {alias -> version}.  Names with no aliases are omitted
+        — this is the \"what is deployable right now\" view."""
+        with self._lock:
+            return {n: dict(a)
+                    for n, a in sorted(self._aliases.items()) if a}
+
+    def models_snapshot(self) -> Dict[str, dict]:
+        """Inventory for the placement controller (and /metrics): every
+        registered name with its versions, alias pins, prod-pinned
+        version (None when unpinned), a lineage summary, the pinned (or
+        latest) version's checkpoint path, and the registry-clock stamp
+        of its last :meth:`resolve` (None = never served)."""
+        with self._lock:
+            out: Dict[str, dict] = {}
+            for name in sorted(self._models):
+                versions = self._models[name]
+                aliases = dict(self._aliases.get(name, {}))
+                pinned = aliases.get("prod")
+                head = pinned if pinned is not None else max(versions)
+                recs = [self._lineage[(n, v)]
+                        for (n, v) in sorted(self._lineage) if n == name]
+                head_rec = self._lineage.get((name, head))
+                out[name] = {
+                    "versions": sorted(versions),
+                    "aliases": aliases,
+                    "pinned": pinned,
+                    "lineage": {
+                        "recorded": len(recs),
+                        "eval_passed": sum(1 for r in recs
+                                           if r.get("eval_passed")),
+                        "head": ({"version": head,
+                                  "parent_version":
+                                      head_rec.get("parent_version"),
+                                  "eval_passed":
+                                      head_rec.get("eval_passed"),
+                                  "run_id": head_rec.get("run_id")}
+                                 if head_rec is not None else None),
+                    },
+                    "checkpoint_path": self._paths.get((name, head)),
+                    "last_access": self._last_access.get(name),
+                }
+            return out
+
     def resolve(self, name: str, ref: Any = "latest") -> Tuple[int, Any]:
         """(version, model) for a ref: an int version, ``"latest"``, a
         ``"v<N>"`` string, or an alias name."""
@@ -233,6 +284,7 @@ class ModelRegistry:
             if not versions:
                 raise KeyError(f"no model named {name!r} registered")
             v = self._resolve_version_locked(name, ref)
+            self._last_access[name] = self.clock()
             return v, versions[v]
 
     def _resolve_version_locked(self, name: str, ref: Any) -> int:

@@ -108,8 +108,9 @@ class UIServer:
         — model internals stay out of the HTTP surface)."""
         import json
         from ..serving import (
-            DeadlineExceededError, OverloadedError, PoisonInputError,
-            ReplicaCrashError, ReplicaHungError,
+            DeadlineExceededError, ModelNotLoadedError, OverloadedError,
+            PoisonInputError, ReplicaCrashError, ReplicaHungError,
+            TenantOverloadedError,
         )
         if self._engine is None:
             return 503, {"error": "no serving engine attached",
@@ -118,11 +119,27 @@ class UIServer:
             payload = json.loads(body)
             import numpy as np
             x = np.asarray(payload["inputs"], np.float32)
-            out = self._engine.output(x, slo_ms=payload.get("slo_ms"))
+            kw = {}
+            # optional multi-tenant fields: only forwarded when present,
+            # so a duck-typed engine predating tenancy still works
+            if payload.get("tenant") is not None:
+                kw["tenant"] = str(payload["tenant"])
+            if payload.get("model") is not None:
+                kw["model"] = str(payload["model"])
+            out = self._engine.output(x, slo_ms=payload.get("slo_ms"), **kw)
             return 200, {"outputs": np.asarray(out).tolist(),
                          "model": self._engine.current_tag}
+        except TenantOverloadedError as e:
+            # the tenant's OWN quota — distinct from fleet overload, so
+            # clients can tell whose budget ran out (and back off, not
+            # retry elsewhere)
+            return 429, {"error": str(e), "error_class": "tenant_overloaded",
+                         "tenant": e.tenant, "shed_count": e.shed_count,
+                         "reason": e.reason}
         except OverloadedError as e:
             return 429, {"error": str(e), "error_class": "overloaded"}
+        except ModelNotLoadedError as e:
+            return 404, {"error": str(e), "error_class": "model_not_loaded"}
         except DeadlineExceededError as e:
             return 504, {"error": str(e), "error_class": "deadline_exceeded"}
         except PoisonInputError as e:
@@ -147,8 +164,9 @@ class UIServer:
         request → 400 ``bad_request``."""
         import json
         from ..serving import (
-            DeadlineExceededError, OverloadedError, PoisonInputError,
-            ReplicaCrashError, ReplicaHungError,
+            DeadlineExceededError, ModelNotLoadedError, OverloadedError,
+            PoisonInputError, ReplicaCrashError, ReplicaHungError,
+            TenantOverloadedError,
         )
         if self._decode_engine is None:
             return 503, {"error": "no decode engine attached",
@@ -163,6 +181,11 @@ class UIServer:
                          "error_class": "prefill_role"}
         try:
             payload = json.loads(body)
+            kw = {}
+            if payload.get("tenant") is not None:
+                kw["tenant"] = str(payload["tenant"])
+            if payload.get("model") is not None:
+                kw["model"] = str(payload["model"])
             res = self._decode_engine.generate(
                 payload["prompt_ids"],
                 max_new_tokens=payload.get("max_tokens"),
@@ -170,13 +193,19 @@ class UIServer:
                 top_k=payload.get("top_k", 0),
                 top_p=payload.get("top_p", 1.0),
                 seed=payload.get("seed", 0),
-                slo_ms=payload.get("slo_ms"))
+                slo_ms=payload.get("slo_ms"), **kw)
             return 200, {"tokens": res.tokens, "n_prompt": res.n_prompt,
                          "finish_reason": res.finish_reason,
                          "model": res.model_tag, "ttft_ms": res.ttft_ms,
                          "tpot_ms": res.tpot_ms}
+        except TenantOverloadedError as e:
+            return 429, {"error": str(e), "error_class": "tenant_overloaded",
+                         "tenant": e.tenant, "shed_count": e.shed_count,
+                         "reason": e.reason}
         except OverloadedError as e:
             return 429, {"error": str(e), "error_class": "overloaded"}
+        except ModelNotLoadedError as e:
+            return 404, {"error": str(e), "error_class": "model_not_loaded"}
         except DeadlineExceededError as e:
             return 504, {"error": str(e), "error_class": "deadline_exceeded"}
         except PoisonInputError as e:

@@ -1,0 +1,589 @@
+"""Multi-tenant many-model soak: one fleet, three models, three
+tenants, chaos mid-burst (bench config ``multitenant_soak``).
+
+Topology (CPU; the admission/placement logic under test is host-side —
+run with ``JAX_PLATFORMS=cpu``, as bench.py's subprocess harness does):
+3 fleet hosts, every host defaults model ``m1``; ``m2`` is placed on
+h0+h1, ``m3`` on h2 only.  Each host enforces the SAME tenant spec
+through its own :class:`TenantTable` (weighted-fair lanes + atomic
+check-and-charge quotas), and a :class:`PlacementController` closes the
+(model, host) loop over live traffic.  Warm bundles for all three
+models are built in a setup phase (compiles allowed there, never
+after).
+
+Timeline (open-loop, one submitter thread per tenant):
+
+  calm     every tenant at its base rate — the p99/error envelope
+  burst    tenant ``burst`` goes 10x on m2 while victimA (also m2!)
+           and victimB (m1) stay calm; mid-burst host h1 — an m2
+           holder — is killed
+  settle   rates return to calm on the survivors
+  reload   m3, idle since setup, has been EVICTED by the controller;
+           fresh m3 traffic demand-reloads it from its warm bundle
+           through the router's model-miss hook
+
+Gates (consumed by bench.py ``multitenant_soak``):
+  - victim isolation: both victim tenants' burst-window p99 stays
+    inside the calm-window envelope and their error count is ZERO —
+    the burst tenant sheds its OWN traffic only
+  - exact shed attribution: every shed is a typed
+    ``TenantOverloadedError`` carrying tenant="burst"; the ledger's
+    per-tenant shed counts equal the host tables' AND the per-tenant
+    metric label slices — victims all zero
+  - zero mixing: every successful response matches exactly its
+    request's model (classified against per-model references) — no
+    version mixing, no cross-tenant poisoning
+  - nothing stranded, nothing double-delivered, through the mid-burst
+    host kill
+  - placement: the hot model was replicated wider under the burst
+    (``placements`` > 0), the idle model was evicted
+    (``placement_evictions`` > 0) and then demand-reloaded
+    (``model_misses`` > 0, ``demand_loads`` > 0) with correct outputs
+  - zero serve-time compiles: post-setup ``bundle_misses`` deltas are
+    zero on every host and no (host, model) compile-cache count grows
+    once that model is (re)loaded — the warm-bundle contract holds
+    through eviction, demand reload, and placement widening
+
+Last stdout line is the JSON result (the bench subprocess contract).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+QUICK = "--quick" in sys.argv or os.environ.get("BENCH_QUICK", "0") == "1"
+
+TENANT_BURST = "burst"
+TENANT_A = "victimA"
+TENANT_B = "victimB"
+MODELS = ("m1", "m2", "m3")
+
+
+def _mlp(seed: int):
+    from deeplearning4j_tpu.nn.conf.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import Dense, OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import (
+        MultiLayerNetwork, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.updaters import Sgd
+
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Sgd(lr=0.05))
+            .layer(Dense(n_out=16, activation="tanh"))
+            .layer(OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(12)).build())
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def _tenant_rows() -> List[dict]:
+    """The tenants.json shape — the same spec every host enforces."""
+    return [
+        {"tenant": TENANT_BURST, "weight": 1.0, "quota_qps": 60,
+         "quota_concurrent": 6, "admission": "shed"},
+        {"tenant": TENANT_A, "weight": 2.0, "slo_ms": 2500},
+        {"tenant": TENANT_B, "weight": 1.0, "slo_ms": 2500},
+    ]
+
+
+def _p99(lat: List[float]) -> Optional[float]:
+    if not lat:
+        return None
+    return float(np.percentile(np.asarray(lat), 99))
+
+
+class _KillableHost:
+    """Engine wrapper for the mid-burst host kill: once ``killed``,
+    every NEW submission/placement fails (already-admitted work inside
+    the inner engine still completes — a kill must strand nothing)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.killed = False
+
+    def output_async(self, x, slo_ms=None, model=None, tenant=None):
+        from deeplearning4j_tpu.serving import ServingUnavailableError
+        if self.killed:
+            raise ServingUnavailableError("host killed (chaos)")
+        return self.inner.output_async(x, slo_ms=slo_ms, model=model,
+                                       tenant=tenant)
+
+    def add_model(self, name, model, **kw):
+        if self.killed:
+            raise RuntimeError("host killed (chaos)")
+        return self.inner.add_model(name, model, **kw)
+
+    def add_model_from_registry(self, registry, name, ref="prod", **kw):
+        if self.killed:
+            raise RuntimeError("host killed (chaos)")
+        return self.inner.add_model_from_registry(registry, name, ref, **kw)
+
+    def remove_model(self, name, **kw):
+        return self.inner.remove_model(name, **kw)
+
+    def has_model(self, name):
+        return self.inner.has_model(name)
+
+    def placed_models(self):
+        return self.inner.placed_models()
+
+    def model_last_used(self, name):
+        return self.inner.model_last_used(name)
+
+    def compile_cache_size(self, model=None):
+        return self.inner.compile_cache_size(model=model)
+
+    def metrics_snapshot(self):
+        return self.inner.metrics_snapshot()
+
+    def health_snapshot(self):
+        if self.killed:
+            return {"status": "unready", "ready": False}
+        return self.inner.health_snapshot()
+
+    @property
+    def current_tag(self):
+        return self.inner.current_tag
+
+    def shutdown(self, timeout: float = 5.0):
+        self.inner.shutdown(timeout=timeout)
+
+
+class _Ledger:
+    """One record per submission, always — stranded / at-most-once /
+    attribution / mixing gates all read from here."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.records: List[dict] = []
+        self.n_submitted = 0
+        self.n_done = 0
+        self.resolutions: Dict[int, int] = {}
+
+    def submit(self, router, tenant: str, model: Optional[str],
+               probe_idx: int, x, slo_ms: float) -> None:
+        with self.lock:
+            rid = self.n_submitted
+            self.n_submitted += 1
+        t_submit = time.monotonic()
+        try:
+            fut = router.output_async(x, slo_ms=slo_ms, model=model,
+                                      tenant=tenant)
+        except Exception as exc:
+            # synchronous shed/validation path — still one record
+            self._record(rid, tenant, model, probe_idx, t_submit,
+                         time.monotonic(), exc, None)
+            return
+
+        def cb(f, rid=rid, t_submit=t_submit):
+            exc = f.exception()
+            out = None if exc is not None else np.asarray(f.result())
+            self._record(rid, tenant, model, probe_idx, t_submit,
+                         time.monotonic(), exc, out)
+        fut.add_done_callback(cb)
+
+    def _record(self, rid, tenant, model, probe_idx, t_submit, t_done,
+                exc, out) -> None:
+        shed_tenant = getattr(exc, "tenant", None)
+        rec = {"rid": rid, "tenant": tenant, "model": model,
+               "probe": probe_idx, "t_submit": t_submit, "t_done": t_done,
+               "latency_ms": (t_done - t_submit) * 1e3,
+               "error": type(exc).__name__ if exc is not None else None,
+               "shed_tenant": shed_tenant, "out": out}
+        with self.lock:
+            self.records.append(rec)
+            self.n_done += 1
+            self.resolutions[rid] = self.resolutions.get(rid, 0) + 1
+
+    def drain(self, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self.lock:
+                if self.n_done >= self.n_submitted:
+                    return True
+            time.sleep(0.02)
+        return False
+
+
+def _classify(out: Optional[np.ndarray], probe_idx: int,
+              refs: Dict[str, List[np.ndarray]], atol=1e-3):
+    """Which model produced this response?  Distinct seeds keep the
+    three models numerically far apart on every probe."""
+    if out is None:
+        return None
+    matches = [m for m, rr in refs.items()
+               if out.shape == rr[probe_idx].shape
+               and np.allclose(out, rr[probe_idx], atol=atol)]
+    return matches[0] if len(matches) == 1 else "ambiguous"
+
+
+def _compile_map(hosts: Dict[str, _KillableHost]) -> Dict[str, Dict[str, int]]:
+    """(host, placed model) -> compile-cache size, live hosts only."""
+    out: Dict[str, Dict[str, int]] = {}
+    for hid, h in hosts.items():
+        if h.killed:
+            continue
+        out[hid] = {m: h.compile_cache_size(model=m)
+                    for m in h.placed_models()}
+    return out
+
+
+def _bundle_misses(hosts: Dict[str, _KillableHost]) -> Dict[str, int]:
+    return {hid: int(h.metrics_snapshot()["counters"].get(
+        "bundle_misses", 0)) for hid, h in hosts.items()}
+
+
+def _pace(stop: threading.Event, phases, submit) -> None:
+    """Open-loop pacing: ``phases`` is [(duration_s, rate_hz)]; calls
+    ``submit(i)`` on schedule, never waiting on responses."""
+    i = 0
+    for duration, rate in phases:
+        t0 = time.monotonic()
+        k = 0
+        while not stop.is_set():
+            t = t0 + k / rate
+            now = time.monotonic()
+            if t - now > 0:
+                time.sleep(min(t - now, 0.05))
+                continue
+            if now - t0 >= duration:
+                break
+            submit(i)
+            i += 1
+            k += 1
+
+
+def run_soak(quick: bool) -> dict:
+    import tempfile
+
+    from deeplearning4j_tpu.serving import (
+        Engine, FleetRouter, ModelRegistry, PlacementController,
+        TenantTable,
+    )
+
+    calm_s = 2.0 if quick else 4.0
+    burst_s = 2.5 if quick else 5.0
+    settle_s = 1.0 if quick else 2.0
+    base_rate = 30.0 if quick else 50.0
+    slo_ms = 2500.0
+    t_run0 = time.monotonic()
+
+    # -- setup: models, checkpoints, warm bundles (compiles allowed) ------
+    nets = {"m1": _mlp(7), "m2": _mlp(11), "m3": _mlp(13)}
+    workdir = tempfile.mkdtemp(prefix="multitenant_soak_")
+    reg = ModelRegistry()
+    for name, net in nets.items():
+        path = os.path.join(workdir, f"{name}.zip")
+        net.save(path)
+        v = reg.load(name, path)
+        reg.set_alias(name, "prod", v)
+
+    rng = np.random.default_rng(0)
+    probes = [rng.normal(size=(r, 12)).astype(np.float32)
+              for r in (1, 2, 4, 2)]
+    refs = {m: [np.asarray(nets[m].output(p)) for p in probes]
+            for m in MODELS}
+
+    def make_host():
+        table = TenantTable.from_specs(_tenant_rows())
+        eng = Engine.from_registry(
+            reg, "m1", "prod", max_batch=8, slo_ms=slo_ms, replicas=1,
+            max_queue=100_000, admission="shed", max_wait_ms=2.0,
+            tenants=table)
+        return eng, table
+
+    eng0, table0 = make_host()
+    eng0.load()
+    eng0.save_warmup_bundle()                       # m1 bundle
+    eng0.add_model_from_registry(reg, "m2")         # compiles (setup)
+    eng0.save_warmup_bundle(model="m2")
+    eng0.add_model_from_registry(reg, "m3")
+    eng0.save_warmup_bundle(model="m3")
+    eng0.remove_model("m3")                         # m3 lives on h2 only
+
+    eng1, table1 = make_host()
+    eng1.load()                                     # bundle hit
+    eng1.add_model_from_registry(reg, "m2")         # bundle hit
+    eng2, table2 = make_host()
+    eng2.load()
+    eng2.add_model_from_registry(reg, "m3")         # bundle hit
+
+    tables = {"h0": table0, "h1": table1, "h2": table2}
+    hosts = {hid: _KillableHost(e)
+             for hid, e in (("h0", eng0), ("h1", eng1), ("h2", eng2))}
+    router = FleetRouter(max_retries=3, breaker_threshold=3)
+    for hid, h in hosts.items():
+        router.add_host(hid, engine=h)
+
+    controller = PlacementController(
+        router, reg, models=["m2", "m3"], min_hosts=1,
+        up_load=30.0, down_load=0.5, up_ticks=2, down_ticks=50,
+        cooldown_s=0.5, evict_idle_s=1.2, ewma_alpha=0.6)
+
+    # post-setup baselines for the zero-serve-time-compiles gate
+    misses0 = _bundle_misses(hosts)
+    setup_s = round(time.monotonic() - t_run0, 2)
+
+    # -- the run ----------------------------------------------------------
+    ledger = _Ledger()
+    stop = threading.Event()
+
+    def submit(tenant, model, i):
+        probe_idx = i % len(probes)
+        ledger.submit(router, tenant, model, probe_idx,
+                      probes[probe_idx], slo_ms)
+
+    def ticker():
+        while not stop.wait(0.2):
+            try:
+                controller.tick()
+            except Exception:
+                pass
+    tick_thread = threading.Thread(target=ticker, daemon=True)
+    tick_thread.start()
+
+    threads = [
+        threading.Thread(target=_pace, args=(
+            stop, [(calm_s, base_rate), (burst_s, 10.0 * base_rate),
+                   (settle_s, base_rate)],
+            lambda i: submit(TENANT_BURST, "m2", i)), daemon=True),
+        threading.Thread(target=_pace, args=(
+            stop, [(calm_s + burst_s + settle_s, base_rate)],
+            lambda i: submit(TENANT_A, "m2", i)), daemon=True),
+        threading.Thread(target=_pace, args=(
+            stop, [(calm_s + burst_s + settle_s, base_rate)],
+            lambda i: submit(TENANT_B, None, i)), daemon=True),
+    ]
+    t0 = time.monotonic()
+    kill_at = calm_s + burst_s / 2.0
+    killer = threading.Timer(
+        kill_at, lambda: setattr(hosts["h1"], "killed", True))
+    killer.daemon = True
+    killer.start()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    killer.cancel() if not hosts["h1"].killed else None
+    traffic_done = time.monotonic()
+    drained = ledger.drain(timeout=60)
+
+    # -- phase: demand reload of the evicted idle model -------------------
+    # m3 has been idle since setup; wait for the controller's idle evict
+    evict_deadline = time.monotonic() + 15.0
+    m3_evicted = False
+    while time.monotonic() < evict_deadline:
+        holders = [hid for hid, placed in router.model_map().items()
+                   if "m3" in placed]
+        if not holders:
+            m3_evicted = True
+            break
+        time.sleep(0.1)
+    if router.hosts().get("h1") == "up":      # breaker may not have tripped
+        router.mark_host_down("h1", reason="chaos-kill")
+
+    n_reload = 16 if quick else 32
+    for i in range(n_reload):
+        submit(TENANT_A, "m3", i)
+        time.sleep(0.02)
+    ledger.drain(timeout=60)
+    # a final mixed wave: compile caches must not grow past this point
+    ccs_mid = _compile_map(hosts)
+    for i in range(30):
+        submit(TENANT_B, None, i)
+        submit(TENANT_A, "m2", i)
+        submit(TENANT_A, "m3", i)
+    all_done = ledger.drain(timeout=60) and drained
+    stop.set()
+    tick_thread.join(timeout=10)
+
+    placement_final = router.model_map()
+    ccs_end = _compile_map(hosts)
+    misses_end = _bundle_misses(hosts)
+    fleet_snap = router.metrics_snapshot()
+    hosts_final = dict(router.hosts())
+    health_final = router.health_snapshot()["status"]
+    wall_s = time.monotonic() - t_run0
+    router.shutdown(shutdown_hosts=True)
+
+    # -- gates ------------------------------------------------------------
+    with ledger.lock:
+        records = list(ledger.records)
+        n_submitted = ledger.n_submitted
+        resolutions = dict(ledger.resolutions)
+    stranded = max(0, n_submitted - len(records))
+    double_delivered = sum(1 for c in resolutions.values() if c > 1)
+
+    by_tenant: Dict[str, List[dict]] = {t: [] for t in
+                                        (TENANT_BURST, TENANT_A, TENANT_B)}
+    for r in records:
+        by_tenant[r["tenant"]].append(r)
+
+    def window(recs, lo, hi):
+        return [r for r in recs if lo <= r["t_submit"] - t0 < hi]
+
+    sheds = {t: sum(1 for r in rs if r["error"] == "TenantOverloadedError")
+             for t, rs in by_tenant.items()}
+    shed_tenant_wrong = sum(
+        1 for r in records if r["error"] == "TenantOverloadedError"
+        and r["shed_tenant"] != r["tenant"])
+    errors_nonshed = {
+        t: sum(1 for r in rs if r["error"] is not None
+               and r["error"] != "TenantOverloadedError")
+        for t, rs in by_tenant.items()}
+
+    # exact attribution: ledger == host tables == metric label slices
+    table_sheds = {t: sum(tb.shed_count(t) for tb in tables.values())
+                   for t in by_tenant}
+    metric_sheds = {t: sum(int(h.inner.metrics.counter_value(
+        "shed", tenant=t)) for h in hosts.values()) for t in by_tenant}
+    attribution_exact = (sheds == table_sheds == metric_sheds
+                         and shed_tenant_wrong == 0)
+
+    # victim isolation: burst-window p99 inside the calm envelope
+    def ok_lat(recs):
+        return [r["latency_ms"] for r in recs if r["error"] is None]
+
+    iso = {}
+    victims_ok = True
+    for t in (TENANT_A, TENANT_B):
+        calm_p99 = _p99(ok_lat(window(by_tenant[t], 0.0, calm_s)))
+        burst_p99 = _p99(ok_lat(window(by_tenant[t], calm_s,
+                                       calm_s + burst_s)))
+        bound = max(3.0 * calm_p99, 150.0) if calm_p99 is not None else None
+        t_ok = (calm_p99 is not None and burst_p99 is not None
+                and burst_p99 <= bound)
+        iso[t] = {"calm_p99_ms": round(calm_p99, 2) if calm_p99 else None,
+                  "burst_p99_ms": (round(burst_p99, 2)
+                                   if burst_p99 else None),
+                  "bound_ms": round(bound, 2) if bound else None,
+                  "p99_ok": bool(t_ok)}
+        victims_ok = victims_ok and t_ok
+
+    victim_sheds = sheds[TENANT_A] + sheds[TENANT_B]
+    victim_errors = errors_nonshed[TENANT_A] + errors_nonshed[TENANT_B]
+
+    # zero mixing / cross-tenant poisoning: every OK response classifies
+    # as exactly its request's model
+    mixed = 0
+    for r in records:
+        if r["error"] is not None:
+            continue
+        want = r["model"] if r["model"] is not None else "m1"
+        if _classify(r["out"], r["probe"], refs) != want:
+            mixed += 1
+
+    c = fleet_snap["counters"]
+    miss_delta = {hid: misses_end[hid] - misses0[hid] for hid in misses_end}
+    ccs_stable = all(
+        ccs_end.get(hid, {}).get(m) == n
+        for hid, models in ccs_mid.items() if hid in ccs_end
+        for m, n in models.items() if m in ccs_end.get(hid, {}))
+    m3_reload_ok = any("m3" in placed
+                       for placed in placement_final.values())
+    m3_responses = [r for r in records if r["model"] == "m3"
+                    and r["error"] is None]
+
+    out = {
+        "n_requests": n_submitted,
+        "setup_seconds": setup_s,
+        "wall_seconds": round(wall_s, 2),
+        "traffic_seconds": round(traffic_done - t0, 2),
+        "stranded": int(stranded),
+        "all_done_before_timeout": bool(all_done),
+        "double_delivered": int(double_delivered),
+        "sheds": sheds, "table_sheds": table_sheds,
+        "metric_sheds": metric_sheds,
+        "shed_tenant_wrong": int(shed_tenant_wrong),
+        "attribution_exact": bool(attribution_exact),
+        "burst_sheds": sheds[TENANT_BURST],
+        "victim_sheds": int(victim_sheds),
+        "victim_errors": int(victim_errors),
+        "errors_nonshed": errors_nonshed,
+        "isolation": iso, "victims_ok": bool(victims_ok),
+        "mixed_responses": int(mixed),
+        "m3_evicted": bool(m3_evicted),
+        "m3_reloaded": bool(m3_reload_ok),
+        "m3_ok_responses": len(m3_responses),
+        "placements": int(c.get("placements", 0)),
+        "placement_evictions": int(c.get("placement_evictions", 0)),
+        "demand_loads": int(c.get("demand_loads", 0)),
+        "model_misses": int(c.get("model_misses", 0)),
+        "model_traffic": fleet_snap.get("model_traffic", {}),
+        "bundle_miss_delta": miss_delta,
+        "serve_time_bundle_misses": int(sum(miss_delta.values())),
+        "compile_caches_stable": bool(ccs_stable),
+        "placement_final": {hid: sorted(placed) for hid, placed
+                            in placement_final.items()},
+        "hosts_final": hosts_final,
+        "health_final": health_final,
+        "host_killed": bool(hosts["h1"].killed),
+    }
+    out["soak_ok"] = bool(
+        out["stranded"] == 0
+        and out["all_done_before_timeout"]
+        and out["double_delivered"] == 0
+        and out["burst_sheds"] > 0
+        and out["victim_sheds"] == 0
+        and out["victim_errors"] == 0
+        and out["attribution_exact"]
+        and out["victims_ok"]
+        and out["mixed_responses"] == 0
+        and out["host_killed"]
+        and out["hosts_final"].get("h1") == "down"
+        and out["m3_evicted"]
+        and out["m3_reloaded"]
+        and out["m3_ok_responses"] > 0
+        and out["placements"] > 0
+        and out["placement_evictions"] > 0
+        and out["demand_loads"] > 0
+        and out["model_misses"] > 0
+        and out["serve_time_bundle_misses"] == 0
+        and out["compile_caches_stable"])
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+    quick = args.quick or QUICK
+
+    import jax
+
+    print(f"multitenant_soak: platform={jax.devices()[0].platform}, "
+          f"quick={quick}", file=sys.stderr)
+
+    # tracing rides along (tenant/shed, tenant/placement,
+    # tenant/demand_load, serve/model_load, serve/model_evict instants);
+    # a FAILED soak dumps the ring buffer as its artifact
+    from deeplearning4j_tpu.obs import trace as obs_trace
+    rec = obs_trace.enable_tracing(capacity=131072)
+
+    out = {"config": "multitenant_soak",
+           "platform": jax.devices()[0].platform, "quick": quick}
+    out.update(run_soak(quick))
+    if not out["soak_ok"]:
+        import tempfile
+        path = os.path.join(tempfile.gettempdir(),
+                            "multitenant_soak_failure.trace.json")
+        try:
+            out["trace_artifact"] = rec.save(path)
+        except OSError:
+            out["trace_artifact"] = None
+    print(json.dumps(out), flush=True)
+    return 0 if out["soak_ok"] else 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -176,3 +176,68 @@ class TestMeshTraining:
         assert rc == 0
         out = capsys.readouterr().out
         assert "drops the ragged tail: 4 of 100 samples" in out
+
+
+class TestServeTenantsAndModels:
+    """serve --tenants tenants.json / --models NAME=PATH,... parsing
+    (docs/SERVING.md "Multi-tenant serving")."""
+
+    def _write(self, tmp_path, payload):
+        path = str(tmp_path / "tenants.json")
+        with open(path, "w") as f:
+            json.dump(payload, f)
+        return path
+
+    def test_parse_tenants_list_and_wrapper(self, tmp_path):
+        from deeplearning4j_tpu.cli import _parse_tenants
+
+        rows = [{"tenant": "a", "weight": 2.0, "quota_qps": 10,
+                 "slo_ms": 200},
+                {"tenant": "b", "quota_concurrent": 4,
+                 "admission": "block"}]
+        for payload in (rows, {"tenants": rows}):
+            table = _parse_tenants(self._write(tmp_path, payload))
+            assert table.tenants() == ["a", "b"]
+            assert table.weight("a") == 2.0
+            assert table.admission_for("b") == "block"
+
+    def test_parse_tenants_bad_specs_are_one_line_errors(self, tmp_path):
+        from deeplearning4j_tpu.cli import _parse_tenants
+
+        with pytest.raises(SystemExit, match="bad --tenants"):
+            _parse_tenants(str(tmp_path / "missing.json"))
+        path = str(tmp_path / "junk.json")
+        with open(path, "w") as f:
+            f.write("{not json")
+        with pytest.raises(SystemExit, match="bad --tenants"):
+            _parse_tenants(path)
+        with pytest.raises(SystemExit, match="unknown tenant-spec keys"):
+            _parse_tenants(self._write(
+                tmp_path, [{"tenant": "a", "qps": 5}]))
+        with pytest.raises(SystemExit, match="bad --tenants"):
+            _parse_tenants(self._write(tmp_path, []))
+        with pytest.raises(SystemExit, match="bad --tenants"):
+            _parse_tenants(self._write(tmp_path, [{"weight": 1.0}]))
+
+    def test_parse_models_specs(self):
+        from deeplearning4j_tpu.cli import _parse_models
+
+        assert _parse_models("a=/x/a.zip,b=/y/b.zip") == [
+            ("a", "/x/a.zip"), ("b", "/y/b.zip")]
+        # bare paths name themselves after the file stem
+        assert _parse_models("/ckpt/fraud.zip") == [
+            ("fraud", "/ckpt/fraud.zip")]
+        with pytest.raises(SystemExit, match="duplicate model name"):
+            _parse_models("a=/x/a.zip,a=/y/b.zip")
+        with pytest.raises(SystemExit, match="bad --models"):
+            _parse_models("")
+        with pytest.raises(SystemExit, match="bad --models"):
+            _parse_models("a=,b=/y/b.zip")
+
+    def test_serve_flag_combinations_rejected(self, tmp_path):
+        with pytest.raises(SystemExit, match="--model/--models"):
+            main(["serve"])
+        spec = self._write(tmp_path, [{"tenant": "a"}])
+        with pytest.raises(SystemExit, match="--tenants configures"):
+            main(["serve", "--fleet", "localhost:1,localhost:2",
+                  "--tenants", spec])

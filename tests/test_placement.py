@@ -1,0 +1,215 @@
+"""Traffic-driven model placement (serving/placement.py) + the
+multi-model engine contracts it actuates and the registry inventory
+views it consumes.
+
+Fast CPU tests with duck-typed constant models (the response value IS
+the model identity — version/tenant mixing is directly observable) and
+injected clocks (GC201): the controller's widen/narrow/idle-evict/
+demand-reload decisions are all driven deterministically here; the
+end-to-end chaos proof lives in scripts/multitenant_soak.py.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.conf.inputs import InputType
+from deeplearning4j_tpu.serving import (
+    Engine, FleetRouter, ModelNotLoadedError, ModelRegistry,
+    PlacementController,
+)
+
+
+class _Conf:
+    input_type = InputType.feed_forward(3)
+
+
+class _ConstModel:
+    """Output value identifies the model — mixing is visible; the conf
+    gives Engine.add_model its inferable per-example shape."""
+
+    conf = _Conf()
+
+    def __init__(self, val):
+        self.val = float(val)
+
+    def output(self, x):
+        return np.full((x.shape[0], 1), self.val, np.float32)
+
+
+def _registry():
+    reg = ModelRegistry()
+    for name, val in (("m1", 1.0), ("m2", 2.0), ("m3", 3.0)):
+        v = reg.register(name, _ConstModel(val))
+        reg.set_alias(name, "prod", v)
+    return reg
+
+
+def _engine(reg, default="m1", **kw):
+    kw.setdefault("max_batch", 8)
+    kw.setdefault("slo_ms", 10_000)
+    kw.setdefault("replicas", 1)
+    eng = Engine.from_registry(reg, default, **kw)
+    eng.load(input_shape=(3,))
+    return eng
+
+
+X = np.zeros((1, 3), np.float32)
+
+
+class TestRegistryInventory:
+    def test_list_aliases_is_the_deployable_view(self):
+        reg = _registry()
+        aliases = reg.list_aliases()
+        assert set(aliases) == {"m1", "m2", "m3"}
+        assert aliases["m1"] == {"prod": 1}
+        reg.register("m4", _ConstModel(4.0))    # no alias -> omitted
+        assert "m4" not in reg.list_aliases()
+
+    def test_models_snapshot_inventory(self):
+        reg = _registry()
+        reg.register("m1", _ConstModel(1.5))    # v2; prod stays at v1
+        snap = reg.models_snapshot()
+        assert set(snap) == {"m1", "m2", "m3"}
+        assert snap["m1"]["versions"] == [1, 2]
+        assert snap["m1"]["pinned"] == 1
+        assert snap["m1"]["aliases"] == {"prod": 1}
+        assert snap["m2"]["last_access"] is None   # never resolved
+        reg.resolve("m2", "prod")
+        assert reg.models_snapshot()["m2"]["last_access"] is not None
+
+
+class TestMultiModelEngine:
+    def test_add_model_places_and_routes(self):
+        reg = _registry()
+        eng = _engine(reg)
+        eng.add_model_from_registry(reg, "m2", input_shape=(3,))
+        assert eng.has_model("m2") and eng.has_model("m1")
+        assert set(eng.placed_models()) == {"m1", "m2"}
+        assert eng.placed_models()["m2"] == "m2:v1"
+        out1 = eng.output_async(X).result(timeout=10)
+        out2 = eng.output_async(X, model="m2").result(timeout=10)
+        assert float(out1[0, 0]) == 1.0 and float(out2[0, 0]) == 2.0
+        assert eng.model_last_used("m2") is not None
+        eng.shutdown()
+
+    def test_add_model_rejects_duplicates_and_default(self):
+        reg = _registry()
+        eng = _engine(reg)
+        eng.add_model("m2", _ConstModel(2.0), input_shape=(3,))
+        with pytest.raises(ValueError, match="already placed"):
+            eng.add_model("m2", _ConstModel(9.0), input_shape=(3,))
+        with pytest.raises(ValueError, match="already placed"):
+            eng.add_model("m1", _ConstModel(9.0), input_shape=(3,))
+        eng.shutdown()
+
+    def test_remove_model_evicts_but_never_the_default(self):
+        reg = _registry()
+        eng = _engine(reg)
+        eng.add_model("m2", _ConstModel(2.0), input_shape=(3,))
+        assert eng.remove_model("m2") is True
+        assert not eng.has_model("m2")
+        assert eng.remove_model("m2") is False      # already gone
+        with pytest.raises(ModelNotLoadedError):
+            eng.output_async(X, model="m2").result(timeout=10)
+        with pytest.raises(ValueError, match="default model"):
+            eng.remove_model("m1")
+        eng.shutdown()
+
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestPlacementController:
+    def _fleet(self, reg, n=2):
+        router = FleetRouter(max_retries=2)
+        engines = []
+        for i in range(n):
+            eng = _engine(reg)
+            engines.append(eng)
+            router.add_host(f"h{i}", engine=eng)
+        return router, engines
+
+    def test_widen_on_demand_then_idle_evict(self):
+        reg = _registry()
+        router, engines = self._fleet(reg)
+        engines[0].add_model_from_registry(reg, "m2", input_shape=(3,))
+        clk = _Clock()
+        ctl = PlacementController(
+            router, reg, models=["m2"], up_load=4.0, up_ticks=1,
+            down_ticks=1000, cooldown_s=0.0, evict_idle_s=5.0,
+            ewma_alpha=1.0, clock=clk)
+        # hot: demand 20/tick over 1 holder >> up_load -> widen to h1
+        for _ in range(20):
+            router.output_async(X, model="m2").result(timeout=10)
+        moves = ctl.tick()
+        assert {"op": "add", "model": "m2", "host": "h1",
+                "reason": "hot"} in moves
+        assert sorted(ctl.placement()["m2"]) == ["h0", "h1"]
+        assert engines[1].output_async(
+            X, model="m2").result(timeout=10)[0, 0] == 2.0
+        # idle: no traffic, last_used ages past evict_idle_s -> evicted
+        # from EVERY holder (idle eviction bypasses min_hosts).  The
+        # engines stamp last_used on THEIR clock (real monotonic), so
+        # idle-age the controller clock past that.
+        clk.t = time.monotonic() + 1000.0
+        moves = ctl.tick()
+        assert sorted(m["host"] for m in moves
+                      if m["op"] == "evict") == ["h0", "h1"]
+        assert ctl.placement()["m2"] == []
+        router.shutdown(shutdown_hosts=True)
+
+    def test_demand_reload_on_model_miss(self):
+        """An evicted model's next request demand-reloads it through the
+        router's miss hook — one latency bump, not an error."""
+        reg = _registry()
+        router, engines = self._fleet(reg)
+        ctl = PlacementController(router, reg, models=["m3"],
+                                  clock=_Clock())
+        assert ctl.placement()["m3"] == []
+        out = router.output_async(X, model="m3").result(timeout=10)
+        assert float(out[0, 0]) == 3.0
+        assert len(ctl.placement()["m3"]) == 1
+        c = router.metrics_snapshot()["counters"]
+        assert c.get("model_misses", 0) >= 1
+        assert c.get("demand_loads", 0) == 1
+        router.shutdown(shutdown_hosts=True)
+
+    def test_unmanaged_model_miss_fails_typed(self):
+        reg = _registry()
+        router, _ = self._fleet(reg)
+        PlacementController(router, reg, models=["m2"], clock=_Clock())
+        with pytest.raises(ModelNotLoadedError):
+            router.output_async(X, model="m3").result(timeout=10)
+        router.shutdown(shutdown_hosts=True)
+
+    def test_no_mixing_across_models_under_load(self):
+        reg = _registry()
+        router, engines = self._fleet(reg)
+        engines[0].add_model_from_registry(reg, "m2", input_shape=(3,))
+        engines[1].add_model_from_registry(reg, "m2", input_shape=(3,))
+        futs = [(m, router.output_async(X, model=m if m != "m1" else None))
+                for _ in range(50) for m in ("m1", "m2")]
+        want = {"m1": 1.0, "m2": 2.0}
+        for m, f in futs:
+            assert float(f.result(timeout=30)[0, 0]) == want[m]
+        router.shutdown(shutdown_hosts=True)
+
+    def test_manage_and_snapshot(self):
+        reg = _registry()
+        router, _ = self._fleet(reg, n=1)
+        ctl = PlacementController(router, reg, models=["m2"],
+                                  clock=_Clock())
+        assert ctl.managed_models() == ["m2"]
+        ctl.manage("m3")
+        assert "m3" in ctl.managed_models()
+        snap = ctl.snapshot()
+        assert set(snap) == {"placement", "demand_ewma", "recent_moves"}
+        assert set(snap["placement"]) == {"m2", "m3"}
+        router.shutdown(shutdown_hosts=True)
