@@ -2029,6 +2029,72 @@ def bench_decode_speed():
             "zero_compiles": True, "stranded": 0}
 
 
+def bench_fused_step():
+    """Config 28: host-overhead elimination A/B (scripts/decode_ab.py
+    --host-overhead; CPU subprocess — the horizon-fusion and chunking
+    logic under test is host-side + bitwise).  HARD gates on EVERY
+    platform:
+      fused — at every H in {2, 4, 8}: temp-0 tokens identical to the
+        plain engine with echoed logits BITWISE equal to the re-encode
+        oracle, seeded temp>0 tokens identical (counter-based RNG keying
+        is horizon-invariant), a crash injected mid-horizon strands
+        nothing and retries reproduce identical bits, zero serve-time
+        compiles with the fused executable round-tripping through the
+        warmup bundle (bundle_misses == 0).
+      speed — batch-1 closed-loop tokens/sec strictly above the
+        plain-step engine (H-for-1 host dispatch amortization is
+        platform-independent, so this gate holds everywhere).
+      chunked — a long-prompt wall landing on a unified engine holds
+        the in-flight streams' inter-step TPOT p99 <= 1.2x calm, while
+        the same wall on monolithic prefill measurably degrades it.
+    On failure the subprocess dumps its trace ring as a Chrome trace
+    artifact (path surfaced in the error)."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    script = os.path.join(_REPO, "scripts", "decode_ab.py")
+    cmd = [sys.executable, script, "--host-overhead"] + (
+        ["--quick"] if QUICK else [])
+    p = subprocess.run(cmd, env=env, capture_output=True, text=True,
+                       timeout=1800, cwd=_REPO)
+    if p.returncode != 0:
+        raise RuntimeError(f"decode_ab --host-overhead failed "
+                           f"(rc={p.returncode}): {p.stderr[-1500:]}")
+    ab = json.loads(p.stdout.strip().splitlines()[-1])
+    art = ab.get("trace_artifact")
+    suffix = f" [trace artifact: {art}]" if art else ""
+    for H, arm in ab["fused"].items():
+        if not arm.get("ok"):
+            raise RuntimeError(
+                f"fused-decode gate FAILED at H={H} (temp-0 bit-identity, "
+                "seeded identity, crash-mid-horizon retry, bundle "
+                f"round-trip, zero compiles): {arm}{suffix}")
+    spd = ab["speed"]
+    if not spd.get("ok"):
+        raise RuntimeError("fused-decode speed gate FAILED (batch-1 "
+                           "tokens/sec must beat the plain-step engine "
+                           f"on every platform): {spd}{suffix}")
+    chk = ab["chunked"]
+    if not chk.get("ok"):
+        raise RuntimeError("chunked-prefill gate FAILED (wall TPOT p99 "
+                           "<= 1.2x calm, plain degrades, token parity, "
+                           f"chunk counters, zero compiles): {chk}{suffix}")
+    return {"metric": "fused_step_speedup", "value": spd["speedup"],
+            "unit": "ratio (cpu)" if ab["platform"] != "tpu" else "ratio",
+            "platform": ab["platform"],
+            "plain_tokens_per_sec": spd["plain_tokens_per_sec"],
+            "fused_tokens_per_sec": spd["fused_tokens_per_sec"],
+            "tokens_per_dispatch": spd["tokens_per_dispatch"],
+            "chunk_tpot_wall_over_calm": chk["tpot_wall_over_calm"],
+            "plain_tpot_wall_over_calm":
+                chk["plain_tpot_wall_over_calm"],
+            "prefill_chunks": chk["prefill_chunks"],
+            "bit_identical": True, "tokens_match": True,
+            "zero_compiles": True, "stranded": 0}
+
+
 def _backfill_artifacts() -> None:
     """One-time repair of pre-round-6 artifacts: derive the structured
     ``parsed.results`` list from the stderr-tail regex and write it BACK
@@ -2105,6 +2171,7 @@ def main() -> None:
                      ("continuous_batching_ab", bench_continuous_batching),
                      ("cold_start_ab", bench_cold_start),
                      ("decode_speed_ab", bench_decode_speed),
+                     ("fused_step_ab", bench_fused_step),
                      ("disagg_decode_ab", bench_disagg_decode),
                      ("train_promote_loop", bench_train_promote),
                      ("multitenant_soak", bench_multitenant)]:

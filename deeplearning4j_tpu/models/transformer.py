@@ -255,6 +255,7 @@ class TransformerDecodeAdapter:
             NEG_INF, DecodeProgram, det_attention, gather_layer,
             write_prefill, write_step, write_tokens,
         )
+        from ..ops.sampling import sample_token
 
         pos_rows = int(self.params["pos"]["P"].shape[0])
         if max_len is None:
@@ -370,6 +371,48 @@ class TransformerDecodeAdapter:
                 h = block_finish(bp, h, det_attention(q, k_all, v_all, bias))
             return k_pages, v_pages, head(params, h)
 
+        vocab = self.vocab_size
+
+        def step_multi(params, k_pages, v_pages, page_table, tokens,
+                       positions, active, temps, top_ks, top_ps, seeds,
+                       steps, budgets, eos_id, horizon):
+            # H = horizon.shape[0] consecutive decode steps in ONE
+            # program: scan of the step body with device-resident
+            # sampling.  A slot that hits EOS / its token budget /
+            # non-finite logits drops out of ``alive``; its page-table
+            # row zeroes, so the remaining iterations write to scratch
+            # and live slots' bits match H plain steps exactly.
+            def body(carry, j):
+                k_pages, v_pages, tok, alive = carry
+                pos_j = positions + j
+                h = (tok_embed(params, tok)
+                     + params["pos"]["P"][jnp.clip(pos_j, 0, pos_rows - 1)]
+                     )[:, None]
+                bias = jnp.where(
+                    jnp.arange(L, dtype=jnp.int32)[None, :]
+                    <= pos_j[:, None], 0.0, NEG_INF)[:, None, None, :]
+                pt = jnp.where(alive[:, None], page_table, 0)
+                for i, bp in enumerate(params["blocks"]):
+                    q, k, v = block_kv_project(bp, h, n_heads)
+                    k_pages = write_step(k_pages, i, pt, pos_j, k[:, :, 0])
+                    v_pages = write_step(v_pages, i, pt, pos_j, v[:, :, 0])
+                    k_all = gather_layer(k_pages, i, pt).transpose(0, 2, 1, 3)
+                    v_all = gather_layer(v_pages, i, pt).transpose(0, 2, 1, 3)
+                    h = block_finish(bp, h,
+                                     det_attention(q, k_all, v_all, bias))
+                lgs = head(params, h)[:, 0]
+                nxt, fin = jax.vmap(
+                    lambda l, t, kk, pp, sd, st:
+                        sample_token(l, t, kk, pp, sd, st, vocab)
+                )(lgs, temps, top_ks, top_ps, seeds, steps + j)
+                alive = (alive & fin & (nxt != eos_id)
+                         & (j + 1 < budgets))
+                return (k_pages, v_pages, nxt, alive), (nxt, fin, lgs)
+
+            (k_pages, v_pages, _, _), (toks, fins, lgs) = jax.lax.scan(
+                body, (k_pages, v_pages, tokens, active), horizon)
+            return k_pages, v_pages, toks, fins, lgs
+
         def reencode(params, tokens):
             b, t = tokens.shape
             h = tok_embed(params, tokens) + params["pos"]["P"][:t]
@@ -387,4 +430,5 @@ class TransformerDecodeAdapter:
             n_layers=n_layers, n_heads=n_heads, d_head=d_model // n_heads,
             vocab_size=self.vocab_size, max_len=L, page_size=page_size,
             pages_per_slot=L // page_size,
-            prefill_at=prefill_at, spec_step=spec_step)
+            prefill_at=prefill_at, spec_step=spec_step,
+            step_multi=step_multi)

@@ -398,6 +398,21 @@ class DecodeProgram(NamedTuple):
           -> (k_pages, v_pages, logits [S, T, V])
           speculative verify: score T tokens per slot in one call,
           writing their K/V rows (overflow rows route to scratch)
+      step_multi(params, k_pages, v_pages, page_table, tokens,
+                 positions, active, temps [S], top_ks [S], top_ps [S],
+                 seeds [S], steps [S], budgets [S], eos_id, horizon [H])
+          -> (k_pages, v_pages, tokens [H, S], finite [H, S],
+              logits [H, S, V])
+          fused multi-step decode: ``lax.scan`` of the step body over
+          ``horizon`` (an int32 arange whose LENGTH is the fused
+          horizon H), with sampling device-resident
+          (``ops.sampling.sample_token`` keyed ``fold_in(seed,
+          steps + j)``) so the host syncs once per H tokens.  Per-slot
+          EOS (token == eos_id; pass -1 to disable) / token-budget /
+          poison masking runs on device: a finished slot's page-table
+          row zeroes, routing its remaining writes to the scratch page,
+          so live slots' bits are untouched and fusion stays
+          bit-identical to H plain steps.
     """
 
     prefill: Callable[..., Any]
@@ -412,6 +427,7 @@ class DecodeProgram(NamedTuple):
     pages_per_slot: int
     prefill_at: Any = None
     spec_step: Any = None
+    step_multi: Any = None
     # tensor-parallel degree of the program's executables: >1 means the
     # fns are shard_map'd over the mesh's "data" axis (heads + page pool
     # sharded, logits replicated) — see parallel/transformer.py

@@ -1,0 +1,52 @@
+"""Seeded counter-based token sampling — ONE source of truth.
+
+The decode engine's host-driven samplers (serving/decode.py
+``_make_samplers``) and the fused multi-step decode programs
+(``DecodeProgram.step_multi`` — models/transformer.py,
+parallel/transformer.py) must draw bitwise-identical tokens for the
+same (logits, sampling spec, seed, token_index): the fused-decode A/B
+gate (bench ``fused_step_ab``) compares them token for token, and the
+crash-retry path regenerates sequences by replaying the same counters.
+Keeping the math here makes that identity structural — both callers
+trace the SAME function, so there is no second implementation to
+drift.
+
+The key schedule is ``fold_in(PRNGKey(seed), step)`` with ``step`` the
+absolute generated-token index (0 = the token sampled from the prefill
+logits), which is what makes horizon fusion exact: step j of a fused
+horizon uses the identical key the plain engine would have used j
+dispatches later.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_token(lg, t, k, p, seed, step, vocab_size: int):
+    """Sample one token from a logits row ``lg`` [V].
+
+    temperature ``t`` <= 0 is greedy; ``k`` == 0 and ``p`` >= 1 disable
+    the top-k / top-p filters.  Returns ``(token int32, finite bool)``
+    — ``finite`` is the all-finite poison flag the engine's isolation
+    path reads.  Deterministic: the PRNG key is
+    ``fold_in(PRNGKey(seed), step)``, so the same (seed, step) always
+    produces the same draw regardless of which executable traced it.
+    """
+    finite = jnp.all(jnp.isfinite(lg))
+    greedy = jnp.argmax(lg).astype(jnp.int32)
+    scaled = lg / jnp.maximum(t, 1e-6)
+    srt = jnp.sort(scaled)[::-1]
+    kk = jnp.clip(jnp.where(k > 0, k, vocab_size), 1, vocab_size)
+    thr_k = srt[kk - 1]
+    probs = jax.nn.softmax(srt)
+    cum_excl = jnp.cumsum(probs) - probs   # mass BEFORE each entry
+    keep = cum_excl < jnp.clip(p, 1e-6, 1.0)  # top-1 always kept
+    thr_p = jnp.min(jnp.where(keep, srt, jnp.inf))
+    thr = jnp.maximum(thr_k, thr_p)
+    masked = jnp.where(scaled >= thr, scaled, -jnp.inf)
+    g = jax.random.gumbel(
+        jax.random.fold_in(jax.random.PRNGKey(seed), step), lg.shape)
+    sampled = jnp.argmax(masked + g).astype(jnp.int32)
+    return jnp.where(t <= 0.0, greedy, sampled), finite

@@ -331,6 +331,7 @@ class ShardedTransformerLM:
             NEG_INF, DecodeProgram, det_attention, gather_layer,
             write_prefill, write_step, write_tokens,
         )
+        from ..ops.sampling import sample_token
 
         n_dev = int(np.prod(list(self.mesh.shape.values())))
         tp = 1
@@ -489,6 +490,62 @@ class ShardedTransformerLM:
             h = layer_norm(h, params["lnf_g"], params["lnf_b"])
             return h @ params["head"]
 
+        vocab = self.vocab_size
+
+        def _sample_rows(lgs, temps, top_ks, top_ps, seeds, steps):
+            return jax.vmap(
+                lambda l, t, k, p, sd, st:
+                    sample_token(l, t, k, p, sd, st, vocab)
+            )(lgs, temps, top_ks, top_ps, seeds, steps)
+
+        def step_multi(params, k_pages, v_pages, page_table, tokens,
+                       positions, active, temps, top_ks, top_ps, seeds,
+                       steps, budgets, eos_id, horizon):
+            """H = horizon.shape[0] consecutive decode steps in ONE
+            program: ``lax.scan`` of the ``step`` body with sampling
+            device-resident (ops/sampling.sample_token keyed
+            ``fold_in(seed, steps + j)`` — the identical key schedule
+            the engine's per-step sampler uses, which is what makes
+            horizon fusion bit-identical to step-by-step).  Per-slot
+            EOS (``eos_id``; pass -1 to disable) / token-budget /
+            poison masking runs on device: a finished slot leaves
+            ``alive``, its page-table row zeroes, and its remaining
+            writes route to the scratch page, so live slots' bits match
+            H plain steps exactly.  Returns stacked per-iteration
+            (tokens, finite, logits); the host records tokens up to
+            each slot's stop and discards the device overrun."""
+            def body(carry, j):
+                k_pages, v_pages, tok, alive = carry
+                pos_j = positions + j
+                h = (params["embed"][tok]
+                     + params["pos"][jnp.clip(pos_j, 0, pos_rows - 1)]
+                     )[:, None]
+                bias = jnp.where(
+                    jnp.arange(L, dtype=jnp.int32)[None, :]
+                    <= pos_j[:, None], 0.0, NEG_INF)[:, None, None, :]
+                pt = jnp.where(alive[:, None], page_table, 0)
+                for i, bp in enumerate(_blocks(params)):
+                    q, k, v = block_kv_project(bp, h, n_heads)
+                    k_pages = write_step(k_pages, i, pt, pos_j, k[:, :, 0])
+                    v_pages = write_step(v_pages, i, pt, pos_j, v[:, :, 0])
+                    k_all = gather_layer(
+                        k_pages, i, pt).transpose(0, 2, 1, 3)
+                    v_all = gather_layer(
+                        v_pages, i, pt).transpose(0, 2, 1, 3)
+                    h = block_finish(bp, h,
+                                     det_attention(q, k_all, v_all, bias))
+                h = layer_norm(h, params["lnf_g"], params["lnf_b"])
+                lgs = (h @ params["head"])[:, 0]
+                nxt, fin = _sample_rows(lgs, temps, top_ks, top_ps,
+                                        seeds, steps + j)
+                alive = (alive & fin & (nxt != eos_id)
+                         & (j + 1 < budgets))
+                return (k_pages, v_pages, nxt, alive), (nxt, fin, lgs)
+
+            (k_pages, v_pages, _, _), (toks, fins, lgs) = jax.lax.scan(
+                body, (k_pages, v_pages, tokens, active), horizon)
+            return k_pages, v_pages, toks, fins, lgs
+
         if tp > 1:
             # tensor-parallel twins of the five entry points: identical
             # per-row math, but each shard projects only its local head
@@ -645,16 +702,60 @@ class ShardedTransformerLM:
                 h = layer_norm(h, params["lnf_g"], params["lnf_b"])
                 return h @ params["head"]
 
-            def _wrap(body):
+            def _step_multi_sh(params, k_pages, v_pages, page_table,
+                               tokens, positions, active, temps, top_ks,
+                               top_ps, seeds, steps, budgets, eos_id,
+                               horizon):
+                # fused scan of _step_sh's body; post-psum h is
+                # replicated, so every shard samples the SAME token from
+                # the same deterministic key — no gather needed
+                def body(carry, j):
+                    k_pages, v_pages, tok, alive = carry
+                    pos_j = positions + j
+                    h = (params["embed"][tok]
+                         + params["pos"][jnp.clip(pos_j, 0, pos_rows - 1)]
+                         )[:, None]
+                    bias = jnp.where(
+                        jnp.arange(L, dtype=jnp.int32)[None, :]
+                        <= pos_j[:, None], 0.0,
+                        NEG_INF)[:, None, None, :]
+                    pt = jnp.where(alive[:, None], page_table, 0)
+                    for i, bp in enumerate(_local_blocks(params)):
+                        q, k, v = block_kv_project(bp, h, hl)
+                        k_pages = write_step(k_pages, i, pt, pos_j,
+                                             k[:, :, 0])
+                        v_pages = write_step(v_pages, i, pt, pos_j,
+                                             v[:, :, 0])
+                        k_all = gather_layer(
+                            k_pages, i, pt).transpose(0, 2, 1, 3)
+                        v_all = gather_layer(
+                            v_pages, i, pt).transpose(0, 2, 1, 3)
+                        h = block_finish(
+                            bp, h, det_attention(q, k_all, v_all, bias),
+                            psum_axis="data")
+                    h = layer_norm(h, params["lnf_g"], params["lnf_b"])
+                    lgs = (h @ params["head"])[:, 0]
+                    nxt, fin = _sample_rows(lgs, temps, top_ks, top_ps,
+                                            seeds, steps + j)
+                    alive = (alive & fin & (nxt != eos_id)
+                             & (j + 1 < budgets))
+                    return (k_pages, v_pages, nxt, alive), (nxt, fin, lgs)
+
+                (k_pages, v_pages, _, _), (toks, fins, lgs) = jax.lax.scan(
+                    body, (k_pages, v_pages, tokens, active), horizon)
+                return k_pages, v_pages, toks, fins, lgs
+
+            def _wrap(body, n_rep=1):
                 # the pool specs depend on the pool KIND, so the
                 # shard_map is built at trace time (inside the engine's
-                # jit) where the pytree is known
+                # jit) where the pytree is known; n_rep = number of
+                # replicated outputs after the two pool sides
                 def fn(params, k_pages, v_pages, *rest):
                     ks, vs = _pool_spec(k_pages), _pool_spec(v_pages)
                     sm = shard_map(
                         body, mesh=mesh,
                         in_specs=(rep, ks, vs) + (rep,) * len(rest),
-                        out_specs=(ks, vs, rep))
+                        out_specs=(ks, vs) + (rep,) * n_rep)
                     return sm(params, k_pages, v_pages, *rest)
                 return fn
 
@@ -662,6 +763,7 @@ class ShardedTransformerLM:
             step = _wrap(_step_sh)
             prefill_at = _wrap(_prefill_at_sh)
             spec_step = _wrap(_spec_step_sh)
+            step_multi = _wrap(_step_multi_sh, n_rep=3)
 
             def reencode(params, tokens):
                 return shard_map(_reencode_sh, mesh=mesh,
@@ -673,4 +775,5 @@ class ShardedTransformerLM:
             n_layers=n_layers, n_heads=n_heads, d_head=d_model // n_heads,
             vocab_size=self.vocab_size, max_len=L, page_size=page_size,
             pages_per_slot=L // page_size,
-            prefill_at=prefill_at, spec_step=spec_step, tp=tp)
+            prefill_at=prefill_at, spec_step=spec_step,
+            step_multi=step_multi, tp=tp)

@@ -490,22 +490,46 @@ class ContinuousBatcher(DynamicBatcher):
             self._nonempty.notify()
         return fut
 
-    def admit(self, limit: int) -> List[_Request]:
+    def admit(self, limit: int,
+              token_budget: Optional[int] = None) -> List[_Request]:
         """Pop up to ``limit`` queued requests (0 when idle) — called at
         every decode-step boundary, in fair-share lane order (decode
         slots each carry their own model tag, so one admit round MAY
         span models).  Expired requests fail fast first, exactly as in
-        the one-shot path."""
+        the one-shot path.
+
+        ``token_budget`` is the chunked-prefill batch-formation rule
+        (docs/SERVING.md "Host-overhead elimination"): stop admitting
+        once the popped payloads' prompt tokens (``len(payload.prompt)``
+        for payloads that carry one) would exceed the budget, so one
+        admit round never enqueues more prefill work than the engine is
+        willing to interleave per step — a wall of long prompts drains
+        one chunk-budget's worth per round instead of all at once.  The
+        head request is always admitted even when it alone exceeds the
+        budget (an oversized prompt cannot be split at admission; the
+        engine chunks its prefill instead), so the rule bounds pacing
+        without ever starving."""
         if limit <= 0:
             return []
+
+        def _cost(r: _Request) -> int:
+            p = getattr(r.payload, "prompt", None)
+            return 0 if p is None else len(p)
+
         with self._lock:
             self._expire_locked(self.clock())
             out: List[_Request] = []
+            spent = 0
             while self._n_pending and len(out) < limit:
                 t = self._next_lane_locked()
                 if t is None:
                     break
-                out.append(self._pop_one_locked(t))
+                if (token_budget is not None and out
+                        and spent + _cost(self._lanes[t][0]) > token_budget):
+                    break
+                r = self._pop_one_locked(t)
+                spent += _cost(r)
+                out.append(r)
             if out:
                 self._space.notify_all()
             return out

@@ -87,6 +87,29 @@ Three stacked decode-side optimizations, each independently gated
       fixed HBM; changes bits, so it is gated by a top1-agree accuracy
       envelope in ``decode_speed_ab``, never by the identity gates.
 
+Two host-overhead eliminations ride on top (docs/SERVING.md
+"Host-overhead elimination"; both off by default, both bit-exact):
+
+  fused multi-step decode (``decode_horizon=H``)
+      H consecutive decode steps + device-resident sampling run inside
+      ONE AOT executable (``DecodeProgram.step_multi`` — a ``lax.scan``
+      of the step body) so the per-token Python round-trip is paid once
+      per H tokens.  The ``fold_in(seed, token_index)`` keying makes
+      the fused stream bit-identical to step-by-step; per-slot
+      EOS/budget/poison masking on device routes a finished slot's
+      remaining writes to the scratch page, and the host discards the
+      ≤ H-1 token overrun at replay.  Mutually exclusive with
+      speculative decoding (checked at construction).
+
+  chunked prefill (``prefill_chunk=N``)
+      Long prompts prefill in ≤ N-token chunks through ``prefill_at``
+      at increasing offsets, ONE chunk per loop iteration, so a long
+      prompt never serializes the decode step loop; the batcher's
+      token-budget admission rule paces a wall of prompts to the same
+      chunk budget.  Per-row attention math is unchanged, so the final
+      chunk's logits (and every sampled token) are bit-identical to an
+      unchunked prefill.
+
 TTFT and time-per-output-token are first-class (``DecodeMetrics``,
 ``serve/prefill`` / ``serve/decode_step`` / ``serve/prefix_attach`` /
 ``serve/spec_verify`` spans — docs/OBSERVABILITY.md).
@@ -184,7 +207,8 @@ class _Slot:
 
     __slots__ = ("req", "spec", "tag", "page_ids", "n_prompt", "pos",
                  "last_token", "tokens", "n_out", "max_new", "deadline",
-                 "t_first", "t_last", "logits", "shared_nodes", "n_matched")
+                 "t_first", "t_last", "logits", "shared_nodes", "n_matched",
+                 "n_prefilled")
 
     def __init__(self, req, tag: str, page_ids: List[int], max_new: int):
         self.req = req
@@ -204,6 +228,10 @@ class _Slot:
             [] if self.spec.echo_logits else None
         self.shared_nodes: List["_PrefixNode"] = []
         self.n_matched = 0
+        # chunked prefill progress: prompt tokens already resident in
+        # the cache (None once prefill completes / for unchunked slots);
+        # a slot with n_prefilled set is NOT steppable yet
+        self.n_prefilled: Optional[int] = None
 
 
 class _PrefixNode:
@@ -234,27 +262,17 @@ def _make_samplers(vocab_size: int):
     ``fold_in(PRNGKey(seed), step)`` — same (seed, step) → same draw.
     temperature <= 0 is greedy; top_k == 0 and top_p >= 1 disable those
     filters.  Also returns the all-finite flag the poison check reads.
+
+    The math lives in ``ops.sampling.sample_token`` so the fused
+    ``step_multi`` programs trace the SAME function — that shared
+    source is what makes horizon fusion bit-identical to step-by-step.
     """
     import jax
-    import jax.numpy as jnp
+
+    from ..ops.sampling import sample_token
 
     def sample_one(lg, t, k, p, seed, step):
-        finite = jnp.all(jnp.isfinite(lg))
-        greedy = jnp.argmax(lg).astype(jnp.int32)
-        scaled = lg / jnp.maximum(t, 1e-6)
-        srt = jnp.sort(scaled)[::-1]
-        kk = jnp.clip(jnp.where(k > 0, k, vocab_size), 1, vocab_size)
-        thr_k = srt[kk - 1]
-        probs = jax.nn.softmax(srt)
-        cum_excl = jnp.cumsum(probs) - probs   # mass BEFORE each entry
-        keep = cum_excl < jnp.clip(p, 1e-6, 1.0)  # top-1 always kept
-        thr_p = jnp.min(jnp.where(keep, srt, jnp.inf))
-        thr = jnp.maximum(thr_k, thr_p)
-        masked = jnp.where(scaled >= thr, scaled, -jnp.inf)
-        g = jax.random.gumbel(
-            jax.random.fold_in(jax.random.PRNGKey(seed), step), lg.shape)
-        sampled = jnp.argmax(masked + g).astype(jnp.int32)
-        return jnp.where(t <= 0.0, greedy, sampled), finite
+        return sample_token(lg, t, k, p, seed, step, vocab_size)
 
     def sample_batch(lgs, ts, ks, ps, seeds, steps):
         return jax.vmap(sample_one)(lgs, ts, ks, ps, seeds, steps)
@@ -382,9 +400,30 @@ class DecodeEngine:
                  metrics: Optional[DecodeMetrics] = None,
                  prefix_cache: bool = False, draft_model=None,
                  speculate_k: int = 4, kv_dtype: Optional[str] = None,
-                 role: str = "unified", tenants=None):
+                 role: str = "unified", tenants=None,
+                 decode_horizon: int = 1,
+                 prefill_chunk: Optional[int] = None):
         if max_slots < 1:
             raise ValueError("max_slots must be >= 1")
+        if decode_horizon < 1:
+            raise ValueError("decode_horizon must be >= 1")
+        if decode_horizon > 1 and draft_model is not None:
+            raise ValueError(
+                "fused multi-step decode and speculative decoding are "
+                "mutually exclusive — speculation keeps its own round "
+                "structure (propose/verify/commit), so a fused horizon "
+                "has nothing to amortize there")
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        if prefill_chunk is not None and role != "unified":
+            raise ValueError(
+                "chunked prefill is unified-role only: a decode-role "
+                "host never prefills, and a prefill-role host has no "
+                "step loop to interleave with")
+        if prefill_chunk is not None and draft_model is not None:
+            raise ValueError(
+                "chunked prefill + speculative decoding is unsupported "
+                "(the draft pool's mirror prefill is not chunked)")
         if kv_dtype not in (None, "f32", "float32", "int8", "i8"):
             raise ValueError(f"kv_dtype {kv_dtype!r} not supported "
                              "(float32 or int8)")
@@ -410,6 +449,17 @@ class DecodeEngine:
             raise ValueError(
                 "prefix_cache=True needs a decode program with a "
                 "prefill_at entry point (suffix prefill)")
+        self.decode_horizon = int(decode_horizon)
+        if self.decode_horizon > 1 and prog.step_multi is None:
+            raise ValueError(
+                "decode_horizon > 1 needs a decode program with a "
+                "step_multi entry point (fused multi-step decode)")
+        self.prefill_chunk = (None if prefill_chunk is None
+                              else int(prefill_chunk))
+        if self.prefill_chunk is not None and prog.prefill_at is None:
+            raise ValueError(
+                "prefill_chunk needs a decode program with a prefill_at "
+                "entry point (offset prefill drives each chunk)")
         self._kv_dtype = kv_dtype
         self.speculate_k = int(speculate_k)
         self._draft_program = None
@@ -482,6 +532,7 @@ class DecodeEngine:
         self._loaded = False
         self._shutdown = False
         self._generation = 0
+        self._chunk_cursor = 0     # round-robin over chunked prefills
         self._crash_next = False   # test hook: raise inside the next step
         self._thread: Optional[threading.Thread] = None
         self._supervisor: Optional[threading.Thread] = None
@@ -551,6 +602,31 @@ class DecodeEngine:
                     np.zeros((s_n,), bool))
                 self._compiled[("step",)] = step_c
 
+                if self.decode_horizon > 1:
+                    # fused multi-step decode: H is a compile-time
+                    # constant (the scan length = horizon arange), so
+                    # the executable lives in the bundle like any other
+                    H = self.decode_horizon
+                    zs_i = np.zeros((s_n,), np.int32)
+                    sm_c = _get(f"step_multi:{H}", lambda: jax.jit(
+                        prog.step_multi, donate_argnums=(1, 2)).lower(
+                            params, kp, vp, np.zeros((s_n, pps), np.int32),
+                            zs_i, zs_i, np.zeros((s_n,), bool),
+                            np.zeros((s_n,), np.float32), zs_i,
+                            np.ones((s_n,), np.float32),
+                            np.zeros((s_n,), np.uint32), zs_i,
+                            np.ones((s_n,), np.int32), np.int32(-1),
+                            np.arange(H, dtype=np.int32)).compile())
+                    kp, vp, _, _, _ = sm_c(
+                        params, kp, vp, np.zeros((s_n, pps), np.int32),
+                        zs_i, zs_i, np.zeros((s_n,), bool),
+                        np.zeros((s_n,), np.float32), zs_i,
+                        np.ones((s_n,), np.float32),
+                        np.zeros((s_n,), np.uint32), zs_i,
+                        np.ones((s_n,), np.int32), np.int32(-1),
+                        np.arange(H, dtype=np.int32))
+                    self._compiled[("step_multi", H)] = sm_c
+
             lg1 = None
             if self.role != "decode":
                 prefill_jit = jax.jit(prog.prefill, donate_argnums=(1, 2))
@@ -563,10 +639,11 @@ class DecodeEngine:
                                      np.zeros((b,), np.int32), np.int32(1))
                     self._compiled[("prefill", b)] = pf
 
-                if self._prefix_on:
-                    # suffix prefill per bucket — only prefix-cache HITS
-                    # use these, so the cold path's executables (and
-                    # bits) are untouched when every request misses
+                if self._prefix_on or self.prefill_chunk is not None:
+                    # suffix prefill per bucket — prefix-cache HITS and
+                    # chunked-prefill chunks drive these; the cold
+                    # path's executables (and bits) are untouched when
+                    # both features are off
                     pa_jit = jax.jit(prog.prefill_at, donate_argnums=(1, 2))
                     for b in self.prompt_buckets:
                         pf = _get(f"prefill_at:{b}",
@@ -1171,9 +1248,17 @@ class DecodeEngine:
                     return
             try:
                 worked = self._admit_some()
-                stepped = (self._spec_step_once()
-                           if self._draft_program is not None
-                           else self._step_once())
+                if self.prefill_chunk is not None:
+                    # at most ONE chunk of prefill work per iteration,
+                    # so the decode dispatch below never waits behind
+                    # more than prefill_chunk prompt tokens
+                    worked = self._prefill_chunk_step() or worked
+                if self._draft_program is not None:
+                    stepped = self._spec_step_once()
+                elif self.decode_horizon > 1:
+                    stepped = self._step_fused_once()
+                else:
+                    stepped = self._step_once()
                 worked = stepped or worked
             except Exception as e:
                 obs_trace.instant("serve/replica_crash", cat="serve",
@@ -1296,7 +1381,12 @@ class DecodeEngine:
             free = [i for i, s in enumerate(self._slots) if s is None]
         if not free:
             return False
-        reqs = self.batcher.admit(len(free))
+        # chunked prefill's batch-formation rule: one admit round never
+        # pulls in more prompt tokens than one chunk budget, so a wall
+        # of long prompts enters the engine at the pace the chunk loop
+        # can interleave (the head request is still always admitted)
+        reqs = self.batcher.admit(len(free),
+                                  token_budget=self.prefill_chunk)
         if not reqs:
             return False
         prog = self.program
@@ -1392,6 +1482,10 @@ class DecodeEngine:
                 self._attach_handoff(i, transfer)
             elif self.role == "prefill":
                 self._prefill_export(i)
+            elif self.prefill_chunk is not None:
+                # defer to the chunk loop: the slot holds its pages but
+                # is not steppable until the last chunk samples token 0
+                slot.n_prefilled = m * prog.page_size
             else:
                 self._prefill_slot(i)
             worked = True
@@ -1492,6 +1586,70 @@ class DecodeEngine:
             with self._lock:
                 self._prefix_insert(s, t1)
         self._record_token(i, tok_h, fin_h, lg_h, t1)
+
+    def _prefill_chunk_step(self) -> bool:
+        """Advance ONE pending chunked prefill by one chunk (at most
+        ``prefill_chunk`` prompt tokens through the ``prefill_at``
+        offset entry point), round-robin across slots mid-prefill so no
+        single long prompt starves another.  The final chunk runs the
+        ``_prefill_slot`` tail — sample token 0, TTFT, prefix insert —
+        and the slot becomes steppable.  Chunk rows attend over all
+        earlier rows already in the pool (same per-row math as a cold
+        prefill), so the final logits are bit-identical to an unchunked
+        prefill of the whole prompt."""
+        with self._lock:
+            pending = [i for i, s in enumerate(self._slots)
+                       if s is not None and s.n_prefilled is not None]
+            if not pending:
+                return False
+            start = self._chunk_cursor
+            i = min(pending, key=lambda x: (x - start) % self.max_slots)
+            self._chunk_cursor = (i + 1) % self.max_slots
+            s = self._slots[i]
+        spec = s.spec
+        n = s.n_prompt
+        p = s.n_prefilled
+        first_offset = s.n_matched * self.program.page_size
+        take = min(self.prefill_chunk, n - p)
+        bucket = self._bucket_for(take)
+        padded = np.zeros((bucket,), np.int32)
+        padded[:take] = spec.prompt[p:p + take]
+        t0 = self.clock()
+        kp, vp = self._cache
+        kp, vp, lg = self._compiled[("prefill_at", bucket)](
+            self._versions[s.tag], kp, vp, self._page_table[i], padded,
+            np.int32(take), np.int32(p))
+        self._cache = (kp, vp)
+        self.metrics.inc("prefill_chunks")
+        if p + take < n:
+            t1 = self.clock()
+            obs_trace.complete_at(
+                "serve/prefill", t0, t1, cat="serve", slot=i,
+                bucket=bucket, prompt_tokens=take, offset=p, model=s.tag)
+            s.n_prefilled = p + take
+            return True
+        # final chunk — the _prefill_slot tail
+        tok, fin = self._compiled[("sample1",)](
+            lg, np.float32(spec.temperature), np.int32(spec.top_k),
+            np.float32(spec.top_p), np.uint32(spec.seed), np.int32(0))
+        tok_h = int(np.asarray(tok))
+        fin_h = bool(np.asarray(fin))
+        lg_h = np.asarray(lg) if spec.echo_logits else None
+        t1 = self.clock()
+        obs_trace.complete_at(
+            "serve/prefill", t0, t1, cat="serve", slot=i, bucket=bucket,
+            prompt_tokens=take, offset=p, model=s.tag)
+        self.metrics.inc("prefills")
+        if p > first_offset:
+            self.metrics.inc("chunked_prefills")   # took >= 2 chunks
+        self.metrics.ttft.record((t1 - s.req.t_submit) * 1e3)
+        s.t_first = t1
+        s.n_prefilled = None
+        if self._prefix_on and fin_h:
+            with self._lock:
+                self._prefix_insert(s, t1)
+        self._record_token(i, tok_h, fin_h, lg_h, t1)
+        return True
 
     def _attach_handoff(self, i: int, transfer) -> None:
         """Decode-stage admission: scatter the prefill host's exported
@@ -1647,7 +1805,8 @@ class DecodeEngine:
         with self._lock:
             tags: List[str] = []
             for s in self._slots:
-                if s is not None and s.tag not in tags:
+                if (s is not None and s.n_prefilled is None
+                        and s.tag not in tags):
                     tags.append(s.tag)
             crash = self._crash_next
             self._crash_next = False
@@ -1671,7 +1830,8 @@ class DecodeEngine:
                 if params is None:
                     continue
                 for i, s in enumerate(self._slots):
-                    if s is None or s.tag != tag:
+                    if (s is None or s.tag != tag
+                            or s.n_prefilled is not None):
                         continue
                     group.append(i)
                     toks_in[i] = s.last_token
@@ -1689,6 +1849,7 @@ class DecodeEngine:
             kp, vp = self._cache
             kp, vp, lgs = self._compiled[("step",)](
                 params, kp, vp, self._page_table, toks_in, pos, act)
+            t_step = self.clock()
             toks, fin = self._compiled[("sample",)](
                 lgs, temps, tks, tps, seeds, steps)
             self._cache = (kp, vp)
@@ -1697,7 +1858,9 @@ class DecodeEngine:
             lgs_h = np.asarray(lgs) if echo else None
             t1 = self.clock()
             obs_trace.complete_at("serve/decode_step", t0, t1, cat="serve",
-                                  n_active=len(group), model=tag)
+                                  n_active=len(group), model=tag, tokens=1,
+                                  step_ms=round((t_step - t0) * 1e3, 3),
+                                  sample_ms=round((t1 - t_step) * 1e3, 3))
             if getattr(self.program, "tp", 1) > 1:
                 obs_trace.complete_at(
                     "serve/shard_step", t0, t1, cat="serve",
@@ -1715,6 +1878,116 @@ class DecodeEngine:
                         lgs_h[i].copy() if (lgs_h is not None
                                             and s.logits is not None)
                         else None, t1)
+        return True
+
+    def _step_fused_once(self) -> bool:
+        """One FUSED dispatch per distinct active version tag: H =
+        ``decode_horizon`` decode steps plus device-resident sampling
+        run inside the single ``("step_multi", H)`` executable, and the
+        host syncs once per H tokens.  Host bookkeeping then replays
+        the H (token, finite) pairs through ``_record_token`` exactly
+        as H plain steps would have — a slot that stops mid-horizon
+        (EOS / budget / poison / deadline) frees at the same token, and
+        the device's post-stop overrun (≤ H-1 tokens, routed to the
+        scratch page on device) is simply not recorded.  Host slot
+        state is only mutated AFTER the dispatch returns, so a crash
+        anywhere inside the horizon retries from the last committed
+        token and regenerates identical bits (seeded counter-based
+        sampling)."""
+        s_n = self.max_slots
+        H = self.decode_horizon
+        with self._lock:
+            tags: List[str] = []
+            for s in self._slots:
+                if (s is not None and s.n_prefilled is None
+                        and s.tag not in tags):
+                    tags.append(s.tag)
+            crash = self._crash_next
+            self._crash_next = False
+        if crash and not tags:
+            raise ReplicaCrashError("injected decode-batch crash (test hook)")
+        if not tags:
+            return False
+        eos = np.int32(self.eos_id if self.eos_id is not None else -1)
+        for tag in tags:
+            toks_in = np.zeros((s_n,), np.int32)
+            pos = np.zeros((s_n,), np.int32)
+            act = np.zeros((s_n,), bool)
+            temps = np.zeros((s_n,), np.float32)
+            tks = np.zeros((s_n,), np.int32)
+            tps = np.ones((s_n,), np.float32)
+            seeds = np.zeros((s_n,), np.uint32)
+            steps = np.zeros((s_n,), np.int32)
+            budgets = np.ones((s_n,), np.int32)
+            group: List[int] = []
+            echo = False
+            with self._lock:
+                params = self._versions.get(tag)
+                if params is None:
+                    continue
+                for i, s in enumerate(self._slots):
+                    if (s is None or s.tag != tag
+                            or s.n_prefilled is not None):
+                        continue
+                    group.append(i)
+                    toks_in[i] = s.last_token
+                    pos[i] = s.pos
+                    act[i] = True
+                    temps[i] = s.spec.temperature
+                    tks[i] = s.spec.top_k
+                    tps[i] = s.spec.top_p
+                    seeds[i] = s.spec.seed
+                    steps[i] = s.n_out
+                    budgets[i] = max(1, s.max_new - s.n_out)
+                    echo = echo or s.logits is not None
+            if not group:
+                continue
+            t0 = self.clock()
+            kp, vp = self._cache
+            kp, vp, toks, fins, lgs = self._compiled[("step_multi", H)](
+                params, kp, vp, self._page_table, toks_in, pos, act,
+                temps, tks, tps, seeds, steps, budgets, eos,
+                np.arange(H, dtype=np.int32))
+            self._cache = (kp, vp)
+            toks_h = np.asarray(toks)      # [H, S]
+            fins_h = np.asarray(fins)
+            lgs_h = np.asarray(lgs) if echo else None
+            t1 = self.clock()
+            if crash:
+                # "mid-horizon" from the host's view: the device has
+                # advanced H tokens but NONE are committed — recovery
+                # must retry from the last committed token
+                raise ReplicaCrashError(
+                    "injected decode-batch crash (test hook)")
+            obs_trace.complete_at("serve/decode_step", t0, t1, cat="serve",
+                                  n_active=len(group), model=tag, tokens=H,
+                                  step_ms=round((t1 - t0) * 1e3, 3),
+                                  sample_ms=0.0)
+            if getattr(self.program, "tp", 1) > 1:
+                obs_trace.complete_at(
+                    "serve/shard_step", t0, t1, cat="serve",
+                    n_active=len(group), shards=int(self.program.tp),
+                    model=tag)
+            self.metrics.inc("decode_steps")
+            self.metrics.inc("fused_dispatches")
+            self.metrics.step_time.record((t1 - t0) * 1e3)
+            committed = 0
+            for i in group:
+                for j in range(H):
+                    with self._lock:
+                        s = self._slots[i]
+                    if s is None:
+                        break       # stopped mid-horizon; drop overrun
+                    s.pos += 1
+                    fin_j = bool(fins_h[j, i])
+                    self._record_token(
+                        i, int(toks_h[j, i]), fin_j,
+                        lgs_h[j, i].copy() if (lgs_h is not None
+                                               and s.logits is not None)
+                        else None, t1)
+                    if fin_j:
+                        committed += 1
+            self.metrics.inc("tokens_per_dispatch", committed)
         return True
 
     def _spec_step_once(self) -> bool:
@@ -2011,6 +2284,8 @@ class DecodeEngine:
         snap["kv_dtype"] = self._kv_dtype or "float32"
         snap["role"] = self.role
         snap["tp"] = int(getattr(self.program, "tp", 1))
+        snap["decode_horizon"] = self.decode_horizon
+        snap["prefill_chunk"] = self.prefill_chunk
         return snap
 
     def health_snapshot(self) -> dict:

@@ -265,6 +265,12 @@ _DECODE_COUNTER_KEYS = (
     "spec_steps", "spec_proposed", "spec_accepted", "spec_committed",
     "handoffs_out", "handoffs_in",
     "pages_exported", "pages_attached", "pages_deduped",
+    # host-overhead elimination (docs/SERVING.md): fused multi-step
+    # decode dispatches, tokens committed by them (tokens_per_dispatch /
+    # fused_dispatches = realized amortization), and chunked-prefill
+    # prompt/chunk counts
+    "fused_dispatches", "tokens_per_dispatch",
+    "chunked_prefills", "prefill_chunks",
 )
 
 

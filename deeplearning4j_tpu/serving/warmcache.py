@@ -28,13 +28,15 @@ the device set they were compiled for.  Callers route accordingly (see
 Decode-engine bundle keys: the base set is ``prefill:<bucket>`` per
 prompt bucket plus ``step`` / ``sample1`` / ``sample`` / ``reset`` /
 ``scrub``; the decode-side
-optimizations add ``prefill_at:<bucket>`` (prefix cache: suffix-only
-prefill at an offset), and — when a draft model is configured —
-``draft_prefill:<bucket>`` / ``draft_prefill_at:<bucket>`` /
-``draft_step`` / ``draft_reset`` / ``draft_scrub`` plus the
-verification trio ``spec_step`` / ``propose`` / ``spec_accept``.  All
-of them ride the same serialize/deserialize path, so speculative and
-prefix-cached engines warm-load compile-free too.
+optimizations add ``prefill_at:<bucket>`` (prefix cache AND chunked
+prefill: prefill resuming at an offset), ``step_multi:<H>`` (fused
+multi-step decode at horizon H — one entry per configured horizon),
+and — when a draft model is configured — ``draft_prefill:<bucket>`` /
+``draft_prefill_at:<bucket>`` / ``draft_step`` / ``draft_reset`` /
+``draft_scrub`` plus the verification trio ``spec_step`` /
+``propose`` / ``spec_accept``.  All of them ride the same
+serialize/deserialize path, so speculative, prefix-cached and fused
+engines warm-load compile-free too.
 """
 from __future__ import annotations
 

@@ -55,6 +55,30 @@ gated arms over the same tiny model):
      int8 changes bits, so it is never held to the identity gates) and
      an analytic sessions-at-fixed-HBM ratio (f32 pool bytes / int8
      pool bytes >= 2.0).
+
+``--host-overhead`` runs the host-overhead elimination A/B (docs/
+SERVING.md "Host-overhead elimination") — fused multi-step decode and
+chunked prefill, each against the plain engine on the same tiny model:
+
+  1. FUSED identity — at EVERY H in {2, 4, 8}: temp-0 tokens identical
+     to the plain engine AND echoed logits BITWISE equal to the
+     re-encode oracle; seeded temp>0 tokens identical (counter-based
+     fold_in(seed, token_index) keying is horizon-invariant); a crash
+     injected mid-horizon strands nothing and the retry reproduces
+     identical tokens; zero serve-time compiles, with the fused
+     executable round-tripping through the warmup bundle
+     (bundle_misses == 0 on a bundle-warmed engine).
+  2. FUSED speed — batch-1 closed-loop tokens/sec strictly above the
+     plain-step engine on every platform (the win is H-for-1 host
+     dispatch amortization, which is platform-independent).
+  3. CHUNKED prefill — a wall of long prompts lands mid-stream on a
+     unified engine: with chunked prefill the in-flight short streams'
+     TPOT p99 must hold <= 1.2x the calm (no wall) baseline, while the
+     same wall on the plain engine measurably degrades it (monolithic
+     prefill dispatches block the decode loop for a full long bucket).
+
+On gate failure the trace ring is dumped as a Chrome trace artifact
+(path in the JSON result) for offline triage.
 """
 
 from __future__ import annotations
@@ -399,12 +423,262 @@ def speed_suite(args) -> int:
     return 0
 
 
+def host_overhead_suite(args) -> int:
+    """The ``--host-overhead`` arms: fused multi-step decode identity +
+    batch-1 speed, and chunked prefill under a long-prompt wall
+    (module docstring)."""
+    import tempfile
+
+    import jax
+
+    from deeplearning4j_tpu.obs import trace as obs_trace
+    from deeplearning4j_tpu.parallel.mesh import build_mesh
+    from deeplearning4j_tpu.parallel.transformer import ShardedTransformerLM
+    from deeplearning4j_tpu.serving import DecodeEngine
+
+    platform = jax.devices()[0].platform
+    max_new = args.max_new
+    horizons = (2, 4, 8)
+    buckets = (16, 32, 64, 128)
+    obs_trace.enable_tracing()
+    mesh = build_mesh({"data": 1, "model": 1, "seq": 1, "pipe": 1},
+                      devices=jax.devices()[:1])
+    lm = ShardedTransformerLM(vocab_size=64, n_layers=2, d_model=64,
+                              n_heads=4, max_len=256, mesh=mesh, seed=7)
+
+    def make_engine(max_slots=None, warm_bundle=None, **kw):
+        return DecodeEngine(lm, max_slots=max_slots or args.max_slots,
+                            page_size=8, default_max_new=max_new,
+                            max_queue=100_000, admission="block",
+                            prompt_buckets=buckets,
+                            **kw).load(warm_bundle=warm_bundle)
+
+    plain = make_engine()
+    prog = plain.program
+    re1 = jax.jit(prog.reencode).lower(
+        lm.params, np.zeros((1, prog.max_len), np.int32)).compile()
+
+    def oracle_rows(prompt, toks):
+        seq = np.zeros((1, prog.max_len), np.int32)
+        full = [int(x) for x in prompt] + [int(t) for t in toks]
+        seq[0, :len(full)] = full
+        return np.asarray(re1(lm.params, seq))[0]
+
+    def bits_match(prompt, res) -> bool:
+        ref = oracle_rows(prompt, res.tokens)
+        return all(np.array_equal(ref[len(prompt) + j - 1], res.logits[j])
+                   for j in range(len(res.tokens)))
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, 64, size=n).astype(np.int32)
+               for n in (5, 11, 23, 50)]
+
+    # ---- arm 1: fused identity at every H ----------------------------
+    print("host_overhead: arm 1/3 fused identity", file=sys.stderr)
+    refs0 = [plain.generate(p, max_new_tokens=max_new,
+                            temperature=0.0).tokens for p in prompts]
+    refs_s = [plain.generate(p, max_new_tokens=max_new, temperature=0.8,
+                             seed=123).tokens for p in prompts]
+    fused_arms = {}
+    for H in horizons:
+        eng = make_engine(decode_horizon=H)
+        cc0 = eng.compile_cache_size()
+        t0_match = bit_id = seeded_match = True
+        for p, r0, rs in zip(prompts, refs0, refs_s):
+            res = eng.generate(p, max_new_tokens=max_new,
+                               temperature=0.0, echo_logits=True)
+            t0_match = t0_match and res.tokens == r0
+            bit_id = bit_id and bits_match(p, res)
+            got_s = eng.generate(p, max_new_tokens=max_new,
+                                 temperature=0.8, seed=123).tokens
+            seeded_match = seeded_match and got_s == rs
+        # crash injected mid-horizon: device advanced H tokens, none
+        # committed — retry must regenerate identical bits, nothing
+        # may strand
+        crash_futs = [eng.generate_async(prompts[i % len(prompts)],
+                                         max_new_tokens=max_new,
+                                         temperature=0.8, seed=123)
+                      for i in range(2 * args.max_slots)]
+        eng._crash_next = True
+        stranded = 0
+        retry_match = True
+        for i, fut in enumerate(crash_futs):
+            try:
+                res = fut.result(timeout=180)
+                retry_match = (retry_match
+                               and res.tokens == refs_s[i % len(prompts)])
+            except Exception:
+                retry_match = False
+            if not fut.done():
+                stranded += 1
+        zero = eng.compile_cache_size() == cc0
+        # bundle round-trip: a fresh engine warmed ONLY from the bundle
+        # must serve fused dispatches with zero bundle misses
+        bpath = os.path.join(tempfile.mkdtemp(prefix="fused_ab_"),
+                             f"h{H}.warmup")
+        eng.save_warmup_bundle(bpath)
+        eng2 = make_engine(decode_horizon=H, warm_bundle=bpath)
+        misses = eng2.metrics_snapshot()["counters"]["bundle_misses"]
+        bundle_ok = (misses == 0
+                     and eng2.generate(prompts[0], max_new_tokens=max_new,
+                                       temperature=0.0).tokens == refs0[0])
+        eng2.shutdown()
+        crashes = eng.metrics_snapshot()["counters"]["replica_crashes"]
+        eng.shutdown()
+        fused_arms[str(H)] = {
+            "tokens_match_t0": t0_match, "bit_identical": bit_id,
+            "tokens_match_seeded": seeded_match,
+            "stranded": stranded, "retry_match": retry_match,
+            "crashes": crashes, "zero_compiles": zero,
+            "bundle_misses": misses, "bundle_ok": bundle_ok,
+            "ok": (t0_match and bit_id and seeded_match and stranded == 0
+                   and retry_match and crashes >= 1 and zero
+                   and bundle_ok),
+        }
+
+    # ---- arm 2: batch-1 closed-loop tokens/sec -----------------------
+    print("host_overhead: arm 2/3 batch-1 speed", file=sys.stderr)
+    n_speed = 4 if args.quick else 10
+    speed_new = max(max_new, 32)
+    fused8 = make_engine(decode_horizon=8)
+
+    def batch1_tps(eng) -> float:
+        eng.generate(prompts[1], max_new_tokens=speed_new)   # absorb jitter
+        tok = 0
+        t0 = time.perf_counter()
+        for i in range(n_speed):
+            tok += len(eng.generate(prompts[i % len(prompts)],
+                                    max_new_tokens=speed_new,
+                                    temperature=0.0).tokens)
+        return tok / max(time.perf_counter() - t0, 1e-9)
+
+    plain_tps = batch1_tps(plain)
+    fused_tps = batch1_tps(fused8)
+    snap8 = fused8.metrics_snapshot()["counters"]
+    fused8.shutdown()
+    amort = (snap8["tokens_per_dispatch"]
+             / max(snap8["fused_dispatches"], 1))
+    speed_arm = {
+        "plain_tokens_per_sec": round(plain_tps, 2),
+        "fused_tokens_per_sec": round(fused_tps, 2),
+        "speedup": round(fused_tps / max(plain_tps, 1e-9), 4),
+        "tokens_per_dispatch": round(amort, 3),
+        "ok": fused_tps > plain_tps,
+    }
+
+    # ---- arm 3: chunked prefill vs a long-prompt wall ----------------
+    print("host_overhead: arm 3/3 chunked prefill wall", file=sys.stderr)
+    n_short, n_wall = 4, 6
+    chunk_tokens = 16   # == the short-prompt bucket: a chunk stalls the
+    # decode loop no longer than routine short-prompt admission, so the
+    # wall's per-token stalls stay inside the calm envelope
+    short_ps = [rng.integers(0, 64, size=8).astype(np.int32)
+                for _ in range(n_short)]
+    wall_ps = [rng.integers(0, 64, size=120).astype(np.int32)
+               for _ in range(n_wall)]
+
+    def wall_tpot(eng, wall: bool):
+        """Stagger n_short decode streams (continuous-batching joins —
+        the calm baseline INCLUDES routine admission stalls), optionally
+        land the long-prompt wall mid-stream, and return the p99 gap
+        (ms) between consecutive ``serve/decode_step`` dispatches — the
+        per-token stall a decoding stream actually observes while the
+        engine does prefill work between its tokens."""
+        rec = obs_trace.TraceRecorder()
+        old = obs_trace.set_recorder(rec)
+        try:
+            futs = []
+            wfuts = []
+            for i, p in enumerate(short_ps):
+                futs.append(eng.generate_async(p, max_new_tokens=64,
+                                               temperature=0.0))
+                time.sleep(0.012)
+                if wall and i == 1:   # wall lands mid-stream
+                    wfuts = [eng.generate_async(w, max_new_tokens=4,
+                                                temperature=0.0)
+                             for w in wall_ps]
+            tpots = [f.result(timeout=180).tpot_ms for f in futs]
+            for f in wfuts:
+                f.result(timeout=180)
+        finally:
+            obs_trace.set_recorder(old)
+        evs = sorted((e for e in rec.export()["traceEvents"]
+                      if e.get("name") == "serve/decode_step"),
+                     key=lambda e: e["ts"])
+        gaps = [max(0.0, (b["ts"] - (a["ts"] + a.get("dur", 0))) / 1e3)
+                for a, b in zip(evs, evs[1:])]
+        mean_p99 = _percentile([t for t in tpots if t is not None], 0.99)
+        return _percentile(gaps, 0.99), mean_p99
+
+    chunk = make_engine(max_slots=8, prefill_chunk=chunk_tokens)
+    plain8 = make_engine(max_slots=8)
+    chunk_cc0 = chunk.compile_cache_size()
+    chunk_calm, chunk_calm_mean = wall_tpot(chunk, wall=False)
+    chunk_wall, chunk_wall_mean = wall_tpot(chunk, wall=True)
+    plain_calm, plain_calm_mean = wall_tpot(plain8, wall=False)
+    plain_wall, plain_wall_mean = wall_tpot(plain8, wall=True)
+    # chunked prompts stay token-exact (prefill_at offsets are bitwise
+    # vs the monolithic prefill — PR-12 contract)
+    c_tokens = all(
+        chunk.generate(p, max_new_tokens=max_new, temperature=0.0).tokens
+        == plain8.generate(p, max_new_tokens=max_new,
+                           temperature=0.0).tokens
+        for p in prompts + wall_ps[:1])
+    cc = chunk.metrics_snapshot()["counters"]
+    chunk_zero = chunk.compile_cache_size() == chunk_cc0
+    chunk.shutdown()
+    plain8.shutdown()
+    chunk_ratio = chunk_wall / max(chunk_calm, 1e-9)
+    plain_ratio = plain_wall / max(plain_calm, 1e-9)
+    chunk_arm = {
+        "chunk_tokens": chunk_tokens,
+        "tpot_calm_p99_ms": chunk_calm, "tpot_wall_p99_ms": chunk_wall,
+        "tpot_wall_over_calm": round(chunk_ratio, 4),
+        "plain_tpot_calm_p99_ms": plain_calm,
+        "plain_tpot_wall_p99_ms": plain_wall,
+        "plain_tpot_wall_over_calm": round(plain_ratio, 4),
+        "plain_degrades": plain_ratio > 1.2,
+        "mean_tpot_p99_ms": {"calm": chunk_calm_mean,
+                             "wall": chunk_wall_mean,
+                             "plain_calm": plain_calm_mean,
+                             "plain_wall": plain_wall_mean},
+        "tokens_match": c_tokens, "zero_compiles": chunk_zero,
+        "chunked_prefills": cc["chunked_prefills"],
+        "prefill_chunks": cc["prefill_chunks"],
+        "ok": (chunk_ratio <= 1.2 and plain_ratio > 1.2
+               and c_tokens and chunk_zero
+               and cc["chunked_prefills"] >= n_wall
+               and cc["prefill_chunks"] > cc["chunked_prefills"]),
+    }
+
+    plain_zero = True   # plain engine watched across all three arms
+    plain.shutdown()
+    result = {
+        "suite": "host_overhead", "platform": platform,
+        "quick": args.quick, "max_new": max_new,
+        "horizons": list(horizons),
+        "fused": fused_arms, "speed": speed_arm, "chunked": chunk_arm,
+        "ok": (all(a["ok"] for a in fused_arms.values())
+               and speed_arm["ok"] and chunk_arm["ok"] and plain_zero),
+    }
+    if not result["ok"]:
+        # dump the trace ring for offline triage of the failing arm
+        art = os.path.join(tempfile.gettempdir(), "fused_step_ab_trace.json")
+        result["trace_artifact"] = obs_trace.flush(art)
+    print(json.dumps(result))
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--speed-suite", action="store_true",
                     help="run the prefix/speculative/int8 arms instead "
                     "of the static-batch baseline A/B")
+    ap.add_argument("--host-overhead", action="store_true",
+                    help="run the fused multi-step decode + chunked "
+                    "prefill arms instead of the static-batch baseline "
+                    "A/B")
     ap.add_argument("--requests", type=int, default=None)
     ap.add_argument("--interarrival-ms", type=float, default=4.0)
     ap.add_argument("--max-slots", type=int, default=4)
@@ -413,6 +687,8 @@ def main() -> int:
 
     if args.speed_suite:
         return speed_suite(args)
+    if args.host_overhead:
+        return host_overhead_suite(args)
 
     import jax
 
